@@ -1,0 +1,25 @@
+"""ompi_tpu — a TPU-native communication framework with the capabilities of
+Open MPI (reference surveyed in SURVEY.md).
+
+Architecture (SURVEY.md §7): Open MPI's two load-bearing ideas — layered
+frameworks with prioritized swappable components, and a launcher/runtime split
+over a tiny identity/modex/fence control plane — implemented TPU-first:
+
+  * ``core``     — substrate: vars/config, component registry, progress (≙ opal/)
+  * ``control``  — bootstrap control plane + ``tpurun`` launcher (≙ PMIx/PRRTE)
+  * ``datatype`` — typed layouts + pack/unpack convertor (≙ opal/datatype)
+  * ``p2p``      — matching + eager/rendezvous point-to-point (≙ pml/ob1 + btl)
+  * ``coll``     — collectives framework: host algorithms + XLA/ICI component
+                   (≙ ompi/mca/coll; the xla component replaces coll/accelerator
+                   host staging with native in-HBM collectives)
+  * ``parallel`` — device mesh / sharding-level API: named-axis collectives,
+                   ring (context) parallelism, Ulysses all-to-all, hierarchical
+                   two-level collectives (≙ coll/han), pipeline helpers
+  * ``ops``      — Pallas/XLA kernels for the hot paths
+  * ``models``   — acceptance workloads (ring, stencil/CG, transformer flagship)
+  * ``ft``       — failure detection + revoke/shrink/agree (≙ ULFM)
+"""
+
+__version__ = "0.1.0"
+
+from .core import var  # noqa: F401

@@ -1,0 +1,75 @@
+"""Progress engine — the global polling loop.
+
+Re-design of opal/runtime/opal_progress.c:216-241: components (transports,
+nonblocking-collective schedules, failure detector) register callbacks; any
+thread blocked on a request completion spins in ``progress()`` which polls
+every registered callback. High/low priority tiers are kept from the
+reference: low-priority callbacks (e.g. connection management, heartbeats)
+run only every Nth call, like libevent being pumped every 8th call.
+
+This matters on TPU hosts too: completion of host-side p2p (DCN/shm) is
+polled here, while device-side collectives complete through PJRT futures —
+the ``wait_sync`` bridge lets a caller block on either.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List
+
+_LOW_PRIORITY_INTERVAL = 8
+
+
+class ProgressEngine:
+    def __init__(self) -> None:
+        self._high: List[Callable[[], int]] = []
+        self._low: List[Callable[[], int]] = []
+        self._lock = threading.RLock()
+        self._counter = 0
+
+    def register(self, fn: Callable[[], int], low_priority: bool = False) -> None:
+        with self._lock:
+            (self._low if low_priority else self._high).append(fn)
+
+    def unregister(self, fn: Callable[[], int]) -> None:
+        with self._lock:
+            for lst in (self._high, self._low):
+                if fn in lst:
+                    lst.remove(fn)
+
+    def progress(self) -> int:
+        """One pass over callbacks; returns number of completed events."""
+        events = 0
+        with self._lock:
+            high = list(self._high)
+            self._counter += 1
+            low = list(self._low) if self._counter % _LOW_PRIORITY_INTERVAL == 0 else []
+        for fn in high:
+            events += fn() or 0
+        for fn in low:
+            events += fn() or 0
+        return events
+
+    def wait_until(self, cond: Callable[[], bool], timeout: float | None = None) -> bool:
+        """Spin in progress() until cond() — the ompi_request_wait_completion
+        pattern (reference ompi/request/request.h:129 wait loop)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        idle = 0
+        while not cond():
+            if self.progress() == 0:
+                idle += 1
+                if idle > 100:        # back off when nothing is moving
+                    time.sleep(0.0001)
+            else:
+                idle = 0
+            if deadline is not None and time.monotonic() > deadline:
+                return cond()
+        return True
+
+
+progress_engine = ProgressEngine()
+
+
+def progress() -> int:
+    return progress_engine.progress()

@@ -1,0 +1,21 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the reference's pattern of testing
+the full stack single-host with self/sm/tcp transports — SURVEY.md §4); the
+driver separately dry-run-compiles the multi-chip path.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_var_cache():
+    from ompi_tpu.core import var
+    yield
+    var.registry.reset_cache()
