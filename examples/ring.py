@@ -1,0 +1,42 @@
+"""Ring message-passing example (≙ examples/ring_c.c:1 — the PR1 acceptance
+workload, BASELINE.json configs[0]).
+
+Run:  python -m ompi_tpu.tools.tpurun -np 4 examples/ring.py
+"""
+
+import sys
+import time
+
+import numpy as np
+
+from ompi_tpu import runtime
+
+
+def main() -> int:
+    ctx = runtime.init()
+    me, n = ctx.rank, ctx.size
+    nxt, prv = (me + 1) % n, (me - 1) % n
+    buf = np.zeros(1, np.int32)
+    t0 = time.perf_counter()
+    if me == 0:
+        buf[0] = 10
+        print(f"rank 0 sending {int(buf[0])} around a {n}-rank ring", flush=True)
+        ctx.p2p.send(buf, dst=nxt, tag=201)
+    while True:
+        ctx.p2p.recv(buf, src=prv, tag=201)
+        if me == 0:
+            buf[0] -= 1
+        ctx.p2p.send(buf, dst=nxt, tag=201)
+        if buf[0] == 0:
+            break
+    if me == 0:
+        ctx.p2p.recv(buf, src=prv, tag=201)
+        dt = time.perf_counter() - t0
+        print(f"rank 0 done: 10 laps x {n} hops in {dt*1e3:.2f} ms "
+              f"({dt*1e6/(10*n):.1f} us/hop)", flush=True)
+    runtime.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

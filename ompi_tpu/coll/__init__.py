@@ -1,0 +1,17 @@
+"""Collectives framework (≙ ompi/mca/coll). Importing the package registers
+the in-tree components."""
+
+from .framework import COLL_FUNCTIONS, CollModule, CollTable, attach_coll  # noqa: F401
+from . import basic  # noqa: F401  (register coll/basic)
+from . import selfcoll  # noqa: F401  (register coll/self)
+
+# tuned and xla register on import too; tolerate partial availability during
+# bring-up of a reduced build
+try:
+    from . import tuned  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+try:
+    from . import xla  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass

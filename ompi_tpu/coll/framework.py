@@ -1,0 +1,110 @@
+"""Collectives framework: per-communicator function table + selection.
+
+≙ ompi/mca/coll: the module attached to each communicator is a table of
+collective entry points (coll.h:531 — blocking, nonblocking, persistent);
+components are queried per communicator and stacked per-function: for every
+entry point, the highest-priority component that implements it wins, with
+lower-priority components as fallback (coll_base_comm_select.c:233,385,456 —
+the subtle contract SURVEY.md calls out).
+
+Components in-tree:
+  * ``selfcoll`` — trivial size-1 communicators (≙ coll/self)
+  * ``basic``    — linear/correctness algorithms (≙ coll/basic)
+  * ``tuned``    — algorithm library + size-based decision rules
+                   (≙ coll/base + coll/tuned)
+  * ``xla``      — ICI-native device collectives for communicators that map
+                   onto a TPU mesh (replaces coll/accelerator host staging)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..core.component import frameworks
+from ..core.output import output, show_help
+
+# the full entry-point inventory (blocking set; i*/persistent variants are
+# derived wrappers — see CollTable.__getattr__)
+COLL_FUNCTIONS = [
+    "allgather", "allgatherv", "allreduce", "alltoall", "alltoallv",
+    "alltoallw", "barrier", "bcast", "exscan", "gather", "gatherv",
+    "reduce", "reduce_scatter", "reduce_scatter_block", "scan", "scatter",
+    "scatterv", "reduce_local",
+    # neighborhood collectives (cart/graph topologies, ≙ coll/basic neighbor_*)
+    "neighbor_allgather", "neighbor_allgatherv", "neighbor_alltoall",
+    "neighbor_alltoallv", "neighbor_alltoallw",
+]
+
+
+class CollModule:
+    """Base class for per-communicator collective modules. Implement any
+    subset of COLL_FUNCTIONS as methods fn(comm, ...)."""
+
+    def enabled(self, name: str) -> bool:
+        return hasattr(self, name)
+
+
+class CollTable:
+    """The per-communicator dispatch table with per-function fallback."""
+
+    def __init__(self, entries: Dict[str, "CollModule"],
+                 stack: List[tuple]) -> None:
+        self._entries = entries
+        self.stack = stack       # [(priority, component_name, module)]
+
+    def provider(self, name: str) -> Optional[str]:
+        """Which component serves this entry point (tpu_info introspection)."""
+        mod = self._entries.get(name)
+        return getattr(mod, "_component_name", None) if mod else None
+
+    def __getattr__(self, name: str):
+        entries = object.__getattribute__(self, "_entries")
+        if name in entries:
+            fn = getattr(entries[name], name)
+
+            def counted(comm, *a, **kw):
+                spc = getattr(comm.ctx, "spc", None)
+                if spc is not None:
+                    spc.inc("collectives")
+                    if name == "barrier":
+                        spc.inc("barriers")
+                return fn(comm, *a, **kw)
+
+            return counted
+        # nonblocking variants: i<name> falls back to eager execution wrapped
+        # in a completed request when no component provides a true schedule
+        if name.startswith("i") and name[1:] in entries:
+            blocking = getattr(entries[name[1:]], name[1:])
+
+            def nb(comm, *a, **kw):
+                from ..p2p.request import CompletedRequest
+                result = blocking(comm, *a, **kw)
+                req = CompletedRequest()
+                req.result = result
+                return req
+
+            return nb
+        raise AttributeError(f"no collective entry point {name!r}")
+
+
+def attach_coll(comm) -> None:
+    """Select and attach the coll table for a new communicator
+    (≙ mca_coll_base_comm_select)."""
+    rows = frameworks.framework("coll").select_all(comm)
+    if not rows:
+        show_help.show("no-component", "coll", "coll_select", "")
+        raise RuntimeError("no coll components available")
+    entries: Dict[str, CollModule] = {}
+    for pri, component, module in sorted(rows, key=lambda r: r[0]):
+        # ascending priority: higher priorities overwrite → win per-function
+        if module is None:
+            continue
+        module._component_name = component.name
+        for fn in COLL_FUNCTIONS:
+            if module.enabled(fn):
+                entries[fn] = module
+    comm.coll = CollTable(entries, sorted(rows, key=lambda r: -r[0]))
+    output.verbose(10, "coll",
+                   f"comm {comm.name}: " +
+                   ", ".join(f"{f}→{m._component_name}"
+                             for f, m in sorted(entries.items())))

@@ -1,0 +1,659 @@
+"""Optimized host collective algorithms + decision rules.
+
+≙ the reference's algorithm library ompi/mca/coll/base/ (SURVEY.md Appendix A)
+plus coll/tuned's decision machinery (coll_tuned_decision_fixed.c:55-104,
+dynamic rules file coll_tuned_dynamic_file.c:58).
+
+Algorithms implemented (reference file:line for the original):
+  allreduce: recursive-doubling (coll_base_allreduce.c:133), ring (:344),
+             Rabenseifner reduce-scatter+allgather (:973)
+  bcast:     binomial tree (coll_base_bcast.c:333), scatter+allgather (:774)
+  reduce:    binomial tree (coll_base_reduce.c:476)
+  allgather: recursive-doubling (coll_base_allgather.c:85), ring (:330),
+             bruck (:767 k=2)
+  reduce_scatter_block: recursive-halving (coll_base_reduce_scatter.c:132)
+  alltoall:  pairwise (coll_base_alltoall.c:180), bruck (:239)
+  barrier:   recursive-doubling (coll_base_barrier.c:188), bruck (:269)
+  scan/exscan: recursive-doubling prefix (coll_base_scan.c:157)
+
+Selection: fixed size/msg-size rules, overridable per-collective with the
+``coll_tuned_<name>_algorithm`` variable and via a dynamic rules file named
+by ``coll_tuned_dynamic_rules`` (lines: ``<coll> <min_comm> <min_bytes>
+<algorithm>``, later lines win — the user-tunable escape hatch the reference
+ships for cluster-specific tuning).
+
+Non-commutative ops fall back to the in-order linear algorithms
+(≙ coll_base_reduce.c:514 in-order binary for non-commutative).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core import var as _var
+from ..core.component import Component, component
+from ..op import Op
+from ..p2p.request import wait_all
+from .basic import BasicModule, T_ALLGATHER, T_ALLTOALL, T_BARRIER, T_BCAST, \
+    T_REDUCE, T_RSCAT, T_SCAN, _inplace
+from .framework import CollModule
+
+
+def _sum_default(op):
+    from .. import op as _op
+    return op or _op.SUM
+
+
+# ---------------------------------------------------------------------------
+# allreduce algorithms
+# ---------------------------------------------------------------------------
+
+def allreduce_recursive_doubling(comm, send: np.ndarray, recv: np.ndarray,
+                                 op: Op) -> None:
+    """coll_base_allreduce.c:133 — log2(p) rounds, full vector each round.
+    Best for small messages. Non-power-of-2 handled with the standard
+    fold-in/fold-out of extra ranks."""
+    size, rank = comm.size, comm.rank
+    recv[...] = send
+    pof2 = 1 << (size.bit_length() - 1)
+    rem = size - pof2
+    tmp = np.empty_like(recv)
+    # fold extras: ranks [0, 2*rem) pair up (even sends to odd)
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.send(recv, rank + 1, T_REDUCE)
+            newrank = -1
+        else:
+            comm.recv(tmp, rank - 1, T_REDUCE)
+            recv[...] = op(tmp, recv)
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
+    if newrank >= 0:
+        mask = 1
+        while mask < pof2:
+            peer_new = newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            comm.sendrecv(recv, peer, tmp, peer, T_REDUCE, T_REDUCE)
+            if op.commutative or peer < rank:
+                recv[...] = op(tmp, recv)
+            else:
+                recv[...] = op(recv.copy(), tmp)
+            mask <<= 1
+    # unfold
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.recv(recv, rank + 1, T_REDUCE)
+        else:
+            comm.send(recv, rank - 1, T_REDUCE)
+
+
+def allreduce_ring(comm, send: np.ndarray, recv: np.ndarray, op: Op) -> None:
+    """coll_base_allreduce.c:344 — reduce-scatter ring then allgather ring;
+    bandwidth-optimal 2(p-1)/p·n bytes per rank. The identical neighbor-
+    exchange schedule ring attention uses (SURVEY.md §5.7)."""
+    size, rank = comm.size, comm.rank
+    recv[...] = send
+    if size == 1:
+        return
+    flat = recv.reshape(-1)
+    chunks = np.array_split(np.arange(flat.size), size)
+    right = (rank + 1) % size
+    left = (rank - 1) % size
+    # reduce-scatter phase
+    for step in range(size - 1):
+        send_idx = chunks[(rank - step) % size]
+        recv_idx = chunks[(rank - step - 1) % size]
+        inbox = np.empty(recv_idx.size, flat.dtype)
+        comm.sendrecv(flat[send_idx[0]:send_idx[0] + send_idx.size]
+                      if send_idx.size else flat[:0],
+                      right, inbox, left, T_REDUCE, T_REDUCE)
+        if recv_idx.size:
+            seg = flat[recv_idx[0]:recv_idx[0] + recv_idx.size]
+            seg[...] = op(inbox, seg)
+    # allgather phase
+    for step in range(size - 1):
+        send_idx = chunks[(rank + 1 - step) % size]
+        recv_idx = chunks[(rank - step) % size]
+        inbox = np.empty(recv_idx.size, flat.dtype)
+        comm.sendrecv(flat[send_idx[0]:send_idx[0] + send_idx.size]
+                      if send_idx.size else flat[:0],
+                      right, inbox, left, T_ALLGATHER, T_ALLGATHER)
+        if recv_idx.size:
+            flat[recv_idx[0]:recv_idx[0] + recv_idx.size] = inbox
+
+
+def allreduce_rabenseifner(comm, send: np.ndarray, recv: np.ndarray,
+                           op: Op) -> None:
+    """coll_base_allreduce.c:973 — recursive-halving reduce-scatter followed
+    by recursive-doubling allgather; best large-message algorithm on trees."""
+    size, rank = comm.size, comm.rank
+    recv[...] = send
+    if size == 1:
+        return
+    flat = recv.reshape(-1)
+    pof2 = 1 << (size.bit_length() - 1)
+    rem = size - pof2
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.send(flat, rank + 1, T_REDUCE)
+            newrank = -1
+        else:
+            tmp = np.empty_like(flat)
+            comm.recv(tmp, rank - 1, T_REDUCE)
+            flat[...] = op(tmp, flat)
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
+
+    if newrank >= 0:
+        # recursive halving reduce-scatter over pof2 ranks
+        bounds = [0, flat.size]
+
+        def halves(lo, hi):
+            mid = lo + (hi - lo) // 2
+            return (lo, mid), (mid, hi)
+
+        mask = pof2 >> 1
+        lo, hi = 0, flat.size
+        while mask > 0:
+            peer_new = newrank ^ (pof2 // (mask * 2)) if False else newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            (alo, amid), (bmid, bhi) = halves(lo, hi)
+            if newrank & mask:
+                keep_lo, keep_hi = bmid, bhi
+                send_lo, send_hi = alo, amid
+            else:
+                keep_lo, keep_hi = alo, amid
+                send_lo, send_hi = bmid, bhi
+            inbox = np.empty(keep_hi - keep_lo, flat.dtype)
+            comm.sendrecv(flat[send_lo:send_hi], peer, inbox, peer,
+                          T_RSCAT, T_RSCAT)
+            seg = flat[keep_lo:keep_hi]
+            if op.commutative or peer < rank:
+                seg[...] = op(inbox, seg)
+            else:
+                seg[...] = op(seg.copy(), inbox)
+            lo, hi = keep_lo, keep_hi
+            mask >>= 1
+        # recursive doubling allgather, retracing in reverse
+        mask = 1
+        while mask < pof2:
+            peer_new = newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            span = hi - lo
+            if newrank & mask:
+                other_lo, other_hi = lo - span, lo
+            else:
+                other_lo, other_hi = hi, hi + span
+            inbox = np.empty(other_hi - other_lo, flat.dtype)
+            comm.sendrecv(flat[lo:hi], peer, inbox, peer,
+                          T_ALLGATHER, T_ALLGATHER)
+            flat[other_lo:other_hi] = inbox
+            lo, hi = min(lo, other_lo), max(hi, other_hi)
+            mask <<= 1
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.recv(flat, rank + 1, T_BCAST)
+        else:
+            comm.send(flat, rank - 1, T_BCAST)
+
+
+# ---------------------------------------------------------------------------
+# bcast / reduce trees
+# ---------------------------------------------------------------------------
+
+def _binomial_children(rank: int, size: int, root: int):
+    """Binomial tree rooted at root (≙ coll_base_topo.c:331 bmtree)."""
+    vrank = (rank - root) % size
+    children = []
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + root) % size
+            return parent, children
+        child = vrank | mask
+        if child < size:
+            children.append((child + root) % size)
+        mask <<= 1
+    return None, children
+
+
+def bcast_binomial(comm, buf: np.ndarray, root: int) -> None:
+    """coll_base_bcast.c:333."""
+    parent, children = _binomial_children(comm.rank, comm.size, root)
+    if parent is not None:
+        comm.recv(buf, parent, T_BCAST)
+    reqs = [comm.isend(buf, c, T_BCAST) for c in reversed(children)]
+    wait_all(reqs)
+
+
+def bcast_scatter_allgather(comm, buf: np.ndarray, root: int) -> None:
+    """coll_base_bcast.c:774 — binomial scatter then ring allgather;
+    bandwidth-optimal for large messages."""
+    size, rank = comm.size, comm.rank
+    flat = buf.reshape(-1)
+    counts = [len(c) for c in np.array_split(np.arange(flat.size), size)]
+    displs = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(int)
+    vrank = (rank - root) % size
+    # binomial scatter of segments
+    parent, _children = _binomial_children(rank, size, root)
+    mask = 1 << max(0, size.bit_length() - 1)
+    # receive my subtree's span from parent
+    def span(vr, m):
+        lo = displs[vr]
+        hi_rank = min(size - 1, vr + m - 1)
+        hi = displs[hi_rank] + counts[hi_rank]
+        return lo, hi
+    if parent is not None:
+        m = 1
+        while not (vrank & m):
+            m <<= 1
+        lo, hi = span(vrank, m)
+        comm.recv(flat[lo:hi], parent, T_BCAST)
+    m = 1
+    while m < size:
+        if vrank & m:
+            break
+        m <<= 1
+    m >>= 1
+    while m >= 1:
+        vchild = vrank | m
+        if vchild < size:
+            lo, hi = span(vchild, m)
+            comm.send(flat[lo:hi], (vchild + root) % size, T_BCAST)
+        m >>= 1
+    # ring allgather of segments
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        sv = (vrank - step) % size
+        rv = (vrank - step - 1) % size
+        s_lo, s_hi = displs[sv], displs[sv] + counts[sv]
+        r_lo, r_hi = displs[rv], displs[rv] + counts[rv]
+        inbox = np.empty(r_hi - r_lo, flat.dtype)
+        comm.sendrecv(flat[s_lo:s_hi], right, inbox, left,
+                      T_ALLGATHER, T_ALLGATHER)
+        flat[r_lo:r_hi] = inbox
+
+
+def reduce_binomial(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                    op: Op, root: int) -> Optional[np.ndarray]:
+    """coll_base_reduce.c:476 — commutative ops only (callers guard)."""
+    acc = send.copy()
+    rank, size = comm.rank, comm.size
+    vrank = (rank - root) % size
+    tmp = np.empty_like(acc)
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + root) % size
+            comm.send(acc, parent, T_REDUCE)
+            return None
+        vchild = vrank | mask
+        if vchild < size:
+            comm.recv(tmp, (vchild + root) % size, T_REDUCE)
+            acc = op(tmp, acc)
+        mask <<= 1
+    if recv is None:
+        recv = np.empty_like(send)
+    recv[...] = acc
+    return recv
+
+
+# ---------------------------------------------------------------------------
+# allgather / alltoall / reduce_scatter / barrier
+# ---------------------------------------------------------------------------
+
+def allgather_recursive_doubling(comm, send: np.ndarray,
+                                 recv: np.ndarray) -> None:
+    """coll_base_allgather.c:85 — power-of-2 comms."""
+    size, rank = comm.size, comm.rank
+    parts = recv.reshape(size, -1)
+    parts[rank] = send.reshape(-1)
+    mask = 1
+    while mask < size:
+        peer = rank ^ mask
+        block = (rank // mask) * mask
+        peer_block = (peer // mask) * mask
+        outbox = parts[block:block + mask]
+        inbox = np.empty_like(parts[peer_block:peer_block + mask])
+        comm.sendrecv(outbox, peer, inbox, peer, T_ALLGATHER, T_ALLGATHER)
+        parts[peer_block:peer_block + mask] = inbox
+        mask <<= 1
+
+
+def allgather_ring(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_allgather.c:330."""
+    size, rank = comm.size, comm.rank
+    parts = recv.reshape(size, -1)
+    parts[rank] = send.reshape(-1)
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        s = (rank - step) % size
+        r = (rank - step - 1) % size
+        inbox = np.empty_like(parts[r])
+        comm.sendrecv(parts[s], right, inbox, left, T_ALLGATHER, T_ALLGATHER)
+        parts[r] = inbox
+
+
+def allgather_bruck(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_allgather.c:767 (k=2 Bruck): log2(p) rounds, any p."""
+    size, rank = comm.size, comm.rank
+    parts = recv.reshape(size, -1)
+    # local rotation: my block first
+    work = np.empty_like(parts)
+    work[0] = send.reshape(-1)
+    have = 1
+    dist = 1
+    while dist < size:
+        sendn = min(dist, size - have)
+        peer_to = (rank - dist) % size
+        peer_from = (rank + dist) % size
+        blkcount = min(have, size - have)
+        inbox = np.empty((blkcount, parts.shape[1]), parts.dtype)
+        comm.sendrecv(work[:blkcount], peer_to, inbox, peer_from,
+                      T_ALLGATHER, T_ALLGATHER)
+        work[have:have + blkcount] = inbox[:min(blkcount, size - have)]
+        have += blkcount
+        dist <<= 1
+    # un-rotate: work[i] holds block (rank + i) mod size
+    for i in range(size):
+        parts[(rank + i) % size] = work[i]
+
+
+def alltoall_pairwise(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_alltoall.c:180 — p-1 exchange rounds with xor/offset pairing."""
+    size, rank = comm.size, comm.rank
+    sp = send.reshape(size, -1)
+    rp = recv.reshape(size, -1)
+    rp[rank] = sp[rank]
+    for step in range(1, size):
+        sendto = (rank + step) % size
+        recvfrom = (rank - step) % size
+        comm.sendrecv(sp[sendto], sendto, rp[recvfrom], recvfrom,
+                      T_ALLTOALL, T_ALLTOALL)
+
+
+def alltoall_bruck(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_alltoall.c:239 — log2(p) rounds for small messages."""
+    size, rank = comm.size, comm.rank
+    sp = send.reshape(size, -1)
+    # phase 1: local rotation so block i is for rank (rank+i)%size
+    work = np.roll(sp, -rank, axis=0).copy()
+    pof = 1
+    while pof < size:
+        mask_blocks = [i for i in range(size) if i & pof]
+        outbox = work[mask_blocks].copy()
+        inbox = np.empty_like(outbox)
+        comm.sendrecv(outbox, (rank + pof) % size, inbox, (rank - pof) % size,
+                      T_ALLTOALL, T_ALLTOALL)
+        work[mask_blocks] = inbox
+        pof <<= 1
+    # phase 3: inverse rotation + reversal
+    rp = recv.reshape(size, -1)
+    for i in range(size):
+        rp[(rank - i) % size] = work[i]
+
+
+def reduce_scatter_block_recursive_halving(comm, send: np.ndarray,
+                                           recv: np.ndarray, op: Op) -> None:
+    """coll_base_reduce_scatter.c:132 adapted to equal blocks (pof2 only)."""
+    size, rank = comm.size, comm.rank
+    flat = send.reshape(-1).copy()
+    blk = flat.size // size
+    lo, hi = 0, flat.size
+    mask = size >> 1
+    while mask > 0:
+        peer = rank ^ mask
+        mid = lo + (hi - lo) // 2
+        if rank & mask:
+            keep_lo, keep_hi, send_lo, send_hi = mid, hi, lo, mid
+        else:
+            keep_lo, keep_hi, send_lo, send_hi = lo, mid, mid, hi
+        inbox = np.empty(keep_hi - keep_lo, flat.dtype)
+        comm.sendrecv(flat[send_lo:send_hi], peer, inbox, peer,
+                      T_RSCAT, T_RSCAT)
+        seg = flat[keep_lo:keep_hi]
+        if op.commutative or peer < rank:
+            seg[...] = op(inbox, seg)
+        else:
+            seg[...] = op(seg.copy(), inbox)
+        lo, hi = keep_lo, keep_hi
+        mask >>= 1
+    recv.reshape(-1)[:] = flat[rank * blk:(rank + 1) * blk]
+
+
+def barrier_recursive_doubling(comm) -> None:
+    """coll_base_barrier.c:188; bruck (:269) handles non-pof2 the same way
+    here because sendrecv pairs are symmetric per round."""
+    size, rank = comm.size, comm.rank
+    token = np.zeros(0, np.uint8)
+    mask = 1
+    while mask < size:
+        to = (rank + mask) % size
+        frm = (rank - mask) % size
+        comm.sendrecv(token, to, token, frm, T_BARRIER, T_BARRIER)
+        mask <<= 1
+
+
+def scan_recursive_doubling(comm, send: np.ndarray, recv: np.ndarray,
+                            op: Op, exclusive: bool) -> None:
+    """coll_base_scan.c:157 — log2(p) rounds; ok for non-commutative because
+    partner ordering is preserved."""
+    size, rank = comm.size, comm.rank
+    total = send.copy()        # running op over my prefix window
+    have_prefix = False
+    prefix = np.empty_like(send)
+    tmp = np.empty_like(send)
+    mask = 1
+    while mask < size:
+        peer = rank ^ mask if False else None
+        lo_peer = rank - mask
+        hi_peer = rank + mask
+        reqs = []
+        if hi_peer < size:
+            reqs.append(comm.isend(total, hi_peer, T_SCAN))
+        if lo_peer >= 0:
+            comm.recv(tmp, lo_peer, T_SCAN)
+            if have_prefix:
+                prefix[...] = op(tmp, prefix)
+            else:
+                prefix[...] = tmp
+                have_prefix = True
+            total = op(tmp.copy(), total)
+        wait_all(reqs)
+        mask <<= 1
+    if exclusive:
+        if have_prefix:
+            recv[...] = prefix
+    else:
+        recv[...] = op(prefix, send.copy()) if have_prefix else send
+
+
+# ---------------------------------------------------------------------------
+# the tuned module: decision rules + dispatch
+# ---------------------------------------------------------------------------
+
+_var.register("coll", "tuned", "dynamic_rules", "", type=str, level=4,
+              help="Path to a dynamic rules file: lines of "
+                   "'<coll> <min_comm_size> <min_bytes> <algorithm>'.")
+
+for _coll, _algs in {
+    "allreduce": "recursive_doubling|ring|rabenseifner",
+    "bcast": "binomial|scatter_allgather",
+    "allgather": "recursive_doubling|ring|bruck",
+    "alltoall": "pairwise|bruck",
+    "reduce_scatter_block": "recursive_halving",
+}.items():
+    _var.register("coll", "tuned", f"{_coll}_algorithm", "", type=str, level=3,
+                  help=f"Force the {_coll} algorithm ({_algs}; empty = auto).")
+
+
+def _load_dynamic_rules():
+    path = _var.get("coll_tuned_dynamic_rules", "")
+    rules = []
+    if path and os.path.exists(path):
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                coll, min_comm, min_bytes, alg = line.split()
+                rules.append((coll, int(min_comm), int(min_bytes), alg))
+    return rules
+
+
+class TunedModule(CollModule):
+    """Per-communicator tuned module; falls back to BasicModule for entry
+    points without a tuned algorithm (per-function stacking does the same at
+    the framework level; the inner fallback keeps semantics like in-order
+    reduction in one place)."""
+
+    def __init__(self, comm) -> None:
+        self.basic = BasicModule()
+        self._rules = _load_dynamic_rules()
+
+    def _pick(self, coll: str, comm, nbytes: int, default: str) -> str:
+        forced = _var.get(f"coll_tuned_{coll}_algorithm", "")
+        if forced:
+            return forced
+        pick = default
+        for c, mc, mb, alg in self._rules:
+            if c == coll and comm.size >= mc and nbytes >= mb:
+                pick = alg
+        return pick
+
+    # -- allreduce (decision table ≙ coll_tuned_decision_fixed.c:69-104) ----
+
+    def allreduce(self, comm, sendbuf, recvbuf=None, op: Op = None):
+        op = _sum_default(op)
+        send = _inplace(sendbuf, recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(send)
+        if comm.size == 1:
+            recvbuf[...] = send
+            return recvbuf
+        if not op.commutative:
+            return self.basic.allreduce(comm, send, recvbuf, op)
+        nbytes = send.nbytes
+        default = ("recursive_doubling" if nbytes <= 4096 else
+                   ("ring" if nbytes <= (1 << 21) else "rabenseifner"))
+        alg = self._pick("allreduce", comm, nbytes, default)
+        if send.size < comm.size:   # tiny vectors can't be scattered
+            alg = "recursive_doubling"
+        if alg == "ring":
+            allreduce_ring(comm, send, recvbuf, op)
+        elif alg == "rabenseifner":
+            allreduce_rabenseifner(comm, send, recvbuf, op)
+        else:
+            allreduce_recursive_doubling(comm, send, recvbuf, op)
+        return recvbuf
+
+    def bcast(self, comm, buf, root: int = 0):
+        buf = np.asarray(buf)
+        if comm.size == 1:
+            return buf
+        nbytes = buf.nbytes
+        default = "binomial" if nbytes <= (1 << 16) or buf.size < comm.size \
+            else "scatter_allgather"
+        alg = self._pick("bcast", comm, nbytes, default)
+        if alg == "scatter_allgather" and buf.size >= comm.size:
+            bcast_scatter_allgather(comm, buf, root)
+        else:
+            bcast_binomial(comm, buf, root)
+        return buf
+
+    def reduce(self, comm, sendbuf, recvbuf=None, op: Op = None, root: int = 0):
+        op = _sum_default(op)
+        send = _inplace(sendbuf, recvbuf)
+        if comm.size == 1:
+            if recvbuf is None:
+                recvbuf = np.empty_like(send)
+            recvbuf[...] = send
+            return recvbuf
+        if not op.commutative:
+            return self.basic.reduce(comm, send, recvbuf, op, root)
+        return reduce_binomial(comm, send, recvbuf, op, root)
+
+    def allgather(self, comm, sendbuf, recvbuf=None):
+        sendbuf = np.asarray(sendbuf)
+        if recvbuf is None:
+            recvbuf = np.empty((comm.size,) + sendbuf.shape, sendbuf.dtype)
+        if comm.size == 1:
+            recvbuf.reshape(1, -1)[0] = sendbuf.reshape(-1)
+            return recvbuf
+        nbytes = sendbuf.nbytes
+        pof2 = (comm.size & (comm.size - 1)) == 0
+        default = ("recursive_doubling" if pof2 and nbytes <= (1 << 16)
+                   else ("bruck" if nbytes <= 4096 else "ring"))
+        alg = self._pick("allgather", comm, nbytes, default)
+        if alg == "recursive_doubling" and pof2:
+            allgather_recursive_doubling(comm, sendbuf, recvbuf)
+        elif alg == "bruck":
+            allgather_bruck(comm, sendbuf, recvbuf)
+        else:
+            allgather_ring(comm, sendbuf, recvbuf)
+        return recvbuf
+
+    def alltoall(self, comm, sendbuf, recvbuf=None):
+        sendbuf = np.asarray(sendbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(sendbuf)
+        if comm.size == 1:
+            recvbuf[...] = sendbuf
+            return recvbuf
+        nbytes = sendbuf.nbytes // comm.size
+        alg = self._pick("alltoall", comm, nbytes,
+                         "bruck" if nbytes <= 1024 else "pairwise")
+        if alg == "bruck":
+            alltoall_bruck(comm, sendbuf, recvbuf)
+        else:
+            alltoall_pairwise(comm, sendbuf, recvbuf)
+        return recvbuf
+
+    def reduce_scatter_block(self, comm, sendbuf, recvbuf=None, op: Op = None):
+        op = _sum_default(op)
+        sendbuf = np.asarray(sendbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(sendbuf.reshape(comm.size, -1)[0])
+        pof2 = (comm.size & (comm.size - 1)) == 0
+        if comm.size == 1:
+            recvbuf.reshape(-1)[:] = sendbuf.reshape(-1)
+            return recvbuf
+        if not op.commutative or not pof2 or \
+           sendbuf.size % comm.size != 0:
+            return self.basic.reduce_scatter_block(comm, sendbuf, recvbuf, op)
+        reduce_scatter_block_recursive_halving(comm, sendbuf, recvbuf, op)
+        return recvbuf
+
+    def barrier(self, comm):
+        if comm.size > 1:
+            barrier_recursive_doubling(comm)
+
+    def scan(self, comm, sendbuf, recvbuf=None, op: Op = None):
+        op = _sum_default(op)
+        send = _inplace(sendbuf, recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(send)
+        scan_recursive_doubling(comm, send, recvbuf, op, exclusive=False)
+        return recvbuf
+
+    def exscan(self, comm, sendbuf, recvbuf=None, op: Op = None):
+        op = _sum_default(op)
+        send = _inplace(sendbuf, recvbuf)
+        if recvbuf is None:
+            recvbuf = np.empty_like(send)
+        scan_recursive_doubling(comm, send, recvbuf, op, exclusive=True)
+        return recvbuf
+
+
+@component("coll", "tuned", priority=30)
+class TunedColl(Component):
+    name = "tuned"
+
+    def query(self, comm):
+        return self.priority, TunedModule(comm)

@@ -1,0 +1,223 @@
+"""Communicators and groups (≙ ompi/communicator + ompi/group).
+
+A Communicator is a (group, context-id) pair with a per-communicator
+collectives table attached at creation — exactly the reference's model
+(comm → c_coll table, ompi/mca/coll/coll.h:531; selection
+coll_base_comm_select.c:233).
+
+Context-id (CID) allocation: the reference agrees on the next free CID with a
+non-blocking allreduce over the parent (ompi/communicator/comm_cid.c:544
+``ompi_comm_nextcid``). Here the parent's rank 0 performs the agreement: it
+gathers (color, key) from all members, carves the new groups, assigns fresh
+CIDs from the parent's counter, and scatters each member its (cid, members)
+— linear but correct, and contained in one place. Internal traffic uses
+reserved negative tags on the parent CID so it can never match user receives.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .p2p.request import ANY_SOURCE, ANY_TAG, Request
+
+# reserved internal tags (user tags must be ≥ 0)
+TAG_COMM_SPLIT = -10
+TAG_COMM_CID = -11
+TAG_COMM_BCAST = -12
+
+
+class Group:
+    """An ordered set of world ranks (≙ ompi/group)."""
+
+    def __init__(self, world_ranks: Sequence[int]) -> None:
+        self.world_ranks: List[int] = list(world_ranks)
+        self._index = {w: i for i, w in enumerate(self.world_ranks)}
+
+    @property
+    def size(self) -> int:
+        return len(self.world_ranks)
+
+    def rank_of_world(self, world_rank: int) -> int:
+        return self._index.get(world_rank, -1)
+
+    def world_of_rank(self, rank: int) -> int:
+        return self.world_ranks[rank]
+
+    def incl(self, ranks: Sequence[int]) -> "Group":
+        return Group([self.world_ranks[r] for r in ranks])
+
+    def excl(self, ranks: Sequence[int]) -> "Group":
+        drop = set(ranks)
+        return Group([w for i, w in enumerate(self.world_ranks) if i not in drop])
+
+    def union(self, other: "Group") -> "Group":
+        seen = list(self.world_ranks)
+        seen += [w for w in other.world_ranks if w not in self._index]
+        return Group(seen)
+
+    def intersection(self, other: "Group") -> "Group":
+        o = set(other.world_ranks)
+        return Group([w for w in self.world_ranks if w in o])
+
+    def difference(self, other: "Group") -> "Group":
+        o = set(other.world_ranks)
+        return Group([w for w in self.world_ranks if w not in o])
+
+    def translate_ranks(self, ranks: Sequence[int], other: "Group") -> List[int]:
+        return [other.rank_of_world(self.world_ranks[r]) for r in ranks]
+
+
+class Communicator:
+    def __init__(self, ctx, group: Group, cid: int, name: str = "comm") -> None:
+        self.ctx = ctx
+        self.group = group
+        self.cid = cid
+        self.name = name
+        self.rank = group.rank_of_world(ctx.rank)
+        self.size = group.size
+        self._cid_counter = cid * 1024 + 1   # namespace child cids per comm
+        self._lock = threading.Lock()
+        self.coll = None       # per-communicator collectives table (coll/)
+        self.revoked = False
+        self._attach_coll()
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def _world(cls, ctx) -> "Communicator":
+        return cls(ctx, Group(range(ctx.size)), cid=0, name="world")
+
+    def _attach_coll(self) -> None:
+        from .coll.framework import attach_coll
+        attach_coll(self)
+
+    # -- p2p in group-rank space -------------------------------------------
+
+    def _world_dst(self, rank: int) -> int:
+        return self.group.world_of_rank(rank)
+
+    def isend(self, buf, dst: int, tag: int = 0, **kw) -> Request:
+        return self.ctx.p2p.isend(buf, self._world_dst(dst), tag, self.cid, **kw)
+
+    def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, **kw) -> Request:
+        wsrc = src if src == ANY_SOURCE else self._world_dst(src)
+        req = self.ctx.p2p.irecv(buf, wsrc, tag, self.cid, **kw)
+
+        def fix_source(r):
+            if r.status.source >= 0:
+                r.status.source = self.group.rank_of_world(r.status.source)
+        req.add_completion_callback(fix_source)
+        return req
+
+    def send(self, buf, dst: int, tag: int = 0, **kw) -> None:
+        self.isend(buf, dst, tag, **kw).wait()
+
+    def recv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, **kw):
+        return self.irecv(buf, src, tag, **kw).wait()
+
+    def sendrecv(self, sendbuf, dst: int, recvbuf, src: int,
+                 sendtag: int = 0, recvtag: int = ANY_TAG):
+        rreq = self.irecv(recvbuf, src, recvtag)
+        sreq = self.isend(sendbuf, dst, sendtag)
+        st = rreq.wait()
+        sreq.wait()
+        return st
+
+    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG, timeout=None):
+        wsrc = src if src == ANY_SOURCE else self._world_dst(src)
+        st = self.ctx.p2p.probe(wsrc, tag, self.cid, timeout=timeout)
+        if st and st["source"] >= 0:
+            st["source"] = self.group.rank_of_world(st["source"])
+        return st
+
+    def iprobe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG):
+        wsrc = src if src == ANY_SOURCE else self._world_dst(src)
+        st = self.ctx.p2p.iprobe(wsrc, tag, self.cid)
+        if st and st["source"] >= 0:
+            st["source"] = self.group.rank_of_world(st["source"])
+        return st
+
+    # -- management: dup / split / create (≙ ompi/communicator/comm.c) ------
+
+    def dup(self, name: Optional[str] = None) -> "Communicator":
+        return self.split(color=0, key=self.rank,
+                          name=name or f"{self.name}.dup")
+
+    def split(self, color: int, key: int = 0,
+              name: Optional[str] = None) -> Optional["Communicator"]:
+        """MPI_Comm_split. color=None (undefined) → no new communicator."""
+        if getattr(self.ctx, "spc", None) is not None:
+            self.ctx.spc.inc("comm_splits")
+        color_wire = -(1 << 62) if color is None else int(color)
+        mine = np.array([color_wire, int(key), self.ctx.rank], np.int64)
+        if self.rank == 0:
+            rows = [mine]
+            buf = np.zeros(3, np.int64)
+            for r in range(1, self.size):
+                self.ctx.p2p.recv(buf, self._world_dst(r), TAG_COMM_SPLIT, self.cid)
+                rows.append(buf.copy())
+            with self._lock:
+                base_cid = self._cid_counter
+            colors = sorted({int(c) for c, _, _ in rows if c != -(1 << 62)})
+            assignments: List[tuple] = []
+            for idx, c in enumerate(colors):
+                members = [(int(k), int(w)) for cc, k, w in rows if cc == c]
+                members.sort()
+                world_ranks = [w for _, w in members]
+                assignments.append((c, base_cid + idx, world_ranks))
+            with self._lock:
+                self._cid_counter = base_cid + len(colors)
+            # scatter each member its (cid, members); rank 0 handles itself
+            my_assign = None
+            for c, cid, world_ranks in assignments:
+                payload = np.array([cid] + world_ranks, np.int64)
+                for w in world_ranks:
+                    if w == self.ctx.rank:
+                        my_assign = payload
+                    else:
+                        self.ctx.p2p.send(payload, w, TAG_COMM_CID, self.cid)
+            for cc, k, w in rows:   # undefined-color members get an empty reply
+                if cc == -(1 << 62) and w != self.ctx.rank:
+                    self.ctx.p2p.send(np.array([-1], np.int64), int(w),
+                                      TAG_COMM_CID, self.cid)
+            if color is None:
+                return None
+            assert my_assign is not None
+            cid, world_ranks = int(my_assign[0]), [int(x) for x in my_assign[1:]]
+        else:
+            self.ctx.p2p.send(mine, self._world_dst(0), TAG_COMM_SPLIT, self.cid)
+            # variable-length reply: probe for size first
+            st = self.ctx.p2p.probe(self._world_dst(0), TAG_COMM_CID, self.cid,
+                                    timeout=60)
+            if st is None:
+                raise RuntimeError(
+                    f"comm split on {self.name}: no reply from root within 60s "
+                    f"(root slow or failed?)")
+            n = st["count"] // 8
+            buf = np.zeros(n, np.int64)
+            self.ctx.p2p.recv(buf, self._world_dst(0), TAG_COMM_CID, self.cid)
+            if color is None or buf[0] < 0:
+                return None
+            cid, world_ranks = int(buf[0]), [int(x) for x in buf[1:]]
+        return Communicator(self.ctx, Group(world_ranks), cid,
+                            name or f"{self.name}.split")
+
+    def create_from_group(self, group: Group, name: str = "subcomm"
+                          ) -> Optional["Communicator"]:
+        """MPI_Comm_create semantics via split."""
+        in_group = group.rank_of_world(self.ctx.rank) >= 0
+        return self.split(color=0 if in_group else None, key=self.rank,
+                          name=name)
+
+    def barrier(self) -> None:
+        self.coll.barrier(self)
+
+    def free(self) -> None:
+        pass
+
+    def __repr__(self) -> str:
+        return (f"Communicator({self.name}, cid={self.cid}, "
+                f"rank={self.rank}/{self.size})")

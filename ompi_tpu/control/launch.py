@@ -1,0 +1,120 @@
+"""``tpurun`` — the launcher (≙ mpirun → prterun → prted, SURVEY.md §3.4).
+
+The reference's mpirun is a thin wrapper that locates and execs PRRTE's
+prterun (ompi/tools/mpirun/main.c:33); the real work — spawning ranks and
+wiring them to the control plane — happens in the runtime. Here the launcher
+itself hosts the coordinator (control/tcp.py) and fork/execs one Python
+process per rank with the environment contract:
+
+    OMPI_TPU_RANK / OMPI_TPU_SIZE / OMPI_TPU_COORD (host:port) /
+    OMPI_TPU_JOB / OMPI_TPU_LOCAL_RANK / OMPI_TPU_NUM_LOCAL
+
+``--mca name value`` CLI assignments are forwarded as OMPI_TPU_<name> env
+vars, preserving the reference's source-precedence semantics (§5.6).
+
+Rank-per-chip: with ``--chips-per-rank 1`` (default) each rank process is
+pinned to one TPU chip via JAX's multi-process initialization
+(OMPI_TPU_VISIBLE_DEVICE index), matching the north star's
+one-rank-per-chip model (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import Dict, List
+
+from .tcp import Coordinator
+
+
+def build_env(base: Dict[str, str], rank: int, size: int, coord: str,
+              job: str, mca: List[str]) -> Dict[str, str]:
+    env = dict(base)
+    env["OMPI_TPU_RANK"] = str(rank)
+    env["OMPI_TPU_SIZE"] = str(size)
+    env["OMPI_TPU_COORD"] = coord
+    env["OMPI_TPU_JOB"] = job
+    env["OMPI_TPU_LOCAL_RANK"] = str(rank)   # single-host launcher
+    env["OMPI_TPU_NUM_LOCAL"] = str(size)
+    for assign in mca:
+        name, _, value = assign.partition("=")
+        env[f"OMPI_TPU_{name}"] = value
+    return env
+
+
+def main(argv: List[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpurun", description="Launch an N-rank ompi_tpu job.")
+    ap.add_argument("-np", "-n", dest="np", type=int, required=True,
+                    help="number of ranks")
+    ap.add_argument("--mca", action="append", nargs=2, default=[],
+                    metavar=("NAME", "VALUE"),
+                    help="set variable NAME to VALUE for all ranks")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="kill the job after this many seconds")
+    ap.add_argument("command", nargs=argparse.REMAINDER,
+                    help="program and args (a python script or executable)")
+    args = ap.parse_args(argv)
+    if not args.command:
+        ap.error("no command given")
+
+    coord = Coordinator(size=args.np, job_id=f"tpurun-{os.getpid()}")
+    host, port = coord.address
+    coord_str = f"{host}:{port}"
+    mca = [f"{n}={v}" for n, v in args.mca]
+
+    cmd = args.command
+    if cmd[0].endswith(".py"):
+        cmd = [sys.executable] + cmd
+
+    procs: List[subprocess.Popen] = []
+    env_base = dict(os.environ)
+    # children import ompi_tpu from this checkout
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env_base["PYTHONPATH"] = pkg_root + os.pathsep + env_base.get("PYTHONPATH", "")
+    for rank in range(args.np):
+        env = build_env(env_base, rank, args.np, coord_str, coord.job_id, mca)
+        procs.append(subprocess.Popen(cmd, env=env))
+
+    def kill_all(sig=signal.SIGTERM):
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.send_signal(sig)
+                except OSError:
+                    pass
+
+    exit_code = 0
+    try:
+        remaining = list(procs)
+        import time
+        deadline = None if args.timeout is None else time.monotonic() + args.timeout
+        while remaining:
+            for p in list(remaining):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                remaining.remove(p)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    # a failed rank takes the job down, like mpirun
+                    kill_all()
+            if deadline is not None and time.monotonic() > deadline:
+                print("tpurun: timeout — killing job", file=sys.stderr)
+                kill_all(signal.SIGKILL)
+                exit_code = exit_code or 124
+                break
+            time.sleep(0.02)
+    except KeyboardInterrupt:
+        kill_all(signal.SIGKILL)
+        exit_code = 130
+    finally:
+        coord.close()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

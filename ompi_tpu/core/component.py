@@ -54,7 +54,9 @@ class Framework:
     def __init__(self, name: str) -> None:
         self.name = name
         self.components: Dict[str, Component] = {}
-        self.opened = False
+        self._open_lock = threading.Lock()
+        self._opened: set = set()       # per-component open() tracking
+        self._disqualified: set = set()
         self._selection_var = _var.register(
             name, "", "select", default="",
             type=str, level=2,
@@ -82,18 +84,26 @@ class Framework:
         for comp in self.components.values():
             if include is not None and comp.name not in include:
                 continue
-            if comp.name in exclude:
-                continue
-            if not self.opened:
-                try:
-                    if not comp.open():
-                        continue
-                except Exception as exc:  # component self-disqualifies on error
-                    output.verbose(1, self.name,
-                                   f"component {comp.name} failed open(): {exc}")
+            with self._open_lock:   # open() is one-time even under races
+                if comp.name in exclude or comp.name in self._disqualified:
                     continue
+                if comp.name not in self._opened:
+                    try:
+                        ok = comp.open()
+                    except Exception as exc:  # self-disqualifies on error
+                        output.verbose(1, self.name,
+                                       f"component {comp.name} failed "
+                                       f"open(): {exc}")
+                        ok = False
+                    if not ok:
+                        output.verbose(
+                            1, self.name,
+                            f"component {comp.name} declined open(); "
+                            f"disqualified")
+                        self._disqualified.add(comp.name)
+                        continue
+                    self._opened.add(comp.name)
             out.append(comp)
-        self.opened = True
         return out
 
     def select(self, scope: Any = None) -> Tuple[Component, Any]:

@@ -26,7 +26,7 @@ class ProgressEngine:
         self._high: List[Callable[[], int]] = []
         self._low: List[Callable[[], int]] = []
         self._lock = threading.RLock()
-        self._counter = 0
+        self.polls = 0                  # lifetime pass count (SPC + low-pri gate)
 
     def register(self, fn: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:
@@ -43,8 +43,8 @@ class ProgressEngine:
         events = 0
         with self._lock:
             high = list(self._high)
-            self._counter += 1
-            low = list(self._low) if self._counter % _LOW_PRIORITY_INTERVAL == 0 else []
+            self.polls += 1
+            low = list(self._low) if self.polls % _LOW_PRIORITY_INTERVAL == 0 else []
         for fn in high:
             events += fn() or 0
         for fn in low:
@@ -68,8 +68,28 @@ class ProgressEngine:
         return True
 
 
-progress_engine = ProgressEngine()
+_tls = threading.local()
+progress_engine = ProgressEngine()     # initial process-wide default engine
+_process_default = progress_engine
+
+
+def get_engine() -> ProgressEngine:
+    """The calling thread's engine — per-rank in threaded multi-rank jobs
+    (thread-local), the process default otherwise (so worker threads a user
+    spawns after init() poll the context's engine, not an empty one)."""
+    return getattr(_tls, "engine", _process_default)
+
+
+def set_engine(engine: ProgressEngine | None) -> None:
+    _tls.engine = engine if engine is not None else _process_default
+
+
+def set_process_engine(engine: ProgressEngine) -> None:
+    """Make `engine` the fallback for threads with no thread-local binding —
+    called by runtime.init() for the process-level (singleton/tpurun) path."""
+    global _process_default
+    _process_default = engine
 
 
 def progress() -> int:
-    return progress_engine.progress()
+    return get_engine().progress()

@@ -1,0 +1,13 @@
+"""Acceptance workloads (≙ examples/ + benchmark suites in the reference):
+ring (examples/ring_c.c analog, examples/ring.py), the dp×tp×sp transformer
+flagship, and the CG/stencil solver (HPCG-class, BASELINE.json configs[4])."""
+
+from .transformer import (  # noqa: F401
+    Config,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    param_specs,
+    shard_params,
+)

@@ -1,0 +1,193 @@
+"""Flagship workload: a GPT-style decoder trained with dp×tp×sp sharding.
+
+Open MPI itself ships no models — its acceptance workloads are ring_c and
+the OSU/HPCG-class benchmarks (SURVEY.md §4/§6). This framework's flagship
+plays the same role *and* exercises every parallelism strategy the framework
+exists to serve (SURVEY.md §2.6): DP (batch sharding → XLA-inserted gradient
+allreduce), TP (Megatron-style column/row-parallel matmuls → psum on the
+row-parallel projections), SP/CP (ring attention over the `sp` axis —
+parallel/ring.py), all over one named mesh.
+
+Pure-jax pytree params (no framework dependency in the data path), bfloat16
+activations on the MXU, float32 master params/optimizer, GSPMD sharding via
+``NamedSharding`` annotations — the "pick a mesh, annotate, let XLA insert
+collectives" recipe.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.ring import attention_reference, ring_attention
+
+
+@dataclass(frozen=True)
+class Config:
+    vocab: int = 512
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 8
+    head_dim: int = 16
+    d_ff: int = 512
+    seq: int = 128
+    dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-native)
+    attn: str = "dense"              # "dense" | "ring"
+    rope_base: float = 10000.0
+
+
+# -- init -------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: Config) -> Dict:
+    def dense(key, fan_in, shape):
+        return (jax.random.normal(key, shape, jnp.float32)
+                / np.sqrt(fan_in))
+
+    keys = jax.random.split(rng, 2 + cfg.n_layers)
+    params: Dict[str, Any] = {
+        "embed": dense(keys[0], cfg.d_model, (cfg.vocab, cfg.d_model)),
+        "final_norm": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    h = cfg.n_heads * cfg.head_dim
+    for i in range(cfg.n_layers):
+        k = jax.random.split(keys[2 + i], 6)
+        params["layers"].append({
+            "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "wqkv": dense(k[0], cfg.d_model, (cfg.d_model, 3 * h)),
+            "wo": dense(k[1], h, (h, cfg.d_model)),
+            "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
+            "w_gate": dense(k[2], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+            "w_up": dense(k[3], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+            "w_down": dense(k[4], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
+        })
+    return params
+
+
+def param_specs(cfg: Config) -> Dict:
+    """Megatron-style TP layout: qkv/gate/up column-parallel (shard the
+    output features over `tp`), wo/down row-parallel (shard the input
+    features; XLA inserts the psum). Embedding sharded over vocab."""
+    layer = {
+        "attn_norm": P(),
+        "wqkv": P(None, "tp"),
+        "wo": P("tp", None),
+        "mlp_norm": P(),
+        "w_gate": P(None, "tp"),
+        "w_up": P(None, "tp"),
+        "w_down": P("tp", None),
+    }
+    return {
+        "embed": P("tp", None),
+        "final_norm": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def shard_params(params: Dict, mesh: Mesh, cfg: Config) -> Dict:
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+# -- model ------------------------------------------------------------------
+
+def _rms_norm(x, w):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * lax.rsqrt(var + 1e-6)).astype(x.dtype) * w.astype(x.dtype)
+
+
+def _rope(x, positions, base):
+    # x: (b, s, h, d) — rotate pairs
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (s, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    rot1 = x1 * cos[None, :, None, :] - x2 * sin[None, :, None, :]
+    rot2 = x2 * cos[None, :, None, :] + x1 * sin[None, :, None, :]
+    return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: Config,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab)."""
+    b, s = tokens.shape
+    x = params["embed"].astype(cfg.dtype)[tokens]      # (b, s, d)
+    positions = jnp.arange(s)
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["attn_norm"])
+        qkv = h @ layer["wqkv"].astype(cfg.dtype)      # (b, s, 3*heads*hd)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+        q = _rope(q, positions, cfg.rope_base)
+        k = _rope(k, positions, cfg.rope_base)
+        if cfg.attn == "ring" and mesh is not None and "sp" in mesh.axis_names:
+            att = ring_attention(q, k, v, mesh, "sp", causal=True,
+                                 batch_axis="dp" if "dp" in mesh.axis_names
+                                 else None,
+                                 head_axis="tp" if "tp" in mesh.axis_names
+                                 else None)
+        else:
+            att = attention_reference(q, k, v, causal=True)
+        att = att.reshape(b, s, cfg.n_heads * cfg.head_dim)
+        x = x + att @ layer["wo"].astype(cfg.dtype)    # row-parallel → psum
+        h = _rms_norm(x, layer["mlp_norm"])
+        gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
+        up = h @ layer["w_up"].astype(cfg.dtype)
+        x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
+    x = _rms_norm(x, params["final_norm"])
+    logits = x @ params["embed"].astype(cfg.dtype).T   # tied embedding
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: Dict, tokens: jax.Array, cfg: Config,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+# -- training ---------------------------------------------------------------
+
+def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
+                    learning_rate: float = 1e-3):
+    """Returns (init_opt_state, step). step is jit-compiled; with a mesh the
+    data batch is dp-sharded and gradients allreduce over dp automatically."""
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    def init_opt(params):
+        return tx.init(params)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    if mesh is not None:
+        # batch dp-sharded; seq dim left unsharded here (tokens carry seq+1
+        # for the shifted targets — GSPMD reshards activations onto sp at
+        # the ring-attention boundary)
+        data_spec = P("dp" if "dp" in mesh.axis_names else None, None)
+        step = jax.jit(step, in_shardings=(None, None,
+                                           NamedSharding(mesh, data_spec)),
+                       donate_argnums=(0, 1))
+    else:
+        step = jax.jit(step, donate_argnums=(0, 1))
+    return init_opt, step

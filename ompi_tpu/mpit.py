@@ -1,0 +1,69 @@
+"""Tool-introspection interface (≙ MPI_T, ompi/mpi/tool/).
+
+cvars  — control variables: the var registry (core/var.py), with name/level/
+         scope/source, readable and (scope permitting) writable at runtime;
+pvars  — performance variables: the SPC counters (spc.py) of a Context;
+categories — frameworks with their components and variables.
+
+The tpu_info tool (tools/tpu_info.py) and tests are the consumers; external
+tools get the same dicts via these functions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .core import var as _var
+from .core.component import frameworks
+from .spc import COUNTERS
+
+
+def cvar_get_num(max_level: int = 9) -> int:
+    return len(_var.registry.all_vars(max_level))
+
+
+def cvar_get_info(index_or_name) -> Dict[str, Any]:
+    if isinstance(index_or_name, int):
+        v = _var.registry.all_vars()[index_or_name]
+    else:
+        v = _var.registry.lookup(index_or_name)
+        if v is None:
+            raise KeyError(index_or_name)
+    return {
+        "name": v.name, "value": v.value, "default": v.default,
+        "type": v.type.__name__, "level": v.level,
+        "scope": v.scope.value, "source": v.source.name, "help": v.help,
+    }
+
+
+def cvar_write(name: str, value) -> None:
+    _var.registry.set_override(name, value)
+
+
+def pvar_get_num() -> int:
+    return len(COUNTERS)
+
+
+def pvar_get_info(index: int) -> Dict[str, str]:
+    name, help_ = COUNTERS[index]
+    return {"name": name, "help": help_}
+
+
+def pvar_read(ctx, name: str) -> float:
+    return ctx.spc.get(name)
+
+
+def pvar_read_all(ctx) -> Dict[str, float]:
+    return ctx.spc.snapshot()
+
+
+def category_get_all() -> List[Dict[str, Any]]:
+    out = []
+    for fw in frameworks.all_frameworks():
+        out.append({
+            "framework": fw.name,
+            "components": sorted(fw.components.keys()),
+            "vars": [v.name for v in _var.registry.all_vars()
+                     if v.name.startswith(fw.name + "_")],
+        })
+    return out

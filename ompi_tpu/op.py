@@ -1,0 +1,84 @@
+"""Reduction operators (≙ ompi/op + ompi/mca/op).
+
+The reference dispatches ``ompi_op_reduce`` through a per-(op, dtype) function
+table (ompi/op/op.h:503) with SIMD kernels in the op/avx component
+(ompi/mca/op/avx/op_avx_component.c:45-47). Here the host path uses numpy's
+vectorized kernels (which use SIMD), and the device path never leaves XLA:
+the coll/xla component lowers the same Op to the matching ``lax`` combinator
+(SUM→psum etc.), so reductions on HBM-resident data run on the TPU's VPU/MXU
+rather than being staged to the host (the coll/accelerator shim this design
+replaces — SURVEY.md §3.2).
+
+User-defined ops (MPI_Op_create) take fn(invec, inoutvec) → outvec and a
+commutativity flag, which algorithm selection honors (non-commutative ops
+must use in-order algorithms, e.g. in-order binary reduce —
+coll_base_reduce.c:514).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Op:
+    name: str
+    fn: Callable[[np.ndarray, np.ndarray], np.ndarray]   # (in, inout) → result
+    commutative: bool = True
+    jax_name: Optional[str] = None   # lax reduction this lowers to on device
+
+    def __call__(self, invec: np.ndarray, inoutvec: np.ndarray) -> np.ndarray:
+        return self.fn(invec, inoutvec)
+
+    @staticmethod
+    def create(fn: Callable[[np.ndarray, np.ndarray], np.ndarray],
+               commutative: bool = True, name: str = "user") -> "Op":
+        return Op(name, fn, commutative)
+
+
+def _logical(npfn):
+    return lambda a, b: npfn(a.astype(bool), b.astype(bool)).astype(a.dtype)
+
+
+SUM = Op("sum", lambda a, b: b + a, jax_name="add")
+PROD = Op("prod", lambda a, b: b * a, jax_name="mul")
+MAX = Op("max", lambda a, b: np.maximum(a, b), jax_name="max")
+MIN = Op("min", lambda a, b: np.minimum(a, b), jax_name="min")
+LAND = Op("land", _logical(np.logical_and), jax_name="and")
+LOR = Op("lor", _logical(np.logical_or), jax_name="or")
+LXOR = Op("lxor", _logical(np.logical_xor))
+BAND = Op("band", lambda a, b: np.bitwise_and(a, b), jax_name="and")
+BOR = Op("bor", lambda a, b: np.bitwise_or(a, b), jax_name="or")
+BXOR = Op("bxor", lambda a, b: np.bitwise_xor(a, b), jax_name="xor")
+REPLACE = Op("replace", lambda a, b: a)        # MPI_REPLACE (for one-sided)
+NO_OP = Op("no_op", lambda a, b: b)            # MPI_NO_OP  (for one-sided)
+
+
+def _maxloc(a, b):
+    # value/index pairs as structured arrays with fields 'v' and 'i'
+    take_a = (a["v"] > b["v"]) | ((a["v"] == b["v"]) & (a["i"] < b["i"]))
+    return np.where(take_a, a, b)
+
+
+def _minloc(a, b):
+    take_a = (a["v"] < b["v"]) | ((a["v"] == b["v"]) & (a["i"] < b["i"]))
+    return np.where(take_a, a, b)
+
+
+MAXLOC = Op("maxloc", _maxloc)
+MINLOC = Op("minloc", _minloc)
+
+
+def loc_dtype(value_dtype) -> np.dtype:
+    """Structured dtype for MAXLOC/MINLOC pairs (≙ MPI_DOUBLE_INT etc.)."""
+    return np.dtype([("v", np.dtype(value_dtype)), ("i", np.int64)])
+
+
+def reduce_local(op: Op, invec: np.ndarray, inoutvec: np.ndarray) -> None:
+    """In-place inoutvec = op(invec, inoutvec) (≙ MPI_Reduce_local,
+    ompi/op/op.h ompi_op_reduce)."""
+    result = op(invec, inoutvec)
+    np.copyto(inoutvec, result)

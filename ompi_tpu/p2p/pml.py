@@ -1,0 +1,254 @@
+"""Point-to-point protocol engine (≙ pml/ob1, ompi/mca/pml/ob1/).
+
+Implements MPI send/recv semantics over the byte transports:
+  * eager protocol for payloads ≤ the transport's eager_limit — one MATCH
+    frame, sender completes locally (pml_ob1_isend.c:249,297 send_inline
+    fast path);
+  * rendezvous for large payloads — RNDV header, receiver matches and ACKs,
+    sender streams FRAGs of max_send_size (wire protocol kinds mirror
+    pml_ob1_hdr.h:43-52 MATCH/RNDV/ACK/FRAG);
+  * matching with wildcards + per-channel sequence numbers (matching.py);
+  * ``sync=True`` forces rendezvous regardless of size — MPI_Ssend semantics
+    (completion implies the receive was matched).
+
+Payloads are packed/unpacked through the datatype convertor; contiguous
+numpy buffers take the single-copy fast path. Device (jax) arrays are staged
+via numpy here — the ICI path for device data is the coll/xla component, not
+host p2p (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import defaultdict
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core import var as _var
+from ..core.output import show_help
+from ..core.progress import ProgressEngine
+from ..datatype import Convertor, Datatype, from_numpy
+from . import transport as T
+from .matching import MatchingEngine, Unexpected
+from .request import ANY_SOURCE, ANY_TAG, Request
+
+
+class TruncateError(RuntimeError):
+    pass
+
+
+def _buffer_args(buf, datatype: Optional[Datatype], count: Optional[int]
+                 ) -> Tuple[np.ndarray, Datatype, int]:
+    arr = np.asarray(buf)
+    if datatype is None:
+        datatype = from_numpy(arr.dtype)
+        if count is None:
+            count = arr.size
+    elif count is None:
+        count = (arr.nbytes // datatype.size) if datatype.size else 0
+    return arr, datatype, count
+
+
+class _SendState:
+    __slots__ = ("req", "data", "dst", "offset")
+
+    def __init__(self, req: Request, data: bytes, dst: int) -> None:
+        self.req = req
+        self.data = data
+        self.dst = dst
+        self.offset = 0
+
+
+class _RecvState:
+    __slots__ = ("req", "conv", "received", "total")
+
+    def __init__(self, req: Request, conv: Convertor, total: int) -> None:
+        self.req = req
+        self.conv = conv
+        self.received = 0
+        self.total = total
+
+
+class P2P:
+    """One instance per rank process."""
+
+    def __init__(self, bootstrap, layer: T.TransportLayer,
+                 engine: ProgressEngine, spc=None) -> None:
+        from ..spc import Counters
+
+        self.bootstrap = bootstrap
+        self.rank = bootstrap.rank
+        self.layer = layer
+        self.engine = engine
+        self.spc = spc if spc is not None else Counters()
+        self.matching = MatchingEngine()
+        self.matching.spc = self.spc
+        self._send_seq: Dict[Tuple[int, int], int] = defaultdict(int)
+        self._sreq = itertools.count(1)
+        self._rreq = itertools.count(1)
+        self._pending_send: Dict[int, _SendState] = {}
+        self._pending_recv: Dict[int, _RecvState] = {}
+        for t in layer.transports:
+            t.dispatch[T.AM_P2P] = self._am_handler
+            engine.register(t.progress)
+
+    # -- send ---------------------------------------------------------------
+
+    def isend(self, buf, dst: int, tag: int = 0, cid: int = 0,
+              datatype: Optional[Datatype] = None, count: Optional[int] = None,
+              sync: bool = False) -> Request:
+        arr, dt, cnt = _buffer_args(buf, datatype, count)
+        data = Convertor(arr, dt, cnt).pack() if cnt else b""
+        req = Request()
+        req.status.source = self.rank
+        req.status.tag = tag
+        req.status.count = len(data)
+        seq = self._send_seq[(cid, dst)]
+        self._send_seq[(cid, dst)] = seq + 1
+        transport = self.layer.for_peer(dst)
+        self.spc.inc("isends")
+        self.spc.inc("bytes_sent", len(data))
+        self.spc.peer_traffic("tx", dst, len(data))
+        if not sync and len(data) <= transport.eager_limit:
+            self.spc.inc("eager_sends")
+            hdr = {"k": "match", "cid": cid, "tag": tag, "seq": seq,
+                   "size": len(data)}
+            transport.send(dst, T.AM_P2P, hdr, data)
+            req.complete()   # eager: locally complete once buffered
+            return req
+        self.spc.inc("rndv_sends")
+        sreq = next(self._sreq)
+        self._pending_send[sreq] = _SendState(req, data, dst)
+        hdr = {"k": "rndv", "cid": cid, "tag": tag, "seq": seq,
+               "size": len(data), "sreq": sreq}
+        transport.send(dst, T.AM_P2P, hdr, b"")
+        return req
+
+    def send(self, buf, dst: int, tag: int = 0, cid: int = 0,
+             datatype: Optional[Datatype] = None, count: Optional[int] = None,
+             sync: bool = False) -> None:
+        self.isend(buf, dst, tag, cid, datatype, count, sync).wait()
+
+    # -- recv ---------------------------------------------------------------
+
+    def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
+              cid: int = 0, datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> Request:
+        arr, dt, cnt = _buffer_args(buf, datatype, count)
+        req = Request()
+        self.spc.inc("recvs")
+
+        def on_match(u: Unexpected) -> None:
+            self.spc.inc("bytes_recvd", u.header["size"])
+            self.spc.peer_traffic("rx", u.src, u.header["size"])
+            capacity = dt.size * cnt
+            req.status.source = u.src
+            req.status.tag = u.tag
+            if u.header["size"] > capacity:
+                show_help.show("truncate", capacity, u.header["size"],
+                               u.tag, u.src)
+                if u.kind == "rndv":
+                    # NACK (rreq < 0) so the sender's request still completes
+                    # — truncation is a receiver-side error in MPI
+                    self.layer.send(u.src, T.AM_P2P,
+                                    {"k": "ack", "sreq": u.header["sreq"],
+                                     "rreq": -1}, b"")
+                req.complete(TruncateError(
+                    f"recv buffer {capacity}B < message {u.header['size']}B"))
+                return
+            if u.kind == "match":
+                if u.payload:
+                    Convertor(arr, dt, cnt).unpack(u.payload)
+                req.status.count = len(u.payload)
+                req.complete()
+            else:  # rendezvous: ACK with a recv-request id, collect FRAGs
+                rreq = next(self._rreq)
+                conv = Convertor(arr, dt, cnt)
+                self._pending_recv[rreq] = _RecvState(req, conv, u.header["size"])
+                req.status.count = u.header["size"]
+                if u.header["size"] == 0:
+                    del self._pending_recv[rreq]
+                    req.complete()
+                    # still ACK so the sender's request completes
+                self.layer.send(u.src, T.AM_P2P,
+                                {"k": "ack", "sreq": u.header["sreq"],
+                                 "rreq": rreq}, b"")
+
+        if self.matching.post_recv(cid, src, tag, on_match) is None:
+            self.spc.inc("matches_unexpected")
+        return req
+
+    def recv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, cid: int = 0,
+             datatype: Optional[Datatype] = None, count: Optional[int] = None):
+        return self.irecv(buf, src, tag, cid, datatype, count).wait()
+
+    def sendrecv(self, sendbuf, dst: int, recvbuf, src: int,
+                 sendtag: int = 0, recvtag: int = ANY_TAG, cid: int = 0):
+        rreq = self.irecv(recvbuf, src, recvtag, cid)
+        sreq = self.isend(sendbuf, dst, sendtag, cid)
+        status = rreq.wait()
+        sreq.wait()
+        return status
+
+    # -- probe --------------------------------------------------------------
+
+    def iprobe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG, cid: int = 0):
+        self.spc.inc("probes")
+        self.engine.progress()
+        u = self.matching.probe(cid, src, tag)
+        if u is None:
+            return None
+        st = {"source": u.src, "tag": u.tag, "count": u.header["size"]}
+        return st
+
+    def probe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG, cid: int = 0,
+              timeout: Optional[float] = None):
+        result = {}
+
+        def check() -> bool:
+            r = self.iprobe(src, tag, cid)
+            if r:
+                result.update(r)
+                return True
+            return False
+
+        self.engine.wait_until(check, timeout=timeout)
+        return result or None
+
+    # -- active-message handler (≙ recv_frag callbacks) ---------------------
+
+    def _am_handler(self, src: int, header: Dict[str, Any], payload: bytes) -> None:
+        k = header["k"]
+        if k in ("match", "rndv"):
+            self.matching.arrived(header["cid"], src, header["tag"],
+                                  header["seq"], k, header, payload)
+        elif k == "ack":
+            state = self._pending_send.pop(header["sreq"])
+            if header["rreq"] < 0:   # receiver matched but discarded (truncate)
+                state.req.complete()
+            else:
+                self._stream_frags(src, header["rreq"], state)
+        elif k == "frag":
+            state = self._pending_recv[header["rreq"]]
+            state.conv.set_position(header["off"])
+            state.conv.unpack(payload)
+            state.received += len(payload)
+            if state.received >= state.total:
+                del self._pending_recv[header["rreq"]]
+                state.req.complete()
+        else:
+            raise RuntimeError(f"unknown p2p frame kind {k!r}")
+
+    def _stream_frags(self, dst: int, rreq: int, state: _SendState) -> None:
+        transport = self.layer.for_peer(dst)
+        chunk = transport.max_send_size
+        data = state.data
+        if not data:
+            state.req.complete()
+            return
+        for off in range(0, len(data), chunk):
+            transport.send(dst, T.AM_P2P,
+                           {"k": "frag", "rreq": rreq, "off": off},
+                           data[off:off + chunk])
+        state.req.complete()   # sender side done once handed to transport

@@ -1,0 +1,91 @@
+"""Completion objects (≙ ompi/request/request.h:129 + wait/test engines)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from ..core.progress import get_engine
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+class Status:
+    __slots__ = ("source", "tag", "count", "error", "cancelled")
+
+    def __init__(self) -> None:
+        self.source = ANY_SOURCE
+        self.tag = ANY_TAG
+        self.count = 0
+        self.error = 0
+        self.cancelled = False
+
+
+class Request:
+    """A pending communication. Completion is driven by the progress engine."""
+
+    __slots__ = ("done", "status", "error", "_on_complete", "_ctx")
+
+    def __init__(self) -> None:
+        self.done = False
+        self.status = Status()
+        self.error: Optional[Exception] = None
+        self._on_complete: List[Callable[["Request"], None]] = []
+        self._ctx: Any = None
+
+    def add_completion_callback(self, cb: Callable[["Request"], None]) -> None:
+        if self.done:
+            cb(self)
+        else:
+            self._on_complete.append(cb)
+
+    def complete(self, error: Optional[Exception] = None) -> None:
+        if self.done:
+            return
+        self.error = error
+        self.done = True
+        for cb in self._on_complete:
+            cb(self)
+        self._on_complete.clear()
+
+    def test(self) -> bool:
+        if not self.done:
+            get_engine().progress()
+        return self.done
+
+    def wait(self, timeout: Optional[float] = None) -> Status:
+        get_engine().wait_until(lambda: self.done, timeout=timeout)
+        if not self.done:
+            raise TimeoutError("request did not complete")
+        if self.error is not None:
+            raise self.error
+        return self.status
+
+
+class CompletedRequest(Request):
+    def __init__(self, count: int = 0) -> None:
+        super().__init__()
+        self.done = True
+        self.status.count = count
+
+
+def wait_all(requests: List[Request], timeout: Optional[float] = None) -> List[Status]:
+    get_engine().wait_until(lambda: all(r.done for r in requests), timeout=timeout)
+    out = []
+    for r in requests:
+        if not r.done:
+            raise TimeoutError("waitall: request did not complete")
+        if r.error is not None:
+            raise r.error
+        out.append(r.status)
+    return out
+
+
+def wait_any(requests: List[Request], timeout: Optional[float] = None) -> int:
+    get_engine().wait_until(lambda: any(r.done for r in requests), timeout=timeout)
+    for i, r in enumerate(requests):
+        if r.done:
+            if r.error is not None:
+                raise r.error
+            return i
+    raise TimeoutError("waitany: no request completed")

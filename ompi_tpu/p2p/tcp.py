@@ -1,0 +1,231 @@
+"""TCP transport (≙ btl/tcp, opal/mca/btl/tcp/btl_tcp_component.c:1253).
+
+Event-driven non-blocking sockets pumped from the progress engine. Design
+points kept from the reference:
+  * listen address published through the modex at init, lazy connect on first
+    send (the reference creates endpoints connection-less at add_procs and
+    connects on demand);
+  * all I/O is non-blocking: sends append to a per-connection out-queue and
+    drain when the socket is writable — two ranks blasting large fragments at
+    each other can never deadlock in sendall;
+  * per-direction ordering: the initiating side of a connection is the only
+    sender on it (simplex pairs), so frames to a given peer arrive in send
+    order — which the matching layer's non-overtaking guarantee rides on.
+
+On TPU pods this is the DCN data plane for host-side traffic; device payloads
+ride ICI via the coll/xla component instead (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import pickle
+import selectors
+import socket
+import struct
+from collections import deque
+from typing import Any, Dict, Optional
+
+from ..core.component import component
+from ..core.output import output
+from . import transport as T
+
+_HDR = struct.Struct("!I")
+
+
+def _advertised_host() -> str:
+    """The address peers should dial: loopback for single-host jobs, the
+    interface routing toward the coordinator for multi-host (DCN) jobs."""
+    import os
+
+    coord = os.environ.get("OMPI_TPU_COORD", "")
+    host = coord.rpartition(":")[0]
+    if not host or host.startswith("127.") or host == "localhost":
+        return "127.0.0.1"
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        probe.connect((host, 1))
+        return probe.getsockname()[0]
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+    finally:
+        probe.close()
+
+
+class _Conn:
+    def __init__(self, sock: socket.socket) -> None:
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self.sock = sock
+        self.inbuf = bytearray()
+        self.outbuf = deque()      # of memoryview
+        self.out_bytes = 0
+        self.peer: Optional[int] = None   # known for rx conns after HELLO
+
+
+@component("transport", "tcp", priority=10)
+class TcpTransport(T.Transport):
+    name = "tcp"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.rank = -1
+        self.size = 0
+        self._bootstrap = None
+        self._sel = selectors.DefaultSelector()
+        self._listener: Optional[socket.socket] = None
+        self._tx: Dict[int, _Conn] = {}      # peer → conn I initiated
+        self._rx: list[_Conn] = []           # conns initiated by peers
+        self._addrs: Dict[int, tuple] = {}
+        self.failed_peers: set = set()       # peers with dropped traffic (FT hook)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def init_job(self, bootstrap) -> None:
+        self.rank, self.size = bootstrap.rank, bootstrap.size
+        self._bootstrap = bootstrap
+        self._listener = socket.create_server(("0.0.0.0", 0))
+        self._listener.setblocking(False)
+        self._sel.register(self._listener, selectors.EVENT_READ, ("accept", None))
+        bootstrap.put("transport_tcp_addr",
+                      (_advertised_host(), self._listener.getsockname()[1]))
+
+    def reachable(self, peer: int) -> bool:
+        return 0 <= peer < self.size
+
+    def _addr_of(self, peer: int) -> tuple:
+        addr = self._addrs.get(peer)
+        if addr is None:
+            addr = tuple(self._bootstrap.get(peer, "transport_tcp_addr"))
+            self._addrs[peer] = addr
+        return addr
+
+    def _tx_conn(self, peer: int) -> _Conn:
+        conn = self._tx.get(peer)
+        if conn is None:
+            sock = socket.create_connection(self._addr_of(peer))
+            conn = _Conn(sock)
+            conn.peer = peer
+            self._tx[peer] = conn
+            self._sel.register(sock, selectors.EVENT_READ, ("tx", conn))
+            self._enqueue(conn, ("HELLO", self.rank, {}, b""))
+        return conn
+
+    # -- tx -----------------------------------------------------------------
+
+    def _enqueue(self, conn: _Conn, frame_obj: Any) -> None:
+        data = pickle.dumps(frame_obj, protocol=pickle.HIGHEST_PROTOCOL)
+        conn.outbuf.append(memoryview(_HDR.pack(len(data)) + data))
+        conn.out_bytes += len(data) + _HDR.size
+        self._flush(conn)
+
+    def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes) -> None:
+        self._enqueue(self._tx_conn(peer), (tag, self.rank, header, payload))
+
+    def _flush(self, conn: _Conn) -> int:
+        sent = 0
+        while conn.outbuf:
+            mv = conn.outbuf[0]
+            try:
+                n = conn.sock.send(mv)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError as exc:
+                output.error("transport",
+                             f"tcp send to rank {conn.peer} failed, dropping "
+                             f"{conn.out_bytes} queued bytes: {exc}")
+                conn.outbuf.clear()
+                conn.out_bytes = 0
+                self.failed_peers.add(conn.peer)
+                return sent
+            sent += n
+            conn.out_bytes -= n
+            if n == len(mv):
+                conn.outbuf.popleft()
+            else:
+                conn.outbuf[0] = mv[n:]
+        return sent
+
+    # -- rx / progress ------------------------------------------------------
+
+    def progress(self) -> int:
+        events = 0
+        for key, _mask in self._sel.select(timeout=0):
+            kind, conn = key.data
+            if kind == "accept":
+                try:
+                    sock, _ = self._listener.accept()
+                except OSError:
+                    continue
+                c = _Conn(sock)
+                self._rx.append(c)
+                self._sel.register(sock, selectors.EVENT_READ, ("rx", c))
+                continue
+            events += self._drain(conn)
+        # drain pending sends even when sockets never became readable
+        for conn in self._tx.values():
+            if conn.outbuf:
+                self._flush(conn)
+        return events
+
+    def _drain(self, conn: _Conn) -> int:
+        try:
+            while True:
+                chunk = conn.sock.recv(1 << 18)
+                if not chunk:
+                    self._close(conn)
+                    return 0
+                conn.inbuf.extend(chunk)
+        except (BlockingIOError, InterruptedError):
+            pass
+        except OSError:
+            self._close(conn)
+            return 0
+        delivered = 0
+        buf = conn.inbuf
+        while len(buf) >= _HDR.size:
+            (n,) = _HDR.unpack_from(buf)
+            if len(buf) < _HDR.size + n:
+                break
+            frame = pickle.loads(bytes(buf[_HDR.size:_HDR.size + n]))
+            del buf[:_HDR.size + n]
+            tag, src, header, payload = frame
+            if tag == "HELLO":
+                conn.peer = src
+            else:
+                self.deliver(src, tag, header, payload)
+                delivered += 1
+        return delivered
+
+    def _close(self, conn: _Conn) -> None:
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn in self._rx:
+            self._rx.remove(conn)
+        for peer, c in list(self._tx.items()):
+            if c is conn:
+                del self._tx[peer]
+
+    def finalize(self) -> None:
+        for conn in list(self._tx.values()) + list(self._rx):
+            if conn.sock.fileno() < 0:
+                continue
+            # best-effort flush of queued frames before teardown
+            conn.sock.setblocking(True)
+            try:
+                while conn.outbuf:
+                    self._flush(conn)
+            except OSError:
+                pass
+            self._close(conn)
+        if self._listener is not None:
+            try:
+                self._sel.unregister(self._listener)
+            except (KeyError, ValueError):
+                pass
+            self._listener.close()

@@ -1,0 +1,52 @@
+"""TPU-first parallelism layer: device meshes, named-axis collectives,
+DeviceComm, sequence/context parallelism, hierarchical collectives.
+
+This package is the re-imagined face of the reference's parallelism-backing
+machinery (SURVEY.md §2.6): DP/TP rides allreduce/reduce-scatter/allgather,
+SP/CP rides ppermute rings and all_to_all (Ulysses), hierarchical rides the
+ICI/DCN axis split (≙ coll/han)."""
+
+from .mesh import (  # noqa: F401
+    STANDARD_AXES,
+    classify_axes,
+    make_mesh,
+    replicated,
+    shard_leading,
+    sharded,
+)
+from .ring import attention_reference, ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .hierarchy import (  # noqa: F401
+    auto_levels,
+    hierarchical_allreduce,
+    hierarchical_psum,
+)
+from .collectives import (  # noqa: F401
+    DeviceComm,
+    all_gather_axis,
+    all_to_all_axis,
+    pbcast,
+    pmax,
+    pmin,
+    ppermute,
+    preduce,
+    psum,
+    reduce_scatter_axis,
+    ring_shift,
+)
+
+
+def attach_mesh(comm, mesh, axis: str) -> None:
+    """Give a communicator a device mesh, enabling the coll/xla component
+    (re-runs coll selection so xla outranks the host components)."""
+    if mesh.shape[axis] not in (comm.size, None) and comm.size != 1:
+        if mesh.shape[axis] != comm.size:
+            raise ValueError(
+                f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
+                f"comm {comm.name} has {comm.size} ranks")
+    comm.device_mesh = mesh
+    comm.device_axis = axis
+    comm.device_comm = DeviceComm(mesh, axis)
+    from ..coll.framework import attach_coll
+
+    attach_coll(comm)

@@ -1,0 +1,339 @@
+"""Device collectives: named-axis primitives + the DeviceComm engine.
+
+This is the heart of the TPU-native design (BASELINE.json north_star): where
+the reference's coll components drive host loops over p2p (§3.2) and its
+coll/accelerator component stages HBM→host before reducing
+(coll_accelerator_allreduce.c:31-60), here collectives on device-resident
+data are XLA collective *programs* executed over ICI — ``lax.psum`` /
+``all_gather`` / ``psum_scatter`` / ``all_to_all`` / ``ppermute`` inside
+``shard_map`` — with an executable cache playing the role ob1's protocol
+state machine plays on the host path ("the analog ... in compilation space",
+SURVEY.md §7 hard parts).
+
+Two API levels:
+  * free functions (``psum``, ``all_gather_axis``, ...) usable inside any
+    user shard_map/jit — the idiomatic JAX face;
+  * ``DeviceComm`` — MPI-shaped collectives over one mesh axis on standalone
+    arrays, caching one compiled executable per (collective, op, shape,
+    dtype) bucket, for OSU-style benchmarking and the coll/xla component.
+
+Layout convention for DeviceComm: an "MPI buffer per rank" is row i of an
+array of shape (n, *elem) sharded on dim 0 over the comm axis; results keep
+that layout (every row holds that rank's result), so chained collectives
+stay on device with no resharding.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..op import MAX, MIN, SUM, Op
+
+# ---------------------------------------------------------------------------
+# named-axis primitives (for use inside shard_map) — thin, explicit wrappers
+# ---------------------------------------------------------------------------
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def pmax(x, axis: str):
+    return lax.pmax(x, axis)
+
+
+def pmin(x, axis: str):
+    return lax.pmin(x, axis)
+
+
+def preduce(x, axis: str, op: Op):
+    """Reduce over a mesh axis with any Op. SUM/MAX/MIN lower to native
+    psum/pmax/pmin (single ICI reduction); other ops all_gather + fold."""
+    if op.name == "sum":
+        return lax.psum(x, axis)
+    if op.name == "max":
+        return lax.pmax(x, axis)
+    if op.name == "min":
+        return lax.pmin(x, axis)
+    gathered = lax.all_gather(x, axis)           # (n, *x.shape)
+    if op.name == "prod":
+        return jnp.prod(gathered, axis=0)
+    if op.name in ("land", "band"):
+        return jnp.all(gathered.astype(bool), axis=0).astype(x.dtype) \
+            if op.name == "land" else functools.reduce(
+                jnp.bitwise_and, [gathered[i] for i in range(gathered.shape[0])])
+    if op.name in ("lor", "bor"):
+        return jnp.any(gathered.astype(bool), axis=0).astype(x.dtype) \
+            if op.name == "lor" else functools.reduce(
+                jnp.bitwise_or, [gathered[i] for i in range(gathered.shape[0])])
+    if op.name in ("lxor", "bxor"):
+        red = functools.reduce(jnp.bitwise_xor,
+                               [gathered[i].astype(jnp.int32)
+                                for i in range(gathered.shape[0])])
+        return red.astype(x.dtype)
+    # generic fold (user op whose fn is jax-traceable)
+    acc = gathered[0]
+    for i in range(1, gathered.shape[0]):
+        acc = op.fn(acc, gathered[i])
+    return acc
+
+
+def all_gather_axis(x, axis: str, tiled: bool = True):
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter_axis(x, axis: str):
+    """psum_scatter over dim 0 (must be divisible by axis size)."""
+    return lax.psum_scatter(x, axis, tiled=True)
+
+
+def all_to_all_axis(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
+def ppermute(x, axis: str, perm: Sequence[Tuple[int, int]]):
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def ring_shift(x, axis: str, n: int, shift: int = 1):
+    """Neighbor exchange on a ring — the schedule ring attention and the
+    ring/segmented-ring collectives share (coll_base_allreduce.c:344,621)."""
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm=perm)
+
+
+def pbcast(x, axis: str, root: int = 0):
+    """Broadcast root's shard to every member of the axis."""
+    return lax.all_gather(x, axis)[root]
+
+
+# ---------------------------------------------------------------------------
+# DeviceComm: MPI-shaped device collectives with an executable cache
+# ---------------------------------------------------------------------------
+
+
+class DeviceComm:
+    """Collectives over one axis of a mesh, single-controller.
+
+    ``n`` "ranks" = positions along `axis`. Input arrays use the canonical
+    (n, *elem) dim-0-sharded layout (see module docstring); `from_ranks`/
+    `to_ranks` convert to/from per-rank host arrays.
+    """
+
+    def __init__(self, mesh: Mesh, axis: str) -> None:
+        self.mesh = mesh
+        self.axis = axis
+        self.n = mesh.shape[axis]
+        self._cache: Dict[tuple, Callable] = {}
+        self._spec = P(axis)
+        self.spc = None          # optional SPC counters
+
+    # -- layout helpers -----------------------------------------------------
+
+    def sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, self._spec)
+
+    def from_ranks(self, arrays: Sequence[np.ndarray]) -> jax.Array:
+        """Stack per-rank buffers into the canonical device layout."""
+        stacked = jnp.stack([jnp.asarray(a) for a in arrays])
+        return jax.device_put(stacked, self.sharding())
+
+    def to_ranks(self, x: jax.Array) -> list:
+        host = np.asarray(jax.device_get(x))
+        return [host[i] for i in range(host.shape[0])]
+
+    # -- compiled-collective cache (≙ the coll/xla executable cache,
+    #    SURVEY.md §7 "ICI collectives outside a single XLA program") -------
+
+    def _compiled(self, key: tuple, build: Callable) -> Callable:
+        fn = self._cache.get(key)
+        if fn is None:
+            fn = build()
+            self._cache[key] = fn
+            if self.spc is not None:
+                self.spc.inc("device_cache_misses")
+        if self.spc is not None:
+            self.spc.inc("device_collectives")
+        return fn
+
+    def _shard_map(self, fn, in_specs, out_specs):
+        return jax.jit(jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                                     out_specs=out_specs))
+
+    def cache_info(self) -> Dict[str, int]:
+        return {"entries": len(self._cache)}
+
+    # -- collectives --------------------------------------------------------
+    #
+    # Rows ("MPI ranks") may outnumber mesh positions: with R total rows on
+    # an n-device axis each device owns r = R/n local rows (rank-per-chip is
+    # r=1; the single-chip bench runs all R rows on one device). Every
+    # collective below handles both regimes: local fold/slice over the r
+    # rows, ICI collective across devices.
+
+    def _fold_local(self, xs, op: Op):
+        """op-reduce the local rows (r, *e) → (*e)."""
+        if op.name == "sum":
+            return jnp.sum(xs, axis=0)
+        if op.name == "max":
+            return jnp.max(xs, axis=0)
+        if op.name == "min":
+            return jnp.min(xs, axis=0)
+        if op.name == "prod":
+            return jnp.prod(xs, axis=0)
+        acc = xs[0]
+        for i in range(1, xs.shape[0]):
+            acc = op.fn(acc, xs[i])
+        return acc
+
+    def allreduce(self, x: jax.Array, op: Op = SUM) -> jax.Array:
+        """Every rank's row ← op over all rows. (R,*e) → (R,*e)."""
+        key = ("allreduce", op.name, x.shape, str(x.dtype))
+
+        def build():
+            def inner(xs):           # xs: (r, *e) local shard
+                red = preduce(self._fold_local(xs, op), self.axis, op)
+                return jnp.broadcast_to(red[None], xs.shape)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def reduce(self, x: jax.Array, op: Op = SUM, root: int = 0) -> jax.Array:
+        """MPI semantics only promise the root's row; this returns the
+        reduction in every row (same executable as allreduce — on ICI the
+        broadcast halves are fused anyway)."""
+        return self.allreduce(x, op)
+
+    def bcast(self, x: jax.Array, root: int = 0) -> jax.Array:
+        key = ("bcast", int(root), x.shape, str(x.dtype))
+
+        def build():
+            def inner(xs):           # (r, *e)
+                full = lax.all_gather(xs, self.axis, axis=0, tiled=True)
+                return jnp.broadcast_to(full[root][None], xs.shape)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def allgather(self, x: jax.Array) -> jax.Array:
+        """(R, b, *e) → (R, R*b, *e): every row = concat of all rows."""
+        key = ("allgather", x.shape, str(x.dtype))
+
+        def build():
+            def inner(xs):           # (r, b, *e)
+                full = lax.all_gather(xs, self.axis, axis=0, tiled=True)
+                flat = full.reshape((-1,) + full.shape[2:])   # (R*b, *e)
+                return jnp.broadcast_to(flat[None],
+                                        (xs.shape[0],) + flat.shape)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def reduce_scatter(self, x: jax.Array, op: Op = SUM) -> jax.Array:
+        """(R, R*b, *e) → (R, b, *e): row i = op-reduced i-th block."""
+        R = x.shape[0]
+        b = x.shape[1] // R
+        r = R // self.n
+        key = ("reduce_scatter", op.name, x.shape, str(x.dtype))
+
+        def build():
+            def inner(xs):           # (r, R*b, *e)
+                folded = self._fold_local(xs, op)          # (R*b, *e)
+                if op.name == "sum":
+                    mine = lax.psum_scatter(folded, self.axis,
+                                            scatter_dimension=0, tiled=True)
+                else:
+                    red = preduce(folded, self.axis, op)   # (R*b, *e)
+                    i = lax.axis_index(self.axis)
+                    mine = lax.dynamic_slice_in_dim(red, i * r * b, r * b, 0)
+                return mine.reshape((r, b) + xs.shape[2:])
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def alltoall(self, x: jax.Array) -> jax.Array:
+        """(R, R, b, *e) → (R, R, b, *e): out[i, j] = in[j, i]."""
+        R = x.shape[0]
+        r = R // self.n
+        key = ("alltoall", x.shape, str(x.dtype))
+
+        def build():
+            if r == 1:
+                def inner(xs):       # (1, R, b, *e): native ICI all-to-all
+                    return lax.all_to_all(xs, self.axis, split_axis=1,
+                                          concat_axis=1, tiled=True)
+            else:
+                def inner(xs):       # (r, R, b, *e): gather + transpose slice
+                    full = lax.all_gather(xs, self.axis, axis=0, tiled=True)
+                    t = jnp.swapaxes(full, 0, 1)           # t[i,j] = in[j,i]
+                    i = lax.axis_index(self.axis)
+                    return lax.dynamic_slice_in_dim(t, i * r, r, 0)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def ring_shift(self, x: jax.Array, shift: int = 1) -> jax.Array:
+        """(R,*e) → (R,*e) with row i moved to row (i+shift)%R — the ppermute
+        ring primitive (context-parallel neighbor exchange)."""
+        R = x.shape[0]
+        r = R // self.n
+        key = ("ring", int(shift), x.shape, str(x.dtype))
+
+        def build():
+            if r == 1:
+                def inner(xs):
+                    return ring_shift(xs, self.axis, self.n, shift)
+            else:
+                def inner(xs):       # local rows shift within/across devices
+                    full = lax.all_gather(xs, self.axis, axis=0, tiled=True)
+                    rolled = jnp.roll(full, shift, axis=0)
+                    i = lax.axis_index(self.axis)
+                    return lax.dynamic_slice_in_dim(rolled, i * r, r, 0)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def scan(self, x: jax.Array, op: Op = SUM, exclusive: bool = False
+             ) -> jax.Array:
+        """Prefix reduction across ranks: row i ← op(rows 0..i)."""
+        R = x.shape[0]
+        r = R // self.n
+        key = ("scan", op.name, bool(exclusive), x.shape, str(x.dtype))
+
+        def build():
+            def inner(xs):           # (r, *e)
+                full = lax.all_gather(xs, self.axis, axis=0, tiled=True)
+                if op.name == "sum":
+                    csum = jnp.cumsum(full, axis=0)
+                else:
+                    csum = lax.associative_scan(
+                        lambda a, b: op.fn(a, b), full, axis=0)
+                if exclusive:
+                    z = jnp.zeros_like(csum[:1])
+                    csum = jnp.concatenate([z, csum[:-1]], axis=0)
+                i = lax.axis_index(self.axis)
+                return lax.dynamic_slice_in_dim(csum, i * r, r, 0)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def barrier(self) -> None:
+        """A real cross-device sync: tiny psum + block."""
+        key = ("barrier",)
+
+        def build():
+            def inner(xs):
+                return lax.psum(xs, self.axis)
+            return self._shard_map(inner, P(self.axis), P())
+
+        token = jax.device_put(
+            jnp.zeros((self.n,), jnp.int32), self.sharding())
+        self._compiled(key, build)(token).block_until_ready()

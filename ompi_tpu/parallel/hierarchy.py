@@ -1,0 +1,63 @@
+"""Hierarchical (two-level) collectives — the HAN analog.
+
+≙ ompi/mca/coll/han: split a collective into an intra-node stage and an
+inter-node stage over sub-communicators (coll_han_allreduce.c:92,
+coll_han_subcomms.c). On TPU the levels are mesh axes: `inner` rides ICI
+within a slice, `outer` rides DCN between slices/hosts. The bandwidth shape
+is the same as HAN's: reduce-scatter inner → allreduce outer on 1/n_inner of
+the data → allgather inner, so the slow (DCN) hops carry only the scattered
+fraction.
+
+On a single-slice mesh XLA would fuse a plain two-axis psum anyway; the
+explicit staged form exists because on multi-slice meshes the outer allreduce
+must move n_inner× less data over DCN — the entire point of HAN.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import classify_axes
+
+
+def hierarchical_psum(x, inner: str, outer: str):
+    """For use inside shard_map: reduce-scatter over `inner`, psum over
+    `outer`, allgather over `inner`. x's leading dim must be divisible by
+    the inner axis size."""
+    scattered = lax.psum_scatter(x, inner, scatter_dimension=0, tiled=True)
+    reduced = lax.psum(scattered, outer)
+    return lax.all_gather(reduced, inner, axis=0, tiled=True)
+
+
+def hierarchical_allreduce(x: jax.Array, mesh: Mesh, inner: str, outer: str
+                           ) -> jax.Array:
+    """Standalone two-level allreduce over both axes of a mesh.
+
+    x: (n_outer, n_inner, *elem) sharded over (outer, inner) — each (i, j)
+    row is that rank's buffer; every row gets the global reduction.
+    """
+    spec = P(outer, inner)
+
+    def local(xs):                    # (1, 1, *elem)
+        flat = xs.reshape(xs.shape[2:])
+        out = hierarchical_psum(flat, inner, outer)
+        return out[None, None]
+
+    fn = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=spec,
+                               out_specs=spec))
+    return fn(x)
+
+
+def auto_levels(mesh: Mesh):
+    """Pick (inner, outer) from topology: ICI axes inner, DCN axes outer
+    (classify_axes); falls back to (last, first) axis on flat meshes."""
+    kinds = classify_axes(mesh)
+    ici = [a for a, k in kinds.items() if k == "ici"]
+    dcn = [a for a, k in kinds.items() if k == "dcn"]
+    if ici and dcn:
+        return ici[-1], dcn[0]
+    names = list(mesh.axis_names)
+    return names[-1], names[0]

@@ -1,0 +1,152 @@
+"""Runtime init/finalize — wires control plane, transports, p2p, collectives.
+
+Re-design of the reference's staged bring-up (SURVEY.md §3.1):
+ompi_mpi_init (ompi/runtime/ompi_mpi_init.c:302) →
+ompi_mpi_instance_init_common (ompi/instance/instance.c:347): RTE/PMIx init,
+framework opens, modex + fence, then COMM_WORLD construction. Here:
+
+    Context(bootstrap):
+      1. per-rank progress engine (≙ opal_progress init)
+      2. open/select transport modules, publish addresses   (≙ btl add_procs)
+      3. bootstrap.fence()                                   (≙ PMIx fence —
+         the ONLY collective in startup, instance.c:529-596)
+      4. p2p protocol engine                                 (≙ pml select)
+      5. COMM_WORLD with the coll framework's per-comm table (≙ comm_init_mpi3)
+
+A Context is one *rank*. Multi-process jobs have one per process (tpurun
+environment contract); threaded single-host jobs create N in one process —
+the reference's single-host test stance (SURVEY.md §4). The singleton path
+(no launcher env) gives a size-1 world, like singleton MPI init.
+
+Thread level: FUNNELED — exactly one thread per Context may call into
+p2p/coll (the matching engine, transports, and selector are driven from that
+thread's progress loop, unlocked). Multiple Contexts in one process (threaded
+ranks) are fully independent.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from .control import Bootstrap, from_environment
+from .core.component import frameworks
+from .core.output import output
+from .core.progress import ProgressEngine, set_engine
+from .p2p import selftrans, tcp  # noqa: F401  (register transport components)
+from .p2p.pml import P2P
+from .p2p.transport import TransportLayer
+
+
+class Context:
+    def __init__(self, bootstrap: Optional[Bootstrap] = None) -> None:
+        self.bootstrap = bootstrap if bootstrap is not None else from_environment()
+        self.rank = self.bootstrap.rank
+        self.size = self.bootstrap.size
+        self.engine = ProgressEngine()
+        self.am_table: dict = {}
+        mods = []
+        for pri, comp, mod in frameworks.framework("transport").select_all(self):
+            mod.dispatch = self.am_table
+            mod.init_job(self.bootstrap)
+            mods.append(mod)
+        if not mods:
+            raise RuntimeError("no transport components available")
+        self.bootstrap.fence()
+        self.layer = TransportLayer(mods)
+        from .spc import Counters
+        self.spc = Counters()
+        self.p2p = P2P(self.bootstrap, self.layer, self.engine, spc=self.spc)
+        self._comm_world = None
+        self.finalized = False
+
+    @property
+    def comm_world(self):
+        """COMM_WORLD, built lazily (imports the comm layer on first use)."""
+        if self._comm_world is None:
+            from .comm import Communicator
+            self._comm_world = Communicator._world(self)
+        return self._comm_world
+
+    def finalize(self) -> None:
+        if self.finalized:
+            return
+        self.finalized = True
+        from .core import var as _var
+        self.spc._v["progress_polls"] = self.engine.polls
+        if _var.get("spc_dump_enabled", False):
+            self.spc.dump(self.rank)
+        try:
+            self.bootstrap.fence()
+        except Exception as exc:
+            output.verbose(1, "runtime", f"finalize fence failed: {exc}")
+        for t in self.layer.transports:
+            t.finalize()
+        self.bootstrap.finalize()
+
+    def abort(self, code: int = 1, msg: str = "") -> None:
+        self.bootstrap.abort(code, msg)
+
+
+_process_ctx: Optional[Context] = None
+
+
+def init(bootstrap: Optional[Bootstrap] = None) -> Context:
+    """Process-level init (≙ MPI_Init). Idempotent."""
+    global _process_ctx
+    if _process_ctx is None or _process_ctx.finalized:
+        _process_ctx = Context(bootstrap)
+        set_engine(_process_ctx.engine)
+        # worker threads the user spawns must poll this engine too
+        from .core.progress import set_process_engine
+        set_process_engine(_process_ctx.engine)
+    return _process_ctx
+
+
+def finalize() -> None:
+    global _process_ctx
+    if _process_ctx is not None:
+        _process_ctx.finalize()
+        _process_ctx = None
+
+
+def run_ranks(n: int, fn: Callable[[Context], object],
+              timeout: float = 60.0) -> List[object]:
+    """Run ``fn(ctx)`` on n threaded ranks wired through a LocalBootstrap —
+    the in-process analog of ``tpurun -np n`` used by the test suite
+    (SURVEY.md §4: the reference tests multi-rank logic single-host)."""
+    from .control.bootstrap import LocalBootstrap
+
+    boots = LocalBootstrap.create_job(n, job_id="threaded")
+    results: List[object] = [None] * n
+    errors: List[BaseException | None] = [None] * n
+
+    def runner(r: int) -> None:
+        ctx = None
+        try:
+            ctx = Context(boots[r])
+            set_engine(ctx.engine)
+            results[r] = fn(ctx)
+        except BaseException as exc:  # noqa: BLE001 — surfaced to caller
+            errors[r] = exc
+            boots[r].abort(1, f"rank {r}: {exc!r}")
+        finally:
+            if ctx is not None:
+                try:
+                    ctx.finalize()
+                except Exception:
+                    pass
+            set_engine(None)
+
+    threads = [threading.Thread(target=runner, args=(r,), daemon=True)
+               for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError("run_ranks: rank thread did not finish")
+    for r, exc in enumerate(errors):
+        if exc is not None:
+            raise exc
+    return results

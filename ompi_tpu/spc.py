@@ -1,0 +1,89 @@
+"""Software performance counters (SPC) + per-peer monitoring.
+
+≙ ompi/runtime/ompi_spc.c (≈100 counters exported as MPI_T pvars, dumped at
+finalize) and the monitoring components' per-peer communication matrices
+(ompi/mca/common/monitoring/common_monitoring.h:57,105, dumped by
+profile2mat.pl). One Counters instance per Context; the p2p engine and coll
+framework increment them; ``dump()`` prints at finalize when
+``spc_dump_enabled`` is set; the MPI_T analog (mpit.py) exposes them as
+pvars.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+from .core import var as _var
+
+_var.register("spc", "", "dump_enabled", False, type=bool, level=3,
+              help="Print the SPC counter table at finalize "
+                   "(≙ mpi_spc_dump_enabled).")
+_var.register("monitoring", "", "enabled", False, type=bool, level=3,
+              help="Record per-peer traffic matrices (≙ pml_monitoring).")
+
+COUNTERS = [
+    ("sends", "point-to-point sends posted"),
+    ("isends", "nonblocking sends posted"),
+    ("recvs", "receives posted"),
+    ("bytes_sent", "payload bytes sent"),
+    ("bytes_recvd", "payload bytes received"),
+    ("eager_sends", "sends using the eager protocol"),
+    ("rndv_sends", "sends using the rendezvous protocol"),
+    ("matches_posted", "messages matched against posted receives"),
+    ("matches_unexpected", "messages matched from the unexpected queue"),
+    ("unexpected_arrivals", "frames arriving with no posted receive"),
+    ("probes", "probe/iprobe calls"),
+    ("collectives", "collective operations started"),
+    ("device_collectives", "collectives dispatched to the XLA/ICI path"),
+    ("device_cache_misses", "device collective executable compiles"),
+    ("barriers", "barrier operations"),
+    ("comm_splits", "communicators created by split/dup"),
+    ("progress_polls", "progress engine passes"),
+    ("time_in_wait", "seconds spent waiting for completions"),
+]
+
+
+class Counters:
+    def __init__(self) -> None:
+        self._v: Dict[str, float] = {name: 0 for name, _ in COUNTERS}
+        self._peer_bytes: Dict[Tuple[str, int], int] = defaultdict(int)
+        self._peer_msgs: Dict[Tuple[str, int], int] = defaultdict(int)
+        self.monitoring = bool(_var.get("monitoring_enabled", False))
+
+    def inc(self, name: str, delta: float = 1) -> None:
+        self._v[name] = self._v.get(name, 0) + delta
+
+    def peer_traffic(self, direction: str, peer: int, nbytes: int) -> None:
+        if self.monitoring:
+            self._peer_bytes[(direction, peer)] += nbytes
+            self._peer_msgs[(direction, peer)] += 1
+
+    def get(self, name: str) -> float:
+        return self._v.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        return dict(self._v)
+
+    def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:
+        """per-peer {direction: {peer: (messages, bytes)}} (monitoring dump)."""
+        out: Dict[str, Dict[int, Tuple[int, int]]] = {"tx": {}, "rx": {}}
+        for (d, p), b in self._peer_bytes.items():
+            out[d][p] = (self._peer_msgs[(d, p)], b)
+        return out
+
+    def dump(self, rank: int) -> str:
+        lines = [f"SPC counters (rank {rank}):"]
+        for name, help_ in COUNTERS:
+            val = self._v.get(name, 0)
+            if val:
+                lines.append(f"  {name:24s} {val:>14.6g}  {help_}")
+        if self.monitoring and self._peer_bytes:
+            lines.append("  per-peer traffic (direction peer msgs bytes):")
+            for (d, p), b in sorted(self._peer_bytes.items()):
+                lines.append(f"    {d} {p:4d} {self._peer_msgs[(d, p)]:8d} {b:12d}")
+        text = "\n".join(lines)
+        print(text, flush=True)
+        return text

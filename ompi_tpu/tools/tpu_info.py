@@ -1,0 +1,71 @@
+"""``tpu_info`` — dump frameworks, components, variables, devices
+(≙ ompi_info, ompi/tools/ompi_info/ — "dumps every framework/component/param",
+SURVEY.md §5.5).
+
+Usage: python -m ompi_tpu.tools.tpu_info [--level N] [--param NAME] [--all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu_info")
+    ap.add_argument("--level", type=int, default=9,
+                    help="max variable level to show (1=user .. 9=developer)")
+    ap.add_argument("--param", help="show one variable by full name")
+    ap.add_argument("--all", action="store_true",
+                    help="include devices and the transport/coll inventory")
+    args = ap.parse_args(argv)
+
+    import ompi_tpu  # noqa: F401  (register core)
+    import ompi_tpu.coll  # noqa: F401  (register coll components)
+    import ompi_tpu.p2p.selftrans  # noqa: F401
+    import ompi_tpu.p2p.tcp  # noqa: F401
+    from ompi_tpu import mpit
+    from ompi_tpu.core import var as _var
+
+    print(f"ompi_tpu {ompi_tpu.__version__}")
+
+    if args.param:
+        try:
+            info = mpit.cvar_get_info(args.param)
+        except KeyError:
+            close = [v.name for v in _var.registry.all_vars()
+                     if args.param.lower() in v.name.lower()]
+            print(f"tpu_info: unknown variable {args.param!r}"
+                  + (f"; did you mean: {', '.join(close[:5])}" if close else ""),
+                  file=sys.stderr)
+            return 1
+        for k, v in info.items():
+            print(f"  {k}: {v}")
+        return 0
+
+    print("\nframeworks / components:")
+    for cat in mpit.category_get_all():
+        print(f"  {cat['framework']}: {', '.join(cat['components']) or '-'}")
+
+    print(f"\nvariables (level ≤ {args.level}):")
+    for v in _var.registry.all_vars(args.level):
+        print(f"  {v.name} = {v.value!r}  (type {v.type.__name__}, "
+              f"level {v.level}, source {v.source.name})")
+        if v.help:
+            print(f"      {v.help}")
+
+    if args.all:
+        try:
+            import jax
+
+            print("\ndevices:")
+            for d in jax.devices():
+                print(f"  [{d.id}] {d.device_kind} ({d.platform}) "
+                      f"process {getattr(d, 'process_index', 0)}")
+        except Exception as exc:  # pragma: no cover
+            print(f"\ndevices: unavailable ({exc})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""CLI entry point: ``python -m ompi_tpu.tools.tpurun -np N prog [args...]``
+(≙ mpirun, ompi/tools/mpirun/main.c)."""
+
+import sys
+
+from ..control.launch import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,14 +2,25 @@
 
 Tests run on a virtual 8-device CPU mesh (the reference's pattern of testing
 the full stack single-host with self/sm/tcp transports — SURVEY.md §4); the
-driver separately dry-run-compiles the multi-chip path.
+driver separately dry-run-compiles the multi-chip path and benches on the
+real chip.
+
+The axon TPU plugin registers itself from sitecustomize before conftest runs,
+so env-var defaults are not enough: force the cpu platform through jax.config
+(safe as long as no backend has been initialized yet).
 """
 
 import os
 
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,369 @@
+"""Collectives: tuned algorithms vs numpy ground truth across comm sizes,
+ops, and forced algorithm variants (≙ the reference's coll correctness
+checks + tuned decision overrides)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import op as ops
+from ompi_tpu import runtime
+from ompi_tpu.core import var
+
+
+def world(ctx):
+    return ctx.comm_world
+
+
+@pytest.mark.parametrize("size", [2, 3, 4])
+def test_allreduce_sum(size):
+    def fn(ctx):
+        c = world(ctx)
+        send = np.arange(8, dtype=np.float32) + c.rank
+        out = c.coll.allreduce(c, send)
+        return out
+
+    res = runtime.run_ranks(size, fn)
+    expect = sum(np.arange(8, dtype=np.float32) + r for r in range(size))
+    for r in res:
+        np.testing.assert_allclose(r, expect)
+
+
+@pytest.mark.parametrize("alg", ["recursive_doubling", "ring", "rabenseifner"])
+@pytest.mark.parametrize("size", [3, 4])
+def test_allreduce_forced_algorithms(alg, size):
+    var.registry.set_cli("coll_tuned_allreduce_algorithm", alg)
+    var.register("coll", "tuned", "allreduce_algorithm", "")
+    var.registry.reset_cache()
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = (np.arange(1000, dtype=np.float64) * (c.rank + 1))
+            return c.coll.allreduce(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        expect = sum(np.arange(1000, dtype=np.float64) * (r + 1)
+                     for r in range(size))
+        for r in res:
+            np.testing.assert_allclose(r, expect)
+    finally:
+        var.registry.set_cli("coll_tuned_allreduce_algorithm", "")
+        var.registry.reset_cache()
+
+
+@pytest.mark.parametrize("op,npfn", [
+    (ops.MAX, np.maximum), (ops.MIN, np.minimum), (ops.PROD, np.multiply),
+])
+def test_allreduce_other_ops(op, npfn):
+    def fn(ctx):
+        c = world(ctx)
+        send = np.arange(1, 9, dtype=np.float64) * (c.rank + 1)
+        return c.coll.allreduce(c, send, op=op)
+
+    res = runtime.run_ranks(3, fn)
+    vals = [np.arange(1, 9, dtype=np.float64) * (r + 1) for r in range(3)]
+    expect = vals[0]
+    for v in vals[1:]:
+        expect = npfn(expect, v)
+    for r in res:
+        np.testing.assert_allclose(r, expect)
+
+
+def test_allreduce_in_place():
+    def fn(ctx):
+        c = world(ctx)
+        buf = np.full(4, float(c.rank + 1), np.float32)
+        c.coll.allreduce(c, None, buf)
+        return buf
+
+    res = runtime.run_ranks(3, fn)
+    for r in res:
+        np.testing.assert_allclose(r, np.full(4, 6.0, np.float32))
+
+
+@pytest.mark.parametrize("alg", ["binomial", "scatter_allgather"])
+@pytest.mark.parametrize("root", [0, 2])
+def test_bcast(alg, root):
+    var.registry.set_cli("coll_tuned_bcast_algorithm", alg)
+    var.register("coll", "tuned", "bcast_algorithm", "")
+    var.registry.reset_cache()
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            buf = (np.arange(64, dtype=np.int64) if c.rank == root
+                   else np.zeros(64, np.int64))
+            c.coll.bcast(c, buf, root=root)
+            return buf
+
+        res = runtime.run_ranks(4, fn)
+        for r in res:
+            np.testing.assert_array_equal(r, np.arange(64, dtype=np.int64))
+    finally:
+        var.registry.set_cli("coll_tuned_bcast_algorithm", "")
+        var.registry.reset_cache()
+
+
+@pytest.mark.parametrize("root", [0, 1])
+def test_reduce(root):
+    def fn(ctx):
+        c = world(ctx)
+        send = np.arange(6, dtype=np.int64) + 10 * c.rank
+        out = np.zeros(6, np.int64) if c.rank == root else None
+        r = c.coll.reduce(c, send, out, root=root)
+        return r
+
+    res = runtime.run_ranks(3, fn)
+    expect = sum(np.arange(6, dtype=np.int64) + 10 * r for r in range(3))
+    np.testing.assert_array_equal(res[root], expect)
+    for i, r in enumerate(res):
+        if i != root:
+            assert r is None
+
+
+def test_reduce_noncommutative_matmul():
+    """Associative, non-commutative user op → must fold in rank order."""
+    matmul = ops.Op.create(
+        lambda a, b: (a.reshape(2, 2) @ b.reshape(2, 2)).reshape(-1),
+        commutative=False, name="matmul")
+
+    def fn(ctx):
+        c = world(ctx)
+        m = np.array([[1, c.rank + 1], [0, 1]], np.float64).reshape(-1)
+        out = np.zeros(4) if c.rank == 0 else None
+        return c.coll.reduce(c, m, out, op=matmul, root=0)
+
+    res = runtime.run_ranks(3, fn)
+    mats = [np.array([[1, r + 1], [0, 1]], np.float64) for r in range(3)]
+    expect = (mats[0] @ mats[1] @ mats[2]).reshape(-1)
+    np.testing.assert_allclose(res[0], expect)
+
+
+@pytest.mark.parametrize("alg", ["recursive_doubling", "ring", "bruck"])
+@pytest.mark.parametrize("size", [3, 4])
+def test_allgather(alg, size):
+    if alg == "recursive_doubling" and size != 4:
+        pytest.skip("recursive doubling needs power-of-2")
+    var.registry.set_cli("coll_tuned_allgather_algorithm", alg)
+    var.register("coll", "tuned", "allgather_algorithm", "")
+    var.registry.reset_cache()
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.full(3, c.rank, np.int32)
+            return c.coll.allgather(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        expect = np.stack([np.full(3, r, np.int32) for r in range(size)])
+        for r in res:
+            np.testing.assert_array_equal(r, expect)
+    finally:
+        var.registry.set_cli("coll_tuned_allgather_algorithm", "")
+        var.registry.reset_cache()
+
+
+@pytest.mark.parametrize("alg", ["pairwise", "bruck"])
+@pytest.mark.parametrize("size", [3, 4])
+def test_alltoall(alg, size):
+    var.registry.set_cli("coll_tuned_alltoall_algorithm", alg)
+    var.register("coll", "tuned", "alltoall_algorithm", "")
+    var.registry.reset_cache()
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.array([c.rank * 100 + i for i in range(c.size)], np.int64)
+            return c.coll.alltoall(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        for me, r in enumerate(res):
+            np.testing.assert_array_equal(
+                r, np.array([src * 100 + me for src in range(size)], np.int64))
+    finally:
+        var.registry.set_cli("coll_tuned_alltoall_algorithm", "")
+        var.registry.reset_cache()
+
+
+@pytest.mark.parametrize("size", [3, 4])   # 4 = recursive halving, 3 = fallback
+def test_reduce_scatter_block(size):
+    def fn(ctx):
+        c = world(ctx)
+        send = np.arange(size * 4, dtype=np.float64) + c.rank
+        return c.coll.reduce_scatter_block(c, send)
+
+    res = runtime.run_ranks(size, fn)
+    total = sum(np.arange(size * 4, dtype=np.float64) + r for r in range(size))
+    for me, r in enumerate(res):
+        np.testing.assert_allclose(r, total[me * 4:(me + 1) * 4])
+
+
+def test_reduce_scatter_varcounts():
+    counts = [1, 2, 3]
+
+    def fn(ctx):
+        c = world(ctx)
+        send = np.arange(6, dtype=np.float64) * (c.rank + 1)
+        recv = np.zeros(counts[c.rank])
+        c.coll.reduce_scatter(c, send, recv, counts)
+        return recv
+
+    res = runtime.run_ranks(3, fn)
+    total = sum(np.arange(6, dtype=np.float64) * (r + 1) for r in range(3))
+    np.testing.assert_allclose(res[0], total[:1])
+    np.testing.assert_allclose(res[1], total[1:3])
+    np.testing.assert_allclose(res[2], total[3:6])
+
+
+def test_gather_scatter_roundtrip():
+    def fn(ctx):
+        c = world(ctx)
+        send = np.full(2, c.rank + 1, np.int32)
+        gathered = c.coll.gather(c, send, root=1)
+        if c.rank == 1:
+            assert gathered is not None
+            scattered_src = gathered * 10
+        else:
+            scattered_src = None
+        out = np.zeros(2, np.int32)
+        c.coll.scatter(c, scattered_src, out, root=1)
+        return out
+
+    res = runtime.run_ranks(3, fn)
+    for me, r in enumerate(res):
+        np.testing.assert_array_equal(r, np.full(2, (me + 1) * 10, np.int32))
+
+
+def test_gatherv_allgatherv():
+    counts = [2, 1, 3]
+
+    def fn(ctx):
+        c = world(ctx)
+        send = np.full(counts[c.rank], c.rank, np.int64)
+        return c.coll.allgatherv(c, send, counts=counts)
+
+    res = runtime.run_ranks(3, fn)
+    expect = np.array([0, 0, 1, 2, 2, 2], np.int64)
+    for r in res:
+        np.testing.assert_array_equal(r, expect)
+
+
+def test_alltoallv():
+    # rank r sends r+1 elements to every peer
+    def fn(ctx):
+        c = world(ctx)
+        n = c.size
+        sendcounts = [c.rank + 1] * n
+        recvcounts = [src + 1 for src in range(n)]
+        send = np.concatenate(
+            [np.full(c.rank + 1, c.rank * 10 + dst, np.int64)
+             for dst in range(n)])
+        recv = np.zeros(sum(recvcounts), np.int64)
+        c.coll.alltoallv(c, send, recv, sendcounts, recvcounts)
+        return recv
+
+    res = runtime.run_ranks(3, fn)
+    for me, r in enumerate(res):
+        expect = np.concatenate(
+            [np.full(src + 1, src * 10 + me, np.int64) for src in range(3)])
+        np.testing.assert_array_equal(r, expect)
+
+
+def test_barrier():
+    import time
+
+    def fn(ctx):
+        c = world(ctx)
+        t0 = time.monotonic()
+        if c.rank == 0:
+            time.sleep(0.3)
+        c.coll.barrier(c)
+        return time.monotonic() - t0
+
+    res = runtime.run_ranks(3, fn)
+    assert all(t >= 0.28 for t in res)   # nobody escapes before rank 0 arrives
+
+
+def test_scan_exscan():
+    def fn(ctx):
+        c = world(ctx)
+        send = np.full(3, float(c.rank + 1), np.float64)
+        inc = c.coll.scan(c, send)
+        exc = np.full(3, -1.0, np.float64)
+        c.coll.exscan(c, send, exc)
+        return inc, exc
+
+    res = runtime.run_ranks(4, fn)
+    for me, (inc, exc) in enumerate(res):
+        np.testing.assert_allclose(inc, np.full(3, sum(range(1, me + 2)), float))
+        if me == 0:
+            np.testing.assert_allclose(exc, np.full(3, -1.0))  # undefined: untouched
+        else:
+            np.testing.assert_allclose(exc, np.full(3, sum(range(1, me + 1)), float))
+
+
+def test_maxloc():
+    def fn(ctx):
+        c = world(ctx)
+        dt = ops.loc_dtype(np.float64)
+        send = np.zeros(2, dt)
+        send["v"] = [c.rank * 1.5, -c.rank]
+        send["i"] = c.rank
+        recv = np.zeros(2, dt)
+        c.coll.allreduce(c, send, recv, op=ops.MAXLOC)
+        return recv
+
+    res = runtime.run_ranks(3, fn)
+    for r in res:
+        assert r["v"][0] == 3.0 and r["i"][0] == 2
+        assert r["v"][1] == 0.0 and r["i"][1] == 0
+
+
+def test_comm_split_and_subcomm_collectives():
+    def fn(ctx):
+        c = world(ctx)
+        sub = c.split(color=c.rank % 2, key=c.rank)
+        send = np.array([float(c.rank)], np.float64)
+        out = sub.coll.allreduce(sub, send)
+        return sub.rank, sub.size, float(out[0])
+
+    res = runtime.run_ranks(4, fn)
+    # evens: ranks 0,2 → sum 2.0 ; odds: 1,3 → 4.0
+    assert res[0] == (0, 2, 2.0)
+    assert res[2] == (1, 2, 2.0)
+    assert res[1] == (0, 2, 4.0)
+    assert res[3] == (1, 2, 4.0)
+
+
+def test_comm_dup_isolated_traffic():
+    def fn(ctx):
+        c = world(ctx)
+        dup = c.dup()
+        assert dup.cid != c.cid
+        # same pattern, different comms — must not cross-match
+        a = c.coll.allreduce(c, np.array([1.0]))
+        b = dup.coll.allreduce(dup, np.array([2.0]))
+        return float(a[0]), float(b[0])
+
+    res = runtime.run_ranks(3, fn)
+    for a, b in res:
+        assert a == 3.0 and b == 6.0
+
+
+def test_split_undefined_color():
+    def fn(ctx):
+        c = world(ctx)
+        sub = c.split(color=0 if c.rank < 2 else None, key=c.rank)
+        if c.rank < 2:
+            assert sub is not None and sub.size == 2
+            return sub.rank
+        assert sub is None
+        return -1
+
+    assert runtime.run_ranks(3, fn) == [0, 1, -1]
+
+
+def test_size_one_world_uses_self_component():
+    def fn(ctx):
+        c = world(ctx)
+        out = c.coll.allreduce(c, np.array([5.0]))
+        return c.coll.provider("allreduce"), float(out[0])
+
+    res = runtime.run_ranks(1, fn)
+    assert res[0] == ("self", 5.0)

@@ -1,0 +1,148 @@
+"""Device (XLA/ICI-path) collectives on the virtual 8-device CPU mesh —
+the single-host stand-in for a TPU slice (SURVEY.md §4 test stance)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ompi_tpu import op as ops  # noqa: E402
+from ompi_tpu import runtime  # noqa: E402
+from ompi_tpu.parallel import DeviceComm, attach_mesh, make_mesh  # noqa: E402
+
+N = 8
+
+
+@pytest.fixture(scope="module", params=["8dev", "1dev"])
+def dc(request):
+    """Both regimes: rank-per-device (8 devices) and all ranks on one device
+    (the single-chip bench mode — multiple rows per mesh position)."""
+    if request.param == "8dev":
+        mesh = make_mesh({"x": N})
+    else:
+        import jax as _jax
+        mesh = make_mesh({"x": 1}, devices=_jax.devices()[:1])
+    return DeviceComm(mesh, "x")
+
+
+def test_allreduce_sum(dc):
+    ranks = [np.full(16, float(i + 1), np.float32) for i in range(N)]
+    x = dc.from_ranks(ranks)
+    out = dc.allreduce(x)
+    expect = np.full(16, sum(range(1, N + 1)), np.float32)
+    for row in dc.to_ranks(out):
+        np.testing.assert_allclose(row, expect)
+
+
+@pytest.mark.parametrize("op,npfn", [
+    (ops.MAX, np.maximum), (ops.MIN, np.minimum), (ops.PROD, np.multiply),
+])
+def test_allreduce_ops(dc, op, npfn):
+    ranks = [np.linspace(i, i + 1, 8).astype(np.float32) for i in range(N)]
+    out = dc.allreduce(dc.from_ranks(ranks), op)
+    expect = ranks[0]
+    for r in ranks[1:]:
+        expect = npfn(expect, r)
+    np.testing.assert_allclose(dc.to_ranks(out)[3], expect, rtol=1e-6)
+
+
+def test_bcast(dc):
+    ranks = [np.full(4, float(i), np.float32) for i in range(N)]
+    out = dc.bcast(dc.from_ranks(ranks), root=5)
+    for row in dc.to_ranks(out):
+        np.testing.assert_allclose(row, np.full(4, 5.0))
+
+
+def test_allgather(dc):
+    ranks = [np.array([i, 10 * i], np.int32) for i in range(N)]
+    out = dc.allgather(dc.from_ranks(ranks))
+    expect = np.concatenate(ranks)
+    for row in dc.to_ranks(out):
+        np.testing.assert_array_equal(row, expect)
+
+
+def test_reduce_scatter(dc):
+    # each rank contributes N*3 elements; rank i receives reduced block i
+    ranks = [np.arange(N * 3, dtype=np.float32) * (i + 1) for i in range(N)]
+    out = dc.reduce_scatter(dc.from_ranks(ranks))
+    total = sum(ranks)
+    rows = dc.to_ranks(out)
+    for i, row in enumerate(rows):
+        np.testing.assert_allclose(row, total[i * 3:(i + 1) * 3])
+
+
+def test_alltoall(dc):
+    # rank i sends block [i, j] to rank j
+    ranks = [np.stack([np.full(2, 100 * i + j, np.int32) for j in range(N)])
+             for i in range(N)]
+    out = dc.alltoall(dc.from_ranks(ranks))
+    rows = dc.to_ranks(out)
+    for j, row in enumerate(rows):
+        for i in range(N):
+            np.testing.assert_array_equal(row[i], np.full(2, 100 * i + j))
+
+
+def test_ring_shift(dc):
+    ranks = [np.array([float(i)]) for i in range(N)]
+    out = dc.ring_shift(dc.from_ranks(ranks), shift=1)
+    rows = dc.to_ranks(out)
+    for i, row in enumerate(rows):
+        assert row[0] == (i - 1) % N
+
+
+def test_scan(dc):
+    ranks = [np.array([float(i + 1)]) for i in range(N)]
+    inc = dc.to_ranks(dc.scan(dc.from_ranks(ranks)))
+    exc = dc.to_ranks(dc.scan(dc.from_ranks(ranks), exclusive=True))
+    for i in range(N):
+        assert inc[i][0] == sum(range(1, i + 2))
+        assert exc[i][0] == (0.0 if i == 0 else sum(range(1, i + 1)))
+
+
+def test_executable_cache_reuse(dc):
+    x = dc.from_ranks([np.ones(32, np.float32)] * N)
+    before = dc.cache_info()["entries"]
+    dc.allreduce(x)
+    mid = dc.cache_info()["entries"]
+    dc.allreduce(x + 1)          # same shape/dtype/op → cache hit
+    assert dc.cache_info()["entries"] == mid
+    dc.allreduce(x.astype(jnp.bfloat16))   # new dtype → new executable
+    assert dc.cache_info()["entries"] == mid + 1
+    assert mid >= before
+
+
+def test_barrier(dc):
+    dc.barrier()   # completes without error
+
+
+def test_comm_integration_device_dispatch():
+    """A communicator with an attached mesh routes device buffers through
+    coll/xla and host buffers through tuned (the check_addr dispatch)."""
+    def fn(ctx):
+        c = ctx.comm_world
+        mesh = make_mesh({"x": N})
+        attach_mesh(c, mesh, "x")
+        assert c.coll.provider("allreduce") == "xla"
+        # device buffer → device result
+        dcomm = c.device_comm
+        x = dcomm.from_ranks([np.full(4, float(i), np.float32)
+                              for i in range(N)])
+        dev = c.coll.allreduce(c, x)
+        # host buffer → host path still works
+        host = c.coll.allreduce(c, np.full(4, 2.0, np.float32))
+        return (np.asarray(jax.device_get(dev))[0], host)
+
+    dev, host = runtime.run_ranks(1, fn)[0]
+    np.testing.assert_allclose(dev, np.full(4, sum(range(N)), np.float32))
+    np.testing.assert_allclose(host, np.full(4, 2.0, np.float32))
+
+
+def test_bfloat16_allreduce(dc):
+    """bfloat16 — the TPU-native compute type — reduces natively."""
+    ranks = [np.ones(128, np.float32).astype(jnp.bfloat16) * (i + 1)
+             for i in range(N)]
+    out = dc.allreduce(dc.from_ranks(ranks))
+    np.testing.assert_allclose(
+        np.asarray(dc.to_ranks(out)[0]).astype(np.float32),
+        np.full(128, 36.0), rtol=1e-2)

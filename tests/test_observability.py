@@ -1,0 +1,74 @@
+"""SPC counters, MPI_T introspection, tpu_info (≙ test/spc +
+test/monitoring in the reference)."""
+
+import numpy as np
+
+from ompi_tpu import mpit, runtime
+from ompi_tpu.core import var
+
+
+def test_spc_counts_p2p_and_coll(monkeypatch):
+    monkeypatch.setenv("OMPI_TPU_monitoring_enabled", "1")
+    var.registry.reset_cache()
+
+    def fn(ctx):
+        c = ctx.comm_world
+        if ctx.rank == 0:
+            c.send(np.arange(4, dtype=np.float32), 1, tag=1)
+        else:
+            buf = np.zeros(4, np.float32)
+            c.recv(buf, 0, tag=1)
+        c.coll.allreduce(c, np.ones(4, np.float32))
+        c.barrier()
+        return mpit.pvar_read_all(ctx), ctx.spc.matrix()
+
+    res = runtime.run_ranks(2, fn)
+    c0, m0 = res[0]
+    c1, m1 = res[1]
+    assert c0["isends"] >= 1 and c0["eager_sends"] >= 1
+    assert c1["recvs"] >= 1 and c1["bytes_recvd"] >= 16
+    assert c0["collectives"] >= 2 and c0["barriers"] >= 1
+    # monitoring matrix saw rank0 → rank1 user traffic
+    assert 1 in m0["tx"] and m0["tx"][1][1] >= 16
+
+
+def test_mpit_cvars():
+    var.register("testmpit", "x", "knob", 7, help="h", level=2)
+    info = mpit.cvar_get_info("testmpit_x_knob")
+    assert info["value"] == 7 and info["level"] == 2
+    mpit.cvar_write("testmpit_x_knob", 9)
+    assert mpit.cvar_get_info("testmpit_x_knob")["value"] == 9
+    assert mpit.cvar_get_num() > 0
+
+
+def test_mpit_pvar_inventory():
+    assert mpit.pvar_get_num() > 10
+    names = {mpit.pvar_get_info(i)["name"] for i in range(mpit.pvar_get_num())}
+    assert {"isends", "recvs", "bytes_sent", "device_collectives"} <= names
+
+
+def test_tpu_info_cli(capsys):
+    from ompi_tpu.tools.tpu_info import main
+    assert main(["--level", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "frameworks / components" in out
+    assert "coll" in out
+    assert main(["--param", "coll_tuned_allreduce_algorithm"]) == 0
+
+
+def test_transport_matrix():
+    """hook/comm_method analog: which transport serves each peer."""
+    def fn(ctx):
+        c = ctx.comm_world
+        if ctx.rank == 0:
+            c.send(np.zeros(1, np.float32), 1, tag=0)
+            c.send(np.zeros(1, np.float32), 0, tag=0)   # self
+            buf = np.zeros(1, np.float32)
+            c.recv(buf, 0, tag=0)
+            return ctx.layer.transport_matrix()
+        buf = np.zeros(1, np.float32)
+        c.recv(buf, 0, tag=0)
+        return None
+
+    res = runtime.run_ranks(2, fn)
+    assert res[0][1] == "tcp" and res[0][0] == "self"

@@ -1,0 +1,244 @@
+"""P2p protocol tests over threaded ranks (self + tcp transports).
+
+Models the reference's single-host multi-rank test stance (SURVEY.md §4) and
+its p2p semantics: eager vs rendezvous, wildcards, ordering, truncation,
+sendrecv rings (examples/ring_c.c)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime
+from ompi_tpu.p2p import ANY_SOURCE, ANY_TAG, TruncateError, wait_all
+
+
+def test_send_recv_two_ranks():
+    def fn(ctx):
+        if ctx.rank == 0:
+            ctx.p2p.send(np.arange(16, dtype=np.float32), dst=1, tag=5)
+            return None
+        buf = np.zeros(16, dtype=np.float32)
+        st = ctx.p2p.recv(buf, src=0, tag=5)
+        assert st.source == 0 and st.tag == 5
+        return buf
+
+    res = runtime.run_ranks(2, fn)
+    np.testing.assert_array_equal(res[1], np.arange(16, dtype=np.float32))
+
+
+def test_self_send():
+    def fn(ctx):
+        req = ctx.p2p.isend(np.array([7], np.int32), dst=ctx.rank, tag=1)
+        buf = np.zeros(1, np.int32)
+        ctx.p2p.recv(buf, src=ctx.rank, tag=1)
+        req.wait()
+        return int(buf[0])
+
+    assert runtime.run_ranks(1, fn) == [7]
+
+
+def test_rendezvous_large_message():
+    n = 1 << 19  # 2MB of float32 — over the 64KB eager limit, multi-frag
+    def fn(ctx):
+        if ctx.rank == 0:
+            data = np.arange(n, dtype=np.float32)
+            ctx.p2p.send(data, dst=1, tag=9)
+            return None
+        buf = np.zeros(n, dtype=np.float32)
+        ctx.p2p.recv(buf, src=0, tag=9)
+        return buf
+
+    res = runtime.run_ranks(2, fn, timeout=120)
+    np.testing.assert_array_equal(res[1], np.arange(n, dtype=np.float32))
+
+
+def test_ssend_completes_after_match():
+    def fn(ctx):
+        if ctx.rank == 0:
+            req = ctx.p2p.isend(np.array([1.0], np.float64), dst=1, tag=3,
+                                sync=True)
+            assert not req.done  # no receiver yet
+            req.wait(timeout=30)
+            return True
+        import time
+        time.sleep(0.2)
+        buf = np.zeros(1, np.float64)
+        ctx.p2p.recv(buf, src=0, tag=3)
+        return True
+
+    assert runtime.run_ranks(2, fn) == [True, True]
+
+
+def test_wildcard_source_and_tag():
+    def fn(ctx):
+        if ctx.rank == 0:
+            buf = np.zeros(1, np.int32)
+            st = ctx.p2p.recv(buf, src=ANY_SOURCE, tag=ANY_TAG)
+            return (st.source, st.tag, int(buf[0]))
+        ctx.p2p.send(np.array([ctx.rank * 10], np.int32), dst=0, tag=77)
+        return None
+
+    res = runtime.run_ranks(2, fn)
+    assert res[0] == (1, 77, 10)
+
+
+def test_message_ordering_same_channel():
+    """MPI non-overtaking: same (src,dst,tag) messages match in send order."""
+    def fn(ctx):
+        if ctx.rank == 0:
+            for i in range(20):
+                ctx.p2p.send(np.array([i], np.int32), dst=1, tag=4)
+            return None
+        out = []
+        buf = np.zeros(1, np.int32)
+        for _ in range(20):
+            ctx.p2p.recv(buf, src=0, tag=4)
+            out.append(int(buf[0]))
+        return out
+
+    res = runtime.run_ranks(2, fn)
+    assert res[1] == list(range(20))
+
+
+def test_unexpected_messages_buffered():
+    def fn(ctx):
+        if ctx.rank == 0:
+            # send before receiver posts
+            for tag in (1, 2, 3):
+                ctx.p2p.send(np.array([tag], np.int32), dst=1, tag=tag)
+            return None
+        import time
+        time.sleep(0.2)
+        # receive out of tag order
+        vals = {}
+        buf = np.zeros(1, np.int32)
+        for tag in (3, 1, 2):
+            ctx.p2p.recv(buf, src=0, tag=tag)
+            vals[tag] = int(buf[0])
+        return vals
+
+    res = runtime.run_ranks(2, fn)
+    assert res[1] == {1: 1, 2: 2, 3: 3}
+
+
+def test_truncation_error():
+    def fn(ctx):
+        if ctx.rank == 0:
+            ctx.p2p.send(np.arange(8, dtype=np.float64), dst=1, tag=1)
+            return None
+        buf = np.zeros(2, np.float64)
+        with pytest.raises(TruncateError):
+            ctx.p2p.recv(buf, src=0, tag=1)
+        return True
+
+    res = runtime.run_ranks(2, fn)
+    assert res[1] is True
+
+
+def test_iprobe_and_probe():
+    def fn(ctx):
+        if ctx.rank == 0:
+            ctx.p2p.send(np.array([5], np.int32), dst=1, tag=42)
+            return None
+        st = ctx.p2p.probe(src=0, tag=42, timeout=30)
+        assert st["count"] == 4
+        buf = np.zeros(1, np.int32)
+        ctx.p2p.recv(buf, src=0, tag=42)
+        return int(buf[0])
+
+    assert runtime.run_ranks(2, fn)[1] == 5
+
+
+def test_ring_4_ranks():
+    """examples/ring_c.c analog — the PR1 acceptance workload
+    (BASELINE.json configs[0]): pass a token around a 4-rank ring."""
+    def fn(ctx):
+        # mirrors examples/ring_c.c:1 control flow: decrement at rank 0,
+        # forward until 0 has gone all the way around
+        n, me = ctx.size, ctx.rank
+        nxt, prv = (me + 1) % n, (me - 1) % n
+        buf = np.zeros(1, np.int32)
+        if me == 0:
+            buf[0] = 10
+            ctx.p2p.send(buf, dst=nxt, tag=201)
+        while True:
+            ctx.p2p.recv(buf, src=prv, tag=201)
+            if me == 0:
+                buf[0] -= 1
+            ctx.p2p.send(buf, dst=nxt, tag=201)
+            if buf[0] == 0:
+                break
+        if me == 0:
+            ctx.p2p.recv(buf, src=prv, tag=201)  # drain the final lap
+        return int(buf[0])
+
+    res = runtime.run_ranks(4, fn, timeout=120)
+    assert res == [0, 0, 0, 0]
+
+
+def test_noncontiguous_datatype_send():
+    from ompi_tpu.datatype import FLOAT32, Datatype
+
+    def fn(ctx):
+        colvec = Datatype.vector(count=4, blocklength=1, stride=4, base=FLOAT32)
+        if ctx.rank == 0:
+            mat = np.arange(16, dtype=np.float32).reshape(4, 4)
+            ctx.p2p.send(mat, dst=1, datatype=colvec, count=1, tag=8)
+            return None
+        out = np.zeros(4, dtype=np.float32)
+        ctx.p2p.recv(out, src=0, tag=8)
+        return out
+
+    res = runtime.run_ranks(2, fn)
+    np.testing.assert_array_equal(res[1], [0, 4, 8, 12])
+
+
+def test_many_outstanding_requests():
+    def fn(ctx):
+        if ctx.rank == 0:
+            reqs = [ctx.p2p.isend(np.full(64, i, np.int32), dst=1, tag=i)
+                    for i in range(32)]
+            wait_all(reqs)
+            return None
+        reqs, bufs = [], []
+        for i in range(32):
+            b = np.zeros(64, np.int32)
+            bufs.append(b)
+            reqs.append(ctx.p2p.irecv(b, src=0, tag=i))
+        wait_all(reqs)
+        return all((bufs[i] == i).all() for i in range(32))
+
+    assert runtime.run_ranks(2, fn)[1] is True
+
+
+def test_wildcard_does_not_steal_internal_tags():
+    """ANY_TAG must not match reserved negative internal tags (review fix)."""
+    def fn(ctx):
+        c = ctx.comm_world
+        if ctx.rank == 0:
+            import time
+            time.sleep(0.1)
+            c.send(np.array([42], np.int32), dst=1, tag=7)
+            c.barrier()
+            return None
+        buf = np.zeros(1, np.int32)
+        req = c.irecv(buf, src=ANY_SOURCE, tag=ANY_TAG)
+        c.barrier()          # internal barrier frames must not satisfy req
+        st = req.wait(timeout=30)
+        return (st.tag, int(buf[0]))
+
+    res = runtime.run_ranks(2, fn)
+    assert res[1] == (7, 42)
+
+
+def test_truncated_rendezvous_releases_sender():
+    def fn(ctx):
+        if ctx.rank == 0:
+            req = ctx.p2p.isend(np.zeros(1 << 17, np.float64), dst=1, tag=1)
+            req.wait(timeout=30)   # must complete despite receiver truncation
+            return True
+        buf = np.zeros(4, np.float64)
+        with pytest.raises(TruncateError):
+            ctx.p2p.recv(buf, src=0, tag=1)
+        return True
+
+    assert runtime.run_ranks(2, fn, timeout=60) == [True, True]

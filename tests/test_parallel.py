@@ -1,0 +1,97 @@
+"""Sequence/context parallelism + hierarchical collectives on the 8-device
+CPU mesh: ring attention and Ulysses must match dense attention exactly."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from ompi_tpu.parallel import (  # noqa: E402
+    attention_reference,
+    hierarchical_allreduce,
+    make_mesh,
+    ring_attention,
+    ulysses_attention,
+)
+
+B, S, H, D = 2, 64, 8, 16     # seq 64 over 8 devices → 8 per device
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"sp": 8})
+
+
+def _qkv(seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((B, S, H, D)).astype(np.float32)
+    return mk(), mk(), mk()
+
+
+def _shard_seq(mesh, x):
+    return jax.device_put(jnp.asarray(x), NamedSharding(mesh, P(None, "sp")))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_dense(mesh, causal):
+    q, k, v = _qkv()
+    qd, kd, vd = (_shard_seq(mesh, t) for t in (q, k, v))
+    out = ring_attention(qd, kd, vd, mesh, "sp", causal=causal)
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_dense(mesh, causal):
+    q, k, v = _qkv(1)
+    qd, kd, vd = (_shard_seq(mesh, t) for t in (q, k, v))
+    out = ulysses_attention(qd, kd, vd, mesh, "sp", causal=causal)
+    ref = attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=causal)
+    np.testing.assert_allclose(np.asarray(jax.device_get(out)),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_jit_grad(mesh):
+    """Differentiability: ring attention must train (loss/grad path)."""
+    q, k, v = _qkv(2)
+    qd, kd, vd = (_shard_seq(mesh, t) for t in (q, k, v))
+
+    def loss(q_, k_, v_):
+        return jnp.sum(ring_attention(q_, k_, v_, mesh, "sp", causal=True) ** 2)
+
+    g = jax.jit(jax.grad(loss))(qd, kd, vd)
+
+    def ref_loss(q_, k_, v_):
+        return jnp.sum(attention_reference(q_, k_, v_, causal=True) ** 2)
+
+    gref = jax.grad(ref_loss)(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(jax.device_get(g)),
+                               np.asarray(gref), rtol=5e-3, atol=5e-4)
+
+
+def test_hierarchical_allreduce():
+    mesh = make_mesh({"outer": 2, "inner": 4})
+    ranks = np.stack([
+        np.stack([np.arange(8, dtype=np.float32) * (o * 4 + i + 1)
+                  for i in range(4)])
+        for o in range(2)
+    ])                                  # (2, 4, 8)
+    x = jax.device_put(jnp.asarray(ranks),
+                       NamedSharding(mesh, P("outer", "inner")))
+    out = hierarchical_allreduce(x, mesh, inner="inner", outer="outer")
+    expect = sum(np.arange(8, dtype=np.float32) * r for r in range(1, 9))
+    host = np.asarray(jax.device_get(out))
+    for o in range(2):
+        for i in range(4):
+            np.testing.assert_allclose(host[o, i], expect)
+
+
+def test_ulysses_rejects_bad_heads(mesh):
+    q = jnp.zeros((B, S, 6, D))       # 6 heads not divisible by 8
+    with pytest.raises(ValueError, match="divisible"):
+        ulysses_attention(q, q, q, mesh, "sp")

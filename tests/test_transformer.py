@@ -1,0 +1,77 @@
+"""Flagship transformer: sharded training must run and learn, and the ring
+(sp) attention path must agree with the dense path."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ompi_tpu.models.transformer import (  # noqa: E402
+    Config,
+    forward,
+    init_params,
+    loss_fn,
+    make_train_step,
+    shard_params,
+)
+from ompi_tpu.parallel import make_mesh  # noqa: E402
+
+
+def _toy_batch(rng, cfg, n=4):
+    # learnable structure: token t+1 = (t + 1) % vocab
+    start = rng.integers(0, cfg.vocab, size=(n, 1))
+    ar = (start + np.arange(cfg.seq + 1)) % cfg.vocab
+    return jnp.asarray(ar, jnp.int32)
+
+
+def test_forward_shapes_single_device():
+    cfg = Config(vocab=64, d_model=32, n_layers=1, n_heads=4, head_dim=8,
+                 d_ff=64, seq=16)
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = forward(params, tokens, cfg)
+    assert logits.shape == (2, 16, 64)
+    assert logits.dtype == jnp.float32
+
+
+def test_training_reduces_loss_sharded():
+    mesh = make_mesh({"dp": 2, "sp": 2, "tp": 2})
+    cfg = Config(vocab=32, d_model=32, n_layers=1, n_heads=4, head_dim=8,
+                 d_ff=64, seq=32, attn="ring")
+    params = shard_params(init_params(jax.random.key(0), cfg), mesh, cfg)
+    init_opt, step = make_train_step(cfg, mesh, learning_rate=3e-3)
+    opt_state = init_opt(params)
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(12):
+        params, opt_state, loss = step(params, opt_state,
+                                       _toy_batch(rng, cfg))
+        losses.append(float(jax.device_get(loss)))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, f"no learning: {losses}"
+
+
+def test_ring_and_dense_forward_agree():
+    mesh = make_mesh({"dp": 1, "sp": 8, "tp": 1})
+    cfg_ring = Config(vocab=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+                      d_ff=64, seq=64, attn="ring", dtype=jnp.float32)
+    cfg_dense = Config(vocab=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+                       d_ff=64, seq=64, attn="dense", dtype=jnp.float32)
+    params = init_params(jax.random.key(1), cfg_ring)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, 64, size=(2, 64)), jnp.int32)
+    ring = forward(params, tokens, cfg_ring, mesh)
+    dense = forward(params, tokens, cfg_dense)
+    np.testing.assert_allclose(np.asarray(jax.device_get(ring)),
+                               np.asarray(jax.device_get(dense)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_graft_entry_contract():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert np.isfinite(np.asarray(jax.device_get(out))).all()
+    g.dryrun_multichip(8)
