@@ -292,7 +292,7 @@ class BasicModule(CollModule):
             recvbuf[...] = prev
             if comm.rank < comm.size - 1:
                 comm.send(op(prev, send.copy()), comm.rank + 1, T_SCAN)
-        return recvbuf if comm.rank > 0 else recvbuf
+        return recvbuf
 
     def reduce_local(self, comm, invec, inoutvec, op: Op = None):
         from .. import op as _op

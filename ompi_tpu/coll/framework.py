@@ -87,9 +87,26 @@ class CollTable:
         raise AttributeError(f"no collective entry point {name!r}")
 
 
+def _ensure_components() -> None:
+    """Import the in-tree component modules (registration happens at import).
+
+    Selection must not depend on package import order: a thread can reach
+    this module through sys.modules while another thread is still executing
+    ``coll/__init__.py``, before the component imports there have run — the
+    analog of the reference opening a framework's components before any
+    selection (mca_base_framework.c:161)."""
+    import importlib
+    for m in ("basic", "selfcoll", "tuned", "xla"):
+        try:
+            importlib.import_module(f"{__package__}.{m}")
+        except ImportError:  # pragma: no cover — reduced build
+            pass
+
+
 def attach_coll(comm) -> None:
     """Select and attach the coll table for a new communicator
     (≙ mca_coll_base_comm_select)."""
+    _ensure_components()
     rows = frameworks.framework("coll").select_all(comm)
     if not rows:
         show_help.show("no-component", "coll", "coll_select", "")

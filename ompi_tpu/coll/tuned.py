@@ -150,26 +150,35 @@ def allreduce_rabenseifner(comm, send: np.ndarray, recv: np.ndarray,
     else:
         newrank = rank - rem
 
+    def block_span(nr: int, down_to_mask: int):
+        """Span nr holds after the halving decisions for masks ≥ down_to_mask
+        (halving may be uneven when the vector doesn't split in two exactly,
+        so spans must be recomputed per rank, never assumed equal)."""
+        blo, bhi = 0, flat.size
+        m = pof2 >> 1
+        while m >= down_to_mask:
+            mid = blo + (bhi - blo) // 2
+            if nr & m:
+                blo = mid
+            else:
+                bhi = mid
+            m >>= 1
+        return blo, bhi
+
     if newrank >= 0:
         # recursive halving reduce-scatter over pof2 ranks
-        bounds = [0, flat.size]
-
-        def halves(lo, hi):
-            mid = lo + (hi - lo) // 2
-            return (lo, mid), (mid, hi)
-
         mask = pof2 >> 1
         lo, hi = 0, flat.size
         while mask > 0:
-            peer_new = newrank ^ (pof2 // (mask * 2)) if False else newrank ^ mask
+            peer_new = newrank ^ mask
             peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
-            (alo, amid), (bmid, bhi) = halves(lo, hi)
+            mid = lo + (hi - lo) // 2
             if newrank & mask:
-                keep_lo, keep_hi = bmid, bhi
-                send_lo, send_hi = alo, amid
+                keep_lo, keep_hi = mid, hi
+                send_lo, send_hi = lo, mid
             else:
-                keep_lo, keep_hi = alo, amid
-                send_lo, send_hi = bmid, bhi
+                keep_lo, keep_hi = lo, mid
+                send_lo, send_hi = mid, hi
             inbox = np.empty(keep_hi - keep_lo, flat.dtype)
             comm.sendrecv(flat[send_lo:send_hi], peer, inbox, peer,
                           T_RSCAT, T_RSCAT)
@@ -180,21 +189,19 @@ def allreduce_rabenseifner(comm, send: np.ndarray, recv: np.ndarray,
                 seg[...] = op(seg.copy(), inbox)
             lo, hi = keep_lo, keep_hi
             mask >>= 1
-        # recursive doubling allgather, retracing in reverse
+        # recursive doubling allgather, retracing in reverse; the peer's
+        # current span is its own halving-path block, which can differ from
+        # ours by one element per level on non-power-of-two vector sizes
         mask = 1
         while mask < pof2:
             peer_new = newrank ^ mask
             peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
-            span = hi - lo
-            if newrank & mask:
-                other_lo, other_hi = lo - span, lo
-            else:
-                other_lo, other_hi = hi, hi + span
-            inbox = np.empty(other_hi - other_lo, flat.dtype)
+            plo, phi = block_span(peer_new, mask)
+            inbox = np.empty(phi - plo, flat.dtype)
             comm.sendrecv(flat[lo:hi], peer, inbox, peer,
                           T_ALLGATHER, T_ALLGATHER)
-            flat[other_lo:other_hi] = inbox
-            lo, hi = min(lo, other_lo), max(hi, other_hi)
+            flat[plo:phi] = inbox
+            lo, hi = min(lo, plo), max(hi, phi)
             mask <<= 1
     if rank < 2 * rem:
         if rank % 2 == 0:
@@ -350,7 +357,6 @@ def allgather_bruck(comm, send: np.ndarray, recv: np.ndarray) -> None:
     have = 1
     dist = 1
     while dist < size:
-        sendn = min(dist, size - have)
         peer_to = (rank - dist) % size
         peer_from = (rank + dist) % size
         blkcount = min(have, size - have)
@@ -451,7 +457,6 @@ def scan_recursive_doubling(comm, send: np.ndarray, recv: np.ndarray,
     tmp = np.empty_like(send)
     mask = 1
     while mask < size:
-        peer = rank ^ mask if False else None
         lo_peer = rank - mask
         hi_peer = rank + mask
         reqs = []

@@ -159,17 +159,16 @@ class Communicator:
             for r in range(1, self.size):
                 self.ctx.p2p.recv(buf, self._world_dst(r), TAG_COMM_SPLIT, self.cid)
                 rows.append(buf.copy())
-            with self._lock:
-                base_cid = self._cid_counter
             colors = sorted({int(c) for c, _, _ in rows if c != -(1 << 62)})
+            with self._lock:   # atomic carve of len(colors) fresh CIDs
+                base_cid = self._cid_counter
+                self._cid_counter = base_cid + len(colors)
             assignments: List[tuple] = []
             for idx, c in enumerate(colors):
                 members = [(int(k), int(w)) for cc, k, w in rows if cc == c]
                 members.sort()
                 world_ranks = [w for _, w in members]
                 assignments.append((c, base_cid + idx, world_ranks))
-            with self._lock:
-                self._cid_counter = base_cid + len(colors)
             # scatter each member its (cid, members); rank 0 handles itself
             my_assign = None
             for c, cid, world_ranks in assignments:

@@ -27,6 +27,7 @@ class ProgressEngine:
         self._low: List[Callable[[], int]] = []
         self._lock = threading.RLock()
         self.polls = 0                  # lifetime pass count (SPC + low-pri gate)
+        self.time_waiting = 0.0         # seconds inside wait_until (SPC)
 
     def register(self, fn: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:
@@ -54,18 +55,22 @@ class ProgressEngine:
     def wait_until(self, cond: Callable[[], bool], timeout: float | None = None) -> bool:
         """Spin in progress() until cond() — the ompi_request_wait_completion
         pattern (reference ompi/request/request.h:129 wait loop)."""
-        deadline = None if timeout is None else time.monotonic() + timeout
+        start = time.monotonic()
+        deadline = None if timeout is None else start + timeout
         idle = 0
-        while not cond():
-            if self.progress() == 0:
-                idle += 1
-                if idle > 100:        # back off when nothing is moving
-                    time.sleep(0.0001)
-            else:
-                idle = 0
-            if deadline is not None and time.monotonic() > deadline:
-                return cond()
-        return True
+        try:
+            while not cond():
+                if self.progress() == 0:
+                    idle += 1
+                    if idle > 100:        # back off when nothing is moving
+                        time.sleep(0.0001)
+                else:
+                    idle = 0
+                if deadline is not None and time.monotonic() > deadline:
+                    return cond()
+            return True
+        finally:
+            self.time_waiting += time.monotonic() - start
 
 
 _tls = threading.local()

@@ -1,0 +1,14 @@
+"""One-sided communication (RMA) — windows, put/get/accumulate, sync.
+
+≙ the reference's ``osc`` framework (ompi/mca/osc/osc.h:370) with the
+``rdma`` component's design (ompi/mca/osc/rdma/osc_rdma.h:133): windows over
+the byte transports, with active-message emulation where the transport has no
+native put/get (opal/mca/btl/base/btl_base_am_rdma.c:1203-1207) — which on
+the host data plane here is always.  Device-resident one-sided access rides
+the ICI instead: see ``ompi_tpu.parallel`` (ppermute/all_to_all are the
+TPU-native remote-memory primitives).
+"""
+
+from .window import LOCK_EXCLUSIVE, LOCK_SHARED, Window, win_allocate
+
+__all__ = ["Window", "win_allocate", "LOCK_SHARED", "LOCK_EXCLUSIVE"]

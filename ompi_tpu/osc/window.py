@@ -1,0 +1,412 @@
+"""RMA windows over active messages (≙ ompi/mca/osc/rdma + AM-RDMA emulation).
+
+Every RMA operation is an active message serviced at the target inside its
+progress loop — the same passive-target property the reference gets from
+hardware RDMA or from the btl_base_am_rdma emulation
+(opal/mca/btl/base/btl_base_am_rdma.c:1203): the target application thread
+never has to post a matching call.
+
+Synchronization (≙ osc_rdma_active_target.c / osc_rdma_passive_target.c):
+  * ``fence``       — active target: flush local ops (every op is acked by
+                      the target *after* it is applied), then barrier.
+  * ``post/start/complete/wait`` — PSCW generalized active target.
+  * ``lock/unlock`` — passive target: shared/exclusive lock queue lives at
+                      the target; unlock acks only after grant + prior ops.
+  * ``flush``/``flush_all`` — passive-target completion without unlock.
+
+Atomicity: accumulate/get_accumulate/fetch_op/compare_and_swap hold the
+target window's apply-lock, giving MPI's per-window atomic-op guarantee.
+
+Ordering relies on the transport contract (transport.py): frames to the same
+peer+tag arrive in send order, so an unlock/complete AM arrives after the
+epoch's operation AMs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..op import NO_OP, REPLACE, SUM, Op
+from ..p2p import transport as T
+from ..p2p.request import Request
+
+LOCK_SHARED = 1
+LOCK_EXCLUSIVE = 2
+
+_OPS = {o.name: o for o in (SUM, REPLACE, NO_OP)}
+
+
+def register_op(op: Op) -> None:
+    """Make an Op usable in accumulate by wire name."""
+    _OPS[op.name] = op
+
+
+def _ensure_ops():
+    from .. import op as _op
+    for name in ("sum", "prod", "max", "min", "land", "lor", "lxor",
+                 "band", "bor", "bxor", "replace", "no_op"):
+        o = getattr(_op, name.upper(), None)
+        if o is not None:
+            _OPS[o.name] = o
+
+
+_ensure_ops()
+
+
+class _OscEngine:
+    """Per-rank singleton: owns the AM_OSC dispatch slot and the window
+    registry (window ids are collectively deterministic: every rank creates
+    windows in the same order on the same communicator)."""
+
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.windows: Dict[int, "Window"] = {}
+        self._oreq = 0
+        self._lock = threading.Lock()
+        # oreq → (request, payload sink for data-carrying replies or None)
+        self.pending: Dict[int, Tuple[Request, Any]] = {}
+        for t in ctx.layer.transports:
+            t.dispatch[T.AM_OSC] = self._am_handler
+
+    def next_oreq(self, req: Request, sink=None) -> int:
+        with self._lock:
+            self._oreq += 1
+            self.pending[self._oreq] = (req, sink)
+            return self._oreq
+
+    # -- target-side service (runs in progress context) ---------------------
+
+    def _am_handler(self, src: int, h: Dict[str, Any], payload: bytes) -> None:
+        k = h["k"]
+        if k in ("ack", "getdata", "fetched"):
+            req, sink = self.pending.pop(h["oreq"])
+            if k != "ack" and sink is not None:
+                sink(payload)
+            req.complete()
+            return
+        win = self.windows[h["win"]]
+        win._serve(src, h, payload)
+
+
+def _engine(ctx) -> _OscEngine:
+    eng = getattr(ctx, "_osc_engine", None)
+    if eng is None:
+        eng = _OscEngine(ctx)
+        ctx._osc_engine = eng
+    return eng
+
+
+class Window:
+    """An RMA window exposing a local numpy buffer to all ranks of a
+    communicator (≙ MPI_Win; ompi/win/win.h).  Created collectively."""
+
+    def __init__(self, comm, local: Optional[np.ndarray],
+                 name: str = "win") -> None:
+        self.comm = comm
+        self.local = local if local is not None else np.zeros(0, np.uint8)
+        if not self.local.flags["C_CONTIGUOUS"]:
+            raise ValueError("window buffer must be C-contiguous")
+        self.name = name
+        self.eng = _engine(comm.ctx)
+        # deterministic collective id: (cid, per-comm window counter)
+        seq = getattr(comm, "_win_seq", 0)
+        comm._win_seq = seq + 1
+        self.win_id = (comm.cid << 16) | seq
+        self.eng.windows[self.win_id] = self
+        self._apply_lock = threading.Lock()
+        # origin-side bookkeeping: outstanding reqs per target group-rank
+        self._outstanding: Dict[int, List[Request]] = {}
+        # target-side passive lock state
+        self._lock_state = 0            # 0 free, -1 exclusive, n>0 shared
+        self._lock_queue: List[Tuple[int, int, int]] = []  # (type, src, oreq)
+        self._lock_mutex = threading.Lock()
+        # PSCW state
+        self._posted_from: set = set()
+        self._complete_from: set = set()
+        self._pscw_target_group: Optional[list] = None
+        self._epoch_assert = 0
+        comm.barrier()   # window exists everywhere before any rank uses it
+
+    # -- construction helpers ----------------------------------------------
+
+    @property
+    def size_bytes(self) -> int:
+        return self.local.nbytes
+
+    def _target_world(self, rank: int) -> int:
+        return self.comm.group.world_of_rank(rank)
+
+    def _track(self, rank: int, req: Request) -> Request:
+        self._outstanding.setdefault(rank, []).append(req)
+        return req
+
+    # -- origin-side operations --------------------------------------------
+
+    def put(self, origin: np.ndarray, target_rank: int,
+            target_disp: int = 0) -> Request:
+        """Nonblocking put; completion = accepted+applied at target."""
+        a = np.ascontiguousarray(origin)
+        req = Request()
+        oreq = self.eng.next_oreq(req)
+        h = {"k": "put", "win": self.win_id, "disp": int(target_disp),
+             "dt": a.dtype.str, "shape": list(a.shape), "oreq": oreq}
+        self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
+                                 h, a.tobytes())
+        return self._track(target_rank, req)
+
+    def get(self, origin: np.ndarray, target_rank: int,
+            target_disp: int = 0) -> Request:
+        """Nonblocking get into ``origin`` (shape/dtype define the request)."""
+        req = Request()
+
+        def land(data: bytes) -> None:
+            np.copyto(origin.reshape(-1), np.frombuffer(data, dtype=origin.dtype))
+        oreq = self.eng.next_oreq(req, sink=land)
+        h = {"k": "get", "win": self.win_id, "disp": int(target_disp),
+             "dt": origin.dtype.str, "count": int(origin.size), "oreq": oreq}
+        self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
+                                 h, b"")
+        return self._track(target_rank, req)
+
+    def accumulate(self, origin: np.ndarray, target_rank: int,
+                   target_disp: int = 0, op: Op = SUM) -> Request:
+        a = np.ascontiguousarray(origin)
+        req = Request()
+        oreq = self.eng.next_oreq(req)
+        h = {"k": "acc", "win": self.win_id, "disp": int(target_disp),
+             "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
+             "oreq": oreq}
+        if op.name not in _OPS:
+            register_op(op)
+        self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
+                                 h, a.tobytes())
+        return self._track(target_rank, req)
+
+    def get_accumulate(self, origin: np.ndarray, result: np.ndarray,
+                       target_rank: int, target_disp: int = 0,
+                       op: Op = SUM) -> Request:
+        """Atomically fetch target data into ``result`` and combine origin
+        into the target (MPI_Get_accumulate; op=NO_OP → pure atomic fetch)."""
+        a = np.ascontiguousarray(origin)
+        req = Request()
+
+        def land(data: bytes) -> None:
+            np.copyto(result.reshape(-1),
+                      np.frombuffer(data, dtype=result.dtype))
+        oreq = self.eng.next_oreq(req, sink=land)
+        h = {"k": "getacc", "win": self.win_id, "disp": int(target_disp),
+             "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
+             "oreq": oreq}
+        self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
+                                 h, a.tobytes())
+        return self._track(target_rank, req)
+
+    def fetch_and_op(self, value, result: np.ndarray, target_rank: int,
+                     target_disp: int = 0, op: Op = SUM) -> Request:
+        """Single-element get_accumulate (MPI_Fetch_and_op)."""
+        origin = np.asarray([value], dtype=result.dtype) \
+            if np.ndim(value) == 0 else np.asarray(value, dtype=result.dtype)
+        return self.get_accumulate(origin, result, target_rank, target_disp, op)
+
+    def compare_and_swap(self, compare, origin, result: np.ndarray,
+                         target_rank: int, target_disp: int = 0) -> Request:
+        dt = result.dtype
+        payload = (np.asarray([compare], dt).tobytes()
+                   + np.asarray([origin], dt).tobytes())
+        req = Request()
+
+        def land(data: bytes) -> None:
+            np.copyto(result.reshape(-1), np.frombuffer(data, dtype=dt))
+        oreq = self.eng.next_oreq(req, sink=land)
+        h = {"k": "cas", "win": self.win_id, "disp": int(target_disp),
+             "dt": dt.str, "oreq": oreq}
+        self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
+                                 h, payload)
+        return self._track(target_rank, req)
+
+    # -- target-side service ------------------------------------------------
+
+    def _flat(self) -> np.ndarray:
+        return self.local.reshape(-1).view(self.local.dtype)
+
+    def _serve(self, src: int, h: Dict[str, Any], payload: bytes) -> None:
+        k = h["k"]
+        layer = self.comm.ctx.layer
+        if k == "put":
+            arr = np.frombuffer(payload, dtype=np.dtype(h["dt"]))
+            with self._apply_lock:
+                self._flat()[h["disp"]:h["disp"] + arr.size] = arr
+            layer.send(src, T.AM_OSC, {"k": "ack", "oreq": h["oreq"]}, b"")
+        elif k == "get":
+            with self._apply_lock:
+                data = self._flat()[h["disp"]:h["disp"] + h["count"]].tobytes()
+            layer.send(src, T.AM_OSC, {"k": "getdata", "oreq": h["oreq"]}, data)
+        elif k in ("acc", "getacc"):
+            arr = np.frombuffer(payload, dtype=np.dtype(h["dt"]))
+            op = _OPS[h["op"]]
+            with self._apply_lock:
+                view = self._flat()[h["disp"]:h["disp"] + arr.size]
+                if k == "getacc":
+                    fetched = view.tobytes()
+                view[...] = op(arr, view.copy())
+            if k == "acc":
+                layer.send(src, T.AM_OSC, {"k": "ack", "oreq": h["oreq"]}, b"")
+            else:
+                layer.send(src, T.AM_OSC,
+                           {"k": "fetched", "oreq": h["oreq"]}, fetched)
+        elif k == "cas":
+            dt = np.dtype(h["dt"])
+            cmp_v = np.frombuffer(payload[:dt.itemsize], dt)[0]
+            new_v = np.frombuffer(payload[dt.itemsize:], dt)[0]
+            with self._apply_lock:
+                view = self._flat()
+                old = view[h["disp"]]
+                if old == cmp_v:
+                    view[h["disp"]] = new_v
+            layer.send(src, T.AM_OSC, {"k": "fetched", "oreq": h["oreq"]},
+                       np.asarray([old], dt).tobytes())
+        elif k == "lock":
+            self._serve_lock(src, h)
+        elif k == "unlock":
+            with self._lock_mutex:
+                self._lock_state = 0 if h["type"] == LOCK_EXCLUSIVE \
+                    else max(0, self._lock_state - 1)
+                self._grant_waiters()
+            layer.send(src, T.AM_OSC, {"k": "ack", "oreq": h["oreq"]}, b"")
+        elif k == "post":
+            self._posted_from.add(src)
+        elif k == "complete":
+            self._complete_from.add(src)
+        else:
+            raise RuntimeError(f"unknown osc frame kind {k!r}")
+
+    def _serve_lock(self, src: int, h: Dict[str, Any]) -> None:
+        with self._lock_mutex:
+            typ = h["type"]
+            can = (self._lock_state == 0 if typ == LOCK_EXCLUSIVE
+                   else self._lock_state >= 0)
+            if can and not self._lock_queue:
+                self._lock_state = -1 if typ == LOCK_EXCLUSIVE \
+                    else self._lock_state + 1
+                grant = True
+            else:
+                self._lock_queue.append((typ, src, h["oreq"]))
+                grant = False
+        if grant:
+            self.comm.ctx.layer.send(src, T.AM_OSC,
+                                     {"k": "ack", "oreq": h["oreq"]}, b"")
+
+    def _grant_waiters(self) -> None:
+        # called with _lock_mutex held
+        while self._lock_queue:
+            typ, src, oreq = self._lock_queue[0]
+            if typ == LOCK_EXCLUSIVE:
+                if self._lock_state != 0:
+                    break
+                self._lock_state = -1
+            else:
+                if self._lock_state < 0:
+                    break
+                self._lock_state += 1
+            self._lock_queue.pop(0)
+            self.comm.ctx.layer.send(src, T.AM_OSC,
+                                     {"k": "ack", "oreq": oreq}, b"")
+            if typ == LOCK_EXCLUSIVE:
+                break
+
+    # -- synchronization ----------------------------------------------------
+
+    def flush(self, rank: int) -> None:
+        """Complete all outstanding ops to ``rank`` (MPI_Win_flush)."""
+        for r in self._outstanding.pop(rank, []):
+            r.wait()
+
+    def flush_all(self) -> None:
+        for rank in list(self._outstanding):
+            self.flush(rank)
+
+    def fence(self, assert_: int = 0) -> None:
+        """MPI_Win_fence: ends+starts an active-target epoch. Local ops are
+        acked-after-apply, so flush_all + barrier ⇒ all ops in the epoch are
+        complete everywhere (the osc/rdma fence recipe)."""
+        self.flush_all()
+        self.comm.barrier()
+
+    # PSCW (MPI_Win_post/start/complete/wait)
+
+    def post(self, group) -> None:
+        """Expose the window to ``group`` (target side)."""
+        self._pscw_origin_group = None
+        for w in group.world_ranks:
+            if w != self.comm.ctx.rank:
+                self.comm.ctx.layer.send(w, T.AM_OSC,
+                                         {"k": "post", "win": self.win_id}, b"")
+        self._pscw_post_group = set(group.world_ranks)
+
+    def start(self, group) -> None:
+        """Begin an access epoch to ``group`` (origin side): wait for posts."""
+        want = {w for w in group.world_ranks if w != self.comm.ctx.rank}
+        self._pscw_target_group = sorted(want)
+        self.comm.ctx.engine.wait_until(
+            lambda: want <= self._posted_from, timeout=60)
+        self._posted_from -= want
+
+    def complete(self) -> None:
+        """End the access epoch: flush, then notify targets."""
+        assert self._pscw_target_group is not None, "complete() without start()"
+        self.flush_all()
+        for w in self._pscw_target_group:
+            self.comm.ctx.layer.send(w, T.AM_OSC,
+                                     {"k": "complete", "win": self.win_id}, b"")
+        self._pscw_target_group = None
+
+    def wait(self) -> None:
+        """Target side: wait until every origin completed its epoch."""
+        want = {w for w in self._pscw_post_group if w != self.comm.ctx.rank}
+        self.comm.ctx.engine.wait_until(
+            lambda: want <= self._complete_from, timeout=60)
+        self._complete_from -= want
+
+    # Passive target (MPI_Win_lock/unlock)
+
+    def lock(self, rank: int, lock_type: int = LOCK_SHARED) -> None:
+        # self-locks loop back through the self transport like any peer
+        req = Request()
+        oreq = self.eng.next_oreq(req)
+        self.comm.ctx.layer.send(self._target_world(rank), T.AM_OSC,
+                                 {"k": "lock", "win": self.win_id,
+                                  "type": lock_type, "oreq": oreq}, b"")
+        req.wait(timeout=60)
+        self._held_locks = getattr(self, "_held_locks", {})
+        self._held_locks[rank] = lock_type
+
+    def unlock(self, rank: int) -> None:
+        self.flush(rank)
+        typ = self._held_locks.pop(rank)
+        req = Request()
+        oreq = self.eng.next_oreq(req)
+        self.comm.ctx.layer.send(self._target_world(rank), T.AM_OSC,
+                                 {"k": "unlock", "win": self.win_id,
+                                  "type": typ, "oreq": oreq}, b"")
+        req.wait(timeout=60)
+
+    def lock_all(self) -> None:
+        for r in range(self.comm.size):
+            self.lock(r, LOCK_SHARED)
+
+    def unlock_all(self) -> None:
+        for r in range(self.comm.size):
+            self.unlock(r)
+
+    def free(self) -> None:
+        self.comm.barrier()
+        self.eng.windows.pop(self.win_id, None)
+
+
+def win_allocate(comm, count: int, dtype=np.float64,
+                 name: str = "win") -> Window:
+    """MPI_Win_allocate: the window owns its buffer (``win.local``)."""
+    return Window(comm, np.zeros(count, dtype=np.dtype(dtype)), name=name)

@@ -128,6 +128,7 @@ class P2P:
     def send(self, buf, dst: int, tag: int = 0, cid: int = 0,
              datatype: Optional[Datatype] = None, count: Optional[int] = None,
              sync: bool = False) -> None:
+        self.spc.inc("sends")
         self.isend(buf, dst, tag, cid, datatype, count, sync).wait()
 
     # -- recv ---------------------------------------------------------------

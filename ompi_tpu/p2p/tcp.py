@@ -168,18 +168,20 @@ class TcpTransport(T.Transport):
         return events
 
     def _drain(self, conn: _Conn) -> int:
+        eof = False
         try:
             while True:
                 chunk = conn.sock.recv(1 << 18)
                 if not chunk:
-                    self._close(conn)
-                    return 0
+                    # peer closed — frames already buffered (sent just before
+                    # the close) must still be parsed and delivered below
+                    eof = True
+                    break
                 conn.inbuf.extend(chunk)
         except (BlockingIOError, InterruptedError):
             pass
         except OSError:
-            self._close(conn)
-            return 0
+            eof = True
         delivered = 0
         buf = conn.inbuf
         while len(buf) >= _HDR.size:
@@ -194,6 +196,8 @@ class TcpTransport(T.Transport):
             else:
                 self.deliver(src, tag, header, payload)
                 delivered += 1
+        if eof:
+            self._close(conn)
         return delivered
 
     def _close(self, conn: _Conn) -> None:

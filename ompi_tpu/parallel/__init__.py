@@ -39,11 +39,10 @@ from .collectives import (  # noqa: F401
 def attach_mesh(comm, mesh, axis: str) -> None:
     """Give a communicator a device mesh, enabling the coll/xla component
     (re-runs coll selection so xla outranks the host components)."""
-    if mesh.shape[axis] not in (comm.size, None) and comm.size != 1:
-        if mesh.shape[axis] != comm.size:
-            raise ValueError(
-                f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
-                f"comm {comm.name} has {comm.size} ranks")
+    if comm.size != 1 and mesh.shape[axis] != comm.size:
+        raise ValueError(
+            f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
+            f"comm {comm.name} has {comm.size} ranks")
     comm.device_mesh = mesh
     comm.device_axis = axis
     comm.device_comm = DeviceComm(mesh, axis)

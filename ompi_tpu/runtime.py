@@ -74,6 +74,7 @@ class Context:
         self.finalized = True
         from .core import var as _var
         self.spc._v["progress_polls"] = self.engine.polls
+        self.spc._v["time_in_wait"] = self.engine.time_waiting
         if _var.get("spc_dump_enabled", False):
             self.spc.dump(self.rank)
         try:

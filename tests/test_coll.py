@@ -30,18 +30,19 @@ def test_allreduce_sum(size):
 
 @pytest.mark.parametrize("alg", ["recursive_doubling", "ring", "rabenseifner"])
 @pytest.mark.parametrize("size", [3, 4])
-def test_allreduce_forced_algorithms(alg, size):
+@pytest.mark.parametrize("count", [1000, 10])   # 10: uneven recursive halving
+def test_allreduce_forced_algorithms(alg, size, count):
     var.registry.set_cli("coll_tuned_allreduce_algorithm", alg)
     var.register("coll", "tuned", "allreduce_algorithm", "")
     var.registry.reset_cache()
     try:
         def fn(ctx):
             c = world(ctx)
-            send = (np.arange(1000, dtype=np.float64) * (c.rank + 1))
+            send = (np.arange(count, dtype=np.float64) * (c.rank + 1))
             return c.coll.allreduce(c, send)
 
         res = runtime.run_ranks(size, fn)
-        expect = sum(np.arange(1000, dtype=np.float64) * (r + 1)
+        expect = sum(np.arange(count, dtype=np.float64) * (r + 1)
                      for r in range(size))
         for r in res:
             np.testing.assert_allclose(r, expect)

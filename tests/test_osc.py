@@ -1,0 +1,176 @@
+"""One-sided (RMA) tests — windows, put/get/accumulate/atomics, sync modes.
+
+Mirrors the reference's one-sided semantics (ompi/mca/osc/): fence epochs,
+PSCW, passive-target lock/unlock, and per-window atomic ops.
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime
+from ompi_tpu.op import MIN, NO_OP, SUM
+from ompi_tpu.osc import LOCK_EXCLUSIVE, Window, win_allocate
+
+
+def run(n, fn):
+    return runtime.run_ranks(n, fn, timeout=90)
+
+
+def test_put_get_fence():
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 8, np.float64)
+        win.local[:] = comm.rank
+        win.fence()
+        # everyone puts its rank into slot [rank] of right neighbor's window
+        right = (comm.rank + 1) % comm.size
+        win.put(np.full(1, float(comm.rank)), right, target_disp=comm.rank)
+        win.fence()
+        got = np.zeros(1)
+        left = (comm.rank - 1) % comm.size
+        win.get(got, left, target_disp=left)
+        win.flush(left)
+        assert got[0] == float(left)
+        # the value our left neighbor put into *our* window
+        assert win.local[left] == float(left)
+        win.free()
+        return True
+    assert all(run(4, body))
+
+
+def test_accumulate_sum_and_min():
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 4, np.int64)
+        win.fence()
+        # all ranks accumulate into rank 0
+        win.accumulate(np.arange(4, dtype=np.int64), 0, op=SUM)
+        win.fence()
+        if comm.rank == 0:
+            np.testing.assert_array_equal(win.local, np.arange(4) * comm.size)
+        win.fence()
+        win.accumulate(np.full(4, comm.rank, np.int64), 1, op=MIN)
+        win.fence()
+        if comm.rank == 1:
+            np.testing.assert_array_equal(win.local, np.zeros(4, np.int64))
+        win.free()
+        return True
+    assert all(run(3, body))
+
+
+def test_fetch_and_op_counter():
+    """Classic atomic ticket counter: every rank increments rank 0's slot;
+    fetched values must be a permutation of 0..N-1."""
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 1, np.int64)
+        win.fence()
+        old = np.zeros(1, np.int64)
+        win.fetch_and_op(1, old, 0, 0, SUM).wait()
+        win.fence()
+        if comm.rank == 0:
+            assert win.local[0] == comm.size
+        # gather tickets at rank 0 to verify uniqueness
+        if comm.rank == 0:
+            tickets = [int(old[0])]
+            buf = np.zeros(1, np.int64)
+            for r in range(1, comm.size):
+                comm.recv(buf, r, 77)
+                tickets.append(int(buf[0]))
+            assert sorted(tickets) == list(range(comm.size))
+        else:
+            comm.send(old, 0, 77)
+        win.free()
+        return True
+    assert all(run(4, body))
+
+
+def test_compare_and_swap():
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 1, np.int64)
+        win.fence()
+        result = np.zeros(1, np.int64)
+        # every rank tries to CAS 0→rank+1 at rank 0; exactly one wins
+        win.compare_and_swap(0, comm.rank + 1, result, 0, 0).wait()
+        win.fence()
+        won = int(result[0]) == 0
+        if comm.rank == 0:
+            winner = int(win.local[0])
+            assert 1 <= winner <= comm.size
+        win.free()
+        return won
+    results = run(4, body)
+    assert sum(results) == 1   # exactly one CAS succeeded
+
+
+def test_get_accumulate_noop_is_atomic_read():
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 2, np.float64)
+        win.local[:] = [comm.rank * 10.0, comm.rank * 10.0 + 1]
+        win.fence()
+        res = np.zeros(2)
+        peer = (comm.rank + 1) % comm.size
+        win.get_accumulate(np.zeros(2), res, peer, 0, op=NO_OP).wait()
+        win.fence()
+        np.testing.assert_array_equal(res, [peer * 10.0, peer * 10.0 + 1])
+        win.free()
+        return True
+    assert all(run(3, body))
+
+
+def test_pscw():
+    """Generalized active target: even ranks expose, odd ranks access."""
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 1, np.float64)
+        evens = comm.group.incl([0, 2])
+        odds = comm.group.incl([1, 3])
+        if comm.rank % 2 == 0:
+            win.post(odds)
+            win.wait()
+            assert win.local[0] != 0.0
+        else:
+            win.start(evens)
+            for t in (0, 2):
+                win.put(np.full(1, float(comm.rank)), t, 0)
+            win.complete()
+        win.free()
+        return True
+    assert all(run(4, body))
+
+
+def test_passive_lock_unlock():
+    def body(ctx):
+        comm = ctx.comm_world
+        win = win_allocate(comm, 1, np.int64)
+        comm.barrier()
+        for _ in range(5):
+            win.lock(0, LOCK_EXCLUSIVE)
+            cur = np.zeros(1, np.int64)
+            win.get(cur, 0, 0)
+            win.flush(0)
+            win.put(cur + 1, 0, 0)
+            win.unlock(0)
+        comm.barrier()
+        if comm.rank == 0:
+            assert win.local[0] == 5 * comm.size
+        win.free()
+        return True
+    assert all(run(3, body))
+
+
+def test_window_create_from_existing_buffer():
+    def body(ctx):
+        comm = ctx.comm_world
+        buf = np.arange(6, dtype=np.float32)
+        win = Window(comm, buf, name="user-buf")
+        win.fence()
+        got = np.zeros(6, np.float32)
+        win.get(got, (comm.rank + 1) % comm.size, 0)
+        win.flush((comm.rank + 1) % comm.size)
+        np.testing.assert_array_equal(got, np.arange(6, dtype=np.float32))
+        win.free()
+        return True
+    assert all(run(2, body))
