@@ -4,6 +4,7 @@ the in-tree components."""
 from .framework import COLL_FUNCTIONS, CollModule, CollTable, attach_coll  # noqa: F401
 from . import basic  # noqa: F401  (register coll/basic)
 from . import selfcoll  # noqa: F401  (register coll/self)
+from . import nbc  # noqa: F401  (register coll/nbc — schedule-based i*)
 
 # tuned and xla register on import too; tolerate partial availability during
 # bring-up of a reduced build

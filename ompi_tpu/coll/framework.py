@@ -96,7 +96,7 @@ def _ensure_components() -> None:
     analog of the reference opening a framework's components before any
     selection (mca_base_framework.c:161)."""
     import importlib
-    for m in ("basic", "selfcoll", "tuned", "xla"):
+    for m in ("basic", "selfcoll", "tuned", "xla", "nbc"):
         try:
             importlib.import_module(f"{__package__}.{m}")
         except ImportError:  # pragma: no cover — reduced build
@@ -120,6 +120,10 @@ def attach_coll(comm) -> None:
         for fn in COLL_FUNCTIONS:
             if module.enabled(fn):
                 entries[fn] = module
+            # true non-blocking schedules (coll/nbc) outrank the derived
+            # eager i* wrappers in CollTable.__getattr__
+            if module.enabled("i" + fn):
+                entries["i" + fn] = module
     comm.coll = CollTable(entries, sorted(rows, key=lambda r: -r[0]))
     output.verbose(10, "coll",
                    f"comm {comm.name}: " +

@@ -1,0 +1,399 @@
+"""Non-blocking collectives as progress-driven schedules (≙ coll/libnbc).
+
+The reference compiles each non-blocking collective into a round-based
+*schedule* of send/recv/op/copy primitives (nbc_internal.h:156-160) advanced
+by the progress engine (NBC_Progress, nbc.c:320): a round's operations all
+start together; the next round starts when every operation of the current
+round has completed. The calling thread never blocks — completion is
+observed via the returned request.
+
+Tag isolation: every schedule instance draws a tag from a reserved cycling
+space (the reference does the same with its own tag space) so concurrent
+collectives on one communicator can't cross-match; ranks agree on the tag
+because collectives are issued in the same order everywhere (MPI ordering
+rule).
+
+Persistent collectives (MPI-4 *_init, coll.h:580-587) wrap a schedule
+factory: each ``start()`` builds and launches a fresh schedule over the same
+arguments, reusing buffers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.component import Component, component
+from ..op import Op, SUM
+from ..p2p.request import Request
+from .framework import CollModule
+
+# reserved cycling tag space for nbc schedules (user ≥ 0; comm mgmt -10..;
+# blocking coll -100..; nbc -200..-999)
+_NBC_TAG_BASE = -200
+_NBC_TAG_SPAN = 800
+
+
+def _nbc_tag(comm) -> int:
+    seq = getattr(comm, "_nbc_seq", 0)
+    comm._nbc_seq = seq + 1
+    return _NBC_TAG_BASE - (seq % _NBC_TAG_SPAN)
+
+
+class Schedule:
+    """Rounds of primitives. Ops:
+    ("send", array, peer, tag) / ("recv", array, peer, tag) — comm ops;
+    ("copy", src, dst) / ("op", op, src, dst) — local, run when the round
+    starts (dst = op(src, dst))."""
+
+    def __init__(self, comm, rounds: List[List[Tuple]],
+                 result: Any = None) -> None:
+        self.comm = comm
+        self.rounds = rounds
+        self.request = Request()
+        self.request.result = None     # type: ignore[attr-defined]
+        self._result = result
+        self._round = -1
+        self._inflight: List[Request] = []
+        self._started = False
+
+    def start(self) -> Request:
+        assert not self._started
+        self._started = True
+        self.comm.ctx.engine.register(self._progress)
+        self._advance()
+        return self.request
+
+    def _advance(self) -> None:
+        while True:
+            self._round += 1
+            self._inflight = []
+            if self._round >= len(self.rounds):
+                self.comm.ctx.engine.unregister(self._progress)
+                self.request.result = self._result   # type: ignore[attr-defined]
+                self.request.complete()
+                return
+            for op in self.rounds[self._round]:
+                kind = op[0]
+                if kind == "send":
+                    _, buf, peer, tag = op
+                    self._inflight.append(self.comm.isend(buf, peer, tag))
+                elif kind == "recv":
+                    _, buf, peer, tag = op
+                    self._inflight.append(self.comm.irecv(buf, peer, tag))
+                elif kind == "copy":
+                    _, src, dst = op
+                    np.copyto(dst, src)
+                elif kind == "op":
+                    _, theop, src, dst = op
+                    dst[...] = theop(src, dst.copy())
+                else:
+                    raise RuntimeError(f"unknown schedule op {kind!r}")
+            if self._inflight:
+                return       # wait for this round's comm ops
+            # local-only round: fall through to the next immediately
+
+    def _progress(self) -> int:
+        if not self._inflight or not all(r.done for r in self._inflight):
+            return 0
+        for r in self._inflight:
+            if r.error is not None:
+                self.comm.ctx.engine.unregister(self._progress)
+                self.request.complete(r.error)
+                return 1
+        self._advance()
+        return 1
+
+
+# ---------------------------------------------------------------------------
+# schedule builders (round-based classics, ≙ libnbc's algorithm set)
+# ---------------------------------------------------------------------------
+
+def sched_barrier(comm) -> Schedule:
+    """Dissemination barrier (≙ nbc ibarrier): ceil(log2 p) rounds."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    rounds = []
+    dist = 1
+    token = np.zeros(1, np.int8)
+    while dist < size:
+        to = (rank + dist) % size
+        frm = (rank - dist) % size
+        rounds.append([("send", token, to, tag),
+                       ("recv", np.zeros(1, np.int8), frm, tag)])
+        dist <<= 1
+    return Schedule(comm, rounds)
+
+
+def sched_bcast(comm, buf: np.ndarray, root: int) -> Schedule:
+    """Binomial-tree ibcast, one round per doubling step: at round t the
+    ranks with vrank < 2^t send to vrank + 2^t — so a rank's sends sit in
+    rounds strictly after its receive round."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    buf = np.asarray(buf)
+    vrank = (rank - root) % size
+    nrounds = max(1, (size - 1).bit_length())
+    rounds: List[List[Tuple]] = [[] for _ in range(nrounds)]
+    if vrank > 0:
+        t_recv = vrank.bit_length() - 1          # round of my highest bit
+        parent = ((vrank - (1 << t_recv)) + root) % size
+        rounds[t_recv].append(("recv", buf, parent, tag))
+    for t in range(nrounds):
+        if vrank < (1 << t):
+            child = vrank + (1 << t)
+            if child < size:
+                rounds[t].append(("send", buf, (child + root) % size, tag))
+    return Schedule(comm, [r for r in rounds if r] or [[]], result=buf)
+
+
+def sched_reduce(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                 root: int, op: Op) -> Schedule:
+    """Binomial-tree ireduce (commutative ops): leaves send up each level."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    acc = send.copy()
+    vrank = (rank - root) % size
+    rounds: List[List[Tuple]] = []
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + root) % size
+            rounds.append([("send", acc, parent, tag)])
+            break
+        child = vrank | mask
+        if child < size:
+            inbox = np.empty_like(acc)
+            rounds.append([("recv", inbox, (child + root) % size, tag)])
+            rounds.append([("op", op, inbox, acc)])
+        mask <<= 1
+    result = None
+    if rank == root:
+        if recv is None:
+            recv = np.empty_like(send)
+        rounds.append([("copy", acc, recv)])
+        result = recv
+    return Schedule(comm, rounds or [[]], result=result)
+
+
+def sched_allreduce(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                    op: Op) -> Schedule:
+    """Recursive-doubling iallreduce (pads to any p via pre/post phases)."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    if recv is None:
+        recv = np.empty_like(send)
+    rounds: List[List[Tuple]] = [[("copy", send, recv)]]
+    pof2 = 1 << (size.bit_length() - 1) if size else 1
+    rem = size - pof2
+    newrank = -1
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            rounds.append([("send", recv, rank + 1, tag)])
+        else:
+            inbox0 = np.empty_like(recv)
+            rounds.append([("recv", inbox0, rank - 1, tag)])
+            rounds.append([("op", op, inbox0, recv)])
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
+    if newrank >= 0:
+        mask = 1
+        while mask < pof2:
+            peer_new = newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            inbox = np.empty_like(recv)
+            rounds.append([("send", recv, peer, tag),
+                           ("recv", inbox, peer, tag)])
+            rounds.append([("op", op, inbox, recv)])
+            mask <<= 1
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            rounds.append([("recv", recv, rank + 1, tag)])
+        else:
+            rounds.append([("send", recv, rank - 1, tag)])
+    return Schedule(comm, rounds, result=recv)
+
+
+def sched_allgather(comm, send: np.ndarray, recv: Optional[np.ndarray]
+                    ) -> Schedule:
+    """Ring iallgather: p-1 rounds of neighbor exchange."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    if recv is None:
+        recv = np.empty((size,) + send.shape, send.dtype)
+    parts = recv.reshape((size, -1))
+    rounds: List[List[Tuple]] = [[("copy", send.reshape(-1), parts[rank])]]
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        blk_send = (rank - step) % size
+        blk_recv = (rank - step - 1) % size
+        rounds.append([("send", parts[blk_send], right, tag),
+                       ("recv", parts[blk_recv], left, tag)])
+    return Schedule(comm, rounds, result=recv)
+
+
+def sched_alltoall(comm, send: np.ndarray, recv: Optional[np.ndarray]
+                   ) -> Schedule:
+    """Linear ialltoall: one round, all pairs in flight (nbc a2a linear)."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    sparts = send.reshape((size, -1))
+    if recv is None:
+        recv = np.empty_like(send)
+    rparts = recv.reshape((size, -1))
+    ops: List[Tuple] = [("copy", sparts[rank], rparts[rank])]
+    for peer in range(size):
+        if peer != rank:
+            ops.append(("send", sparts[peer], peer, tag))
+            ops.append(("recv", rparts[peer], peer, tag))
+    return Schedule(comm, [ops], result=recv)
+
+
+def sched_gather(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                 root: int) -> Schedule:
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    if rank == root:
+        if recv is None:
+            recv = np.empty((size,) + send.shape, send.dtype)
+        parts = recv.reshape((size, -1))
+        ops: List[Tuple] = [("copy", send.reshape(-1), parts[root])]
+        ops += [("recv", parts[src], src, tag)
+                for src in range(size) if src != root]
+        return Schedule(comm, [ops], result=recv)
+    return Schedule(comm, [[("send", send, root, tag)]])
+
+
+def sched_scatter(comm, send: Optional[np.ndarray], recv: np.ndarray,
+                  root: int) -> Schedule:
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    recv = np.asarray(recv)
+    if rank == root:
+        assert send is not None
+        parts = np.asarray(send).reshape((size, -1))
+        ops: List[Tuple] = [("copy", parts[root], recv.reshape(-1))]
+        ops += [("send", np.ascontiguousarray(parts[dst]), dst, tag)
+                for dst in range(size) if dst != root]
+        return Schedule(comm, [ops], result=recv)
+    return Schedule(comm, [[("recv", recv, root, tag)]], result=recv)
+
+
+def sched_reduce_scatter_block(comm, send: np.ndarray,
+                               recv: Optional[np.ndarray], op: Op) -> Schedule:
+    """ireduce_scatter_block as reduce rounds + scatter round (nonoverlapping
+    composition, ≙ coll_base_reduce_scatter.c:47 nonoverlapping)."""
+    size, rank = comm.size, comm.rank
+    send = np.asarray(send)
+    parts = send.reshape((size, -1))
+    if recv is None:
+        recv = np.empty(parts.shape[1:], send.dtype)
+    tag = _nbc_tag(comm)
+    # pairwise-exchange reduce-scatter: p-1 single-op rounds (any p)
+    acc = parts[rank].copy()
+    rounds: List[List[Tuple]] = []
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        inbox = np.empty_like(acc)
+        rounds.append([("send", np.ascontiguousarray(parts[to]), to, tag),
+                       ("recv", inbox, frm, tag)])
+        rounds.append([("op", op, inbox, acc)])
+    rounds.append([("copy", acc, recv.reshape(-1))])
+    return Schedule(comm, rounds, result=recv)
+
+
+class NbcModule(CollModule):
+    """Registers true-schedule i* entry points; the coll table prefers these
+    over the derived eager wrappers."""
+
+    def ibarrier(self, comm):
+        return sched_barrier(comm).start()
+
+    def ibcast(self, comm, buf, root: int = 0):
+        return sched_bcast(comm, buf, root).start()
+
+    def ireduce(self, comm, sendbuf, recvbuf=None, op: Op = SUM,
+                root: int = 0):
+        if not op.commutative:
+            raise ValueError("nbc ireduce requires a commutative op "
+                             "(use the blocking in-order reduce)")
+        return sched_reduce(comm, sendbuf, recvbuf, root, op).start()
+
+    def iallreduce(self, comm, sendbuf, recvbuf=None, op: Op = SUM):
+        return sched_allreduce(comm, sendbuf, recvbuf, op).start()
+
+    def iallgather(self, comm, sendbuf, recvbuf=None):
+        return sched_allgather(comm, sendbuf, recvbuf).start()
+
+    def ialltoall(self, comm, sendbuf, recvbuf=None):
+        return sched_alltoall(comm, sendbuf, recvbuf).start()
+
+    def igather(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        return sched_gather(comm, sendbuf, recvbuf, root).start()
+
+    def iscatter(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        if recvbuf is None:
+            if comm.rank != root:   # same contract as the blocking scatter
+                raise ValueError("non-root iscatter needs recvbuf")
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty(sb.reshape((comm.size, -1)).shape[1:], sb.dtype)
+        return sched_scatter(comm, sendbuf, recvbuf, root).start()
+
+    def ireduce_scatter_block(self, comm, sendbuf, recvbuf=None, op: Op = SUM):
+        return sched_reduce_scatter_block(comm, sendbuf, recvbuf, op).start()
+
+
+@component("coll", "nbc", priority=40)
+class NbcColl(Component):
+    name = "nbc"
+
+    def query(self, comm):
+        return self.priority, NbcModule()
+
+
+# ---------------------------------------------------------------------------
+# persistent collectives (MPI-4 *_init, coll.h:580-587)
+# ---------------------------------------------------------------------------
+
+class PersistentColl:
+    """MPI_*_init analog: ``start()`` launches a fresh schedule over the
+    bound arguments; ``wait()``/the returned request completes it. Reusable
+    any number of times; inactive between wait and the next start."""
+
+    def __init__(self, factory: Callable[[], Request]) -> None:
+        self._factory = factory
+        self._active: Optional[Request] = None
+
+    def start(self) -> Request:
+        if self._active is not None and not self._active.done:
+            raise RuntimeError("persistent collective started while active")
+        self._active = self._factory()
+        return self._active
+
+    def wait(self):
+        assert self._active is not None, "wait() before start()"
+        st = self._active.wait()
+        result = getattr(self._active, "result", None)
+        self._active = None
+        return result if result is not None else st
+
+    def test(self) -> bool:
+        return self._active is not None and self._active.test()
+
+
+def persistent(comm, name: str, *args, **kw) -> PersistentColl:
+    """Build a persistent handle for any i<name> entry point:
+    ``persistent(comm, "allreduce", send, recv)`` ≙ MPI_Allreduce_init."""
+    iname = "i" + name
+
+    def factory() -> Request:
+        return getattr(comm.coll, iname)(comm, *args, **kw)
+    return PersistentColl(factory)

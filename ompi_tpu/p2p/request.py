@@ -24,12 +24,13 @@ class Status:
 class Request:
     """A pending communication. Completion is driven by the progress engine."""
 
-    __slots__ = ("done", "status", "error", "_on_complete", "_ctx")
+    __slots__ = ("done", "status", "error", "result", "_on_complete", "_ctx")
 
     def __init__(self) -> None:
         self.done = False
         self.status = Status()
         self.error: Optional[Exception] = None
+        self.result: Any = None       # collective/value-carrying completions
         self._on_complete: List[Callable[["Request"], None]] = []
         self._ctx: Any = None
 

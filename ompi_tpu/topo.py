@@ -1,0 +1,226 @@
+"""Process topologies — cartesian, graph, distributed graph (≙ ompi/mca/topo).
+
+The reference's topo framework (ompi/mca/topo/base + topo/basic) attaches a
+topology object to a communicator, powering MPI_Cart_*/MPI_Graph_* queries
+and the neighborhood collectives (implemented here in coll/basic's
+neighbor_* entry points, which read ``comm.topo``).
+
+TPU-first remap note: the reference's topo/treematch component reorders
+ranks so the communication graph matches the hardware tree (hwloc). The
+equivalent here is ``parallel.mesh``'s device-mesh axis assignment — ICI is
+a literal torus, so a cartesian topology whose dims match the mesh maps
+neighbor exchange onto single-hop ICI ``ppermute`` (see
+parallel/collectives.ring_shift). ``cart_to_mesh_axes`` exposes that
+mapping for device-resident halo exchange.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def dims_create(nnodes: int, ndims: int,
+                dims: Optional[Sequence[int]] = None) -> List[int]:
+    """MPI_Dims_create: factor nnodes into a balanced ndims grid.
+    Zero entries in ``dims`` are free; nonzero are constraints."""
+    out = [0] * ndims if dims is None else list(dims)
+    fixed = 1
+    free_idx = [i for i, d in enumerate(out) if d == 0]
+    for d in out:
+        if d:
+            fixed *= d
+    if not free_idx:
+        if fixed != nnodes:
+            raise ValueError(f"dims {out} do not multiply to {nnodes}")
+        return out
+    rem, nfree = nnodes, len(free_idx)
+    if rem % fixed:
+        raise ValueError(f"{nnodes} not divisible by fixed dims {out}")
+    rem //= fixed
+    # greedy: pull out the largest factor ≤ rem^(1/k) for each free slot
+    factors = []
+    n = rem
+    p = 2
+    while p * p <= n:
+        while n % p == 0:
+            factors.append(p)
+            n //= p
+        p += 1
+    if n > 1:
+        factors.append(n)
+    sizes = [1] * nfree
+    for f in sorted(factors, reverse=True):
+        sizes[int(np.argmin(sizes))] *= f
+    for i, s in zip(free_idx, sorted(sizes, reverse=True)):
+        out[i] = s
+    return out
+
+
+class CartTopo:
+    """Cartesian topology (≙ topo/base cart; MPI_Cart_create)."""
+
+    kind = "cart"
+
+    def __init__(self, dims: Sequence[int], periods: Sequence[bool]) -> None:
+        self.dims = list(dims)
+        self.periods = list(periods)
+        if len(self.dims) != len(self.periods):
+            raise ValueError("dims and periods must have the same length")
+        self.size = int(np.prod(self.dims)) if self.dims else 1
+
+    # row-major rank layout, like the reference
+
+    def coords(self, rank: int) -> List[int]:
+        out = []
+        for d in reversed(self.dims):
+            out.append(rank % d)
+            rank //= d
+        return list(reversed(out))
+
+    def rank_of(self, coords: Sequence[int]) -> int:
+        rank = 0
+        for c, d, p in zip(coords, self.dims, self.periods):
+            if c < 0 or c >= d:
+                if not p:
+                    raise ValueError(f"coordinate {c} out of range for "
+                                     f"non-periodic dim of size {d}")
+                c %= d
+            rank = rank * d + c
+        return rank
+
+    def shift(self, rank: int, dim: int, disp: int = 1
+              ) -> Tuple[Optional[int], Optional[int]]:
+        """MPI_Cart_shift → (source, dest); None ≙ MPI_PROC_NULL at a
+        non-periodic boundary."""
+        c = self.coords(rank)
+
+        def at(offset):
+            cc = list(c)
+            cc[dim] += offset
+            if not self.periods[dim] and not (0 <= cc[dim] < self.dims[dim]):
+                return None
+            return self.rank_of(cc)
+        return at(-disp), at(disp)
+
+    def neighbors(self, rank: int) -> List[int]:
+        """Neighbor order fixed by the standard: for each dim, -1 then +1."""
+        out = []
+        for dim in range(len(self.dims)):
+            src, dst = self.shift(rank, dim, 1)
+            out.extend([src, dst])
+        return [n for n in out if n is not None]
+
+    # neighborhood-collective interface (coll/basic neighbor_*)
+    def in_neighbors(self, rank: int) -> List[int]:
+        return self.neighbors(rank)
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return self.neighbors(rank)
+
+
+class GraphTopo:
+    """General graph topology (MPI_Graph_create): undirected adjacency."""
+
+    kind = "graph"
+
+    def __init__(self, index: Sequence[int], edges: Sequence[int]) -> None:
+        # the classic MPI compressed format: index[i] = end of rank i's edges
+        self.index = list(index)
+        self.edges = list(edges)
+        self.size = len(self.index)
+
+    def neighbors(self, rank: int) -> List[int]:
+        lo = self.index[rank - 1] if rank > 0 else 0
+        return self.edges[lo:self.index[rank]]
+
+    in_neighbors = neighbors
+    out_neighbors = neighbors
+
+
+class DistGraphTopo:
+    """Distributed graph (MPI_Dist_graph_create_adjacent): directed, local."""
+
+    kind = "dist_graph"
+
+    def __init__(self, sources: Sequence[int], destinations: Sequence[int]
+                 ) -> None:
+        self.sources = list(sources)
+        self.destinations = list(destinations)
+
+    def in_neighbors(self, rank: int) -> List[int]:
+        return self.sources
+
+    def out_neighbors(self, rank: int) -> List[int]:
+        return self.destinations
+
+
+# ---------------------------------------------------------------------------
+# communicator-level constructors (≙ ompi/mpi/c/cart_create.c etc.)
+# ---------------------------------------------------------------------------
+
+def cart_create(comm, dims: Sequence[int], periods: Optional[Sequence[bool]]
+                = None, reorder: bool = False, name: str = "cart"):
+    """MPI_Cart_create: returns a new communicator with ``comm.topo`` set,
+    or None for ranks beyond the grid. ``reorder`` is accepted and ignored
+    (rank order already matches the mesh axis order — see module docstring)."""
+    periods = [False] * len(dims) if periods is None else list(periods)
+    topo = CartTopo(dims, periods)
+    if topo.size > comm.size:
+        raise ValueError(f"cartesian grid {dims} needs {topo.size} ranks, "
+                         f"comm has {comm.size}")
+    color = 0 if comm.rank < topo.size else None
+    newcomm = comm.split(color, key=comm.rank, name=name)
+    if newcomm is not None:
+        newcomm.topo = topo
+    return newcomm
+
+
+def cart_sub(comm, remain_dims: Sequence[bool], name: str = "cart_sub"):
+    """MPI_Cart_sub: slice the grid keeping only remain_dims axes."""
+    topo: CartTopo = comm.topo
+    coords = topo.coords(comm.rank)
+    # color = coordinates along dropped dims; key = rank within kept dims
+    color = 0
+    for c, d, keep in zip(coords, topo.dims, remain_dims):
+        if not keep:
+            color = color * d + c
+    sub = comm.split(color, key=comm.rank, name=name)
+    if sub is not None:
+        sub.topo = CartTopo([d for d, k in zip(topo.dims, remain_dims) if k],
+                            [p for p, k in zip(topo.periods, remain_dims) if k])
+    return sub
+
+
+def graph_create(comm, index: Sequence[int], edges: Sequence[int],
+                 reorder: bool = False, name: str = "graph"):
+    topo = GraphTopo(index, edges)
+    if topo.size > comm.size:
+        raise ValueError("graph larger than communicator")
+    color = 0 if comm.rank < topo.size else None
+    newcomm = comm.split(color, key=comm.rank, name=name)
+    if newcomm is not None:
+        newcomm.topo = topo
+    return newcomm
+
+
+def dist_graph_create_adjacent(comm, sources: Sequence[int],
+                               destinations: Sequence[int],
+                               reorder: bool = False,
+                               name: str = "dist_graph"):
+    """Adjacent variant only (the general MPI_Dist_graph_create requires an
+    edge-exchange; adjacent covers the common halo/stencil use)."""
+    newcomm = comm.dup(name=name)
+    newcomm.topo = DistGraphTopo(sources, destinations)
+    return newcomm
+
+
+def cart_to_mesh_axes(topo: CartTopo, mesh) -> Optional[List[str]]:
+    """Match cartesian dims onto device-mesh axes (same sizes, in order) so
+    halo exchange can ride single-hop ICI ppermute; None if no clean match."""
+    axes = list(mesh.shape.keys())
+    sizes = [mesh.shape[a] for a in axes]
+    if sizes[:len(topo.dims)] == list(topo.dims):
+        return axes[:len(topo.dims)]
+    return None

@@ -1,0 +1,189 @@
+"""Non-blocking collective schedule tests (≙ coll/libnbc) + persistent
+collectives (MPI-4 *_init)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime
+from ompi_tpu.coll.nbc import persistent
+from ompi_tpu.op import SUM, MAX
+from ompi_tpu.p2p.request import wait_all
+
+
+def run(n, fn):
+    return runtime.run_ranks(n, fn, timeout=90)
+
+
+def test_iallreduce_overlap_with_p2p():
+    """The point of nbc: p2p traffic proceeds while the collective is in
+    flight, and the schedule is driven purely by the progress engine."""
+    def body(ctx):
+        comm = ctx.comm_world
+        send = np.arange(64, dtype=np.float64) + comm.rank
+        req = comm.coll.iallreduce(comm, send)
+        # interleave unrelated p2p while the schedule progresses
+        peer = (comm.rank + 1) % comm.size
+        left = (comm.rank - 1) % comm.size
+        out = np.zeros(4)
+        st = comm.sendrecv(np.full(4, float(comm.rank)), peer, out, left,
+                           sendtag=5, recvtag=5)
+        assert out[0] == float(left)
+        req.wait()
+        expect = sum(np.arange(64) + r for r in range(comm.size))
+        np.testing.assert_allclose(req.result, expect)
+        return True
+    assert all(run(4, body))
+
+
+@pytest.mark.parametrize("size", [2, 3, 5])
+def test_iallreduce_nonpow2(size):
+    def body(ctx):
+        comm = ctx.comm_world
+        send = np.full(7, float(comm.rank + 1))
+        req = comm.coll.iallreduce(comm, send, op=MAX)
+        req.wait()
+        np.testing.assert_array_equal(req.result, np.full(7, float(comm.size)))
+        return True
+    assert all(run(size, body))
+
+
+def test_ibarrier_is_actually_nonblocking():
+    """Rank 0 delays entering; others' ibarrier must not complete early."""
+    import time
+
+    def body(ctx):
+        comm = ctx.comm_world
+        if comm.rank == 0:
+            time.sleep(0.3)
+            comm.coll.ibarrier(comm).wait()
+            return True
+        req = comm.coll.ibarrier(comm)
+        t0 = time.monotonic()
+        # test() polls; must stay incomplete until rank 0 arrives
+        assert not req.test()
+        req.wait()
+        assert time.monotonic() - t0 > 0.1
+        return True
+    assert all(run(3, body))
+
+
+@pytest.mark.parametrize("root", [0, 2])
+def test_ibcast_binomial(root):
+    def body(ctx):
+        comm = ctx.comm_world
+        buf = (np.arange(16, dtype=np.int64) if comm.rank == root
+               else np.zeros(16, np.int64))
+        req = comm.coll.ibcast(comm, buf, root=root)
+        req.wait()
+        np.testing.assert_array_equal(buf, np.arange(16))
+        return True
+    assert all(run(4, body))
+
+
+def test_ireduce_igather_iscatter():
+    def body(ctx):
+        comm = ctx.comm_world
+        r1 = comm.coll.ireduce(comm, np.full(3, float(comm.rank)), root=1)
+        r2 = comm.coll.igather(comm, np.array([comm.rank * 2.0]), root=0)
+        sendbuf = (np.arange(comm.size, dtype=np.float64) * 10
+                   if comm.rank == 2 else None)
+        r3 = comm.coll.iscatter(comm, sendbuf, recvbuf=np.zeros(1), root=2)
+        wait_all([r1, r2, r3])
+        if comm.rank == 1:
+            expect = np.full(3, sum(range(comm.size)), np.float64)
+            np.testing.assert_array_equal(r1.result, expect)
+        if comm.rank == 0:
+            np.testing.assert_array_equal(
+                r2.result.reshape(-1), [r * 2.0 for r in range(comm.size)])
+        assert r3.result.reshape(-1)[0] == comm.rank * 10.0
+        return True
+    assert all(run(3, body))
+
+
+def test_iallgather_ialltoall():
+    def body(ctx):
+        comm = ctx.comm_world
+        r1 = comm.coll.iallgather(comm, np.array([float(comm.rank)]))
+        a2a_send = np.arange(comm.size, dtype=np.float64) + 100 * comm.rank
+        r2 = comm.coll.ialltoall(comm, a2a_send)
+        r1.wait(); r2.wait()
+        np.testing.assert_array_equal(
+            r1.result.reshape(-1), [float(r) for r in range(comm.size)])
+        np.testing.assert_array_equal(
+            r2.result.reshape(-1),
+            [100.0 * p + comm.rank for p in range(comm.size)])
+        return True
+    assert all(run(4, body))
+
+
+def test_ireduce_scatter_block():
+    def body(ctx):
+        comm = ctx.comm_world
+        send = np.arange(comm.size * 2, dtype=np.float64) + comm.rank
+        req = comm.coll.ireduce_scatter_block(comm, send)
+        req.wait()
+        base = np.arange(comm.size * 2, dtype=np.float64)
+        full = sum(base + r for r in range(comm.size))
+        np.testing.assert_array_equal(
+            req.result.reshape(-1), full[comm.rank * 2:(comm.rank + 1) * 2])
+        return True
+    assert all(run(3, body))
+
+
+def test_concurrent_schedules_no_cross_matching():
+    """Two collectives in flight at once on the same communicator must not
+    cross-match (per-schedule tag isolation)."""
+    def body(ctx):
+        comm = ctx.comm_world
+        a = comm.coll.iallreduce(comm, np.full(4, 1.0))
+        b = comm.coll.iallreduce(comm, np.full(4, 10.0))
+        b.wait(); a.wait()
+        np.testing.assert_array_equal(a.result, np.full(4, float(comm.size)))
+        np.testing.assert_array_equal(b.result, np.full(4, 10.0 * comm.size))
+        return True
+    assert all(run(4, body))
+
+
+def test_persistent_allreduce_restartable():
+    def body(ctx):
+        comm = ctx.comm_world
+        send = np.zeros(4)
+        p = persistent(comm, "allreduce", send)
+        results = []
+        for it in range(3):
+            send[...] = comm.rank + it
+            p.start()
+            results.append(np.array(p.wait()))
+        for it, r in enumerate(results):
+            np.testing.assert_array_equal(
+                r, np.full(4, sum(range(comm.size)) + it * comm.size))
+        return True
+    assert all(run(3, body))
+
+
+def test_derived_eager_fallback_still_works():
+    """Entry points without a true schedule (e.g. iallgatherv) still come
+    from the derived eager wrapper."""
+    def body(ctx):
+        comm = ctx.comm_world
+        counts = [r + 1 for r in range(comm.size)]
+        recvbuf = np.zeros(sum(counts))
+        req = comm.coll.iallgatherv(
+            comm, np.full(comm.rank + 1, float(comm.rank)), recvbuf, counts)
+        req.wait()
+        expect = np.concatenate(
+            [np.full(r + 1, float(r)) for r in range(comm.size)])
+        np.testing.assert_array_equal(recvbuf, expect)
+        return True
+    assert all(run(3, body))
+
+
+def test_size_one_schedules():
+    def body(ctx):
+        comm = ctx.comm_world
+        req = comm.coll.iallreduce(comm, np.arange(4.0))
+        req.wait()
+        np.testing.assert_array_equal(req.result, np.arange(4.0))
+        comm.coll.ibarrier(comm).wait()
+        return True
+    assert all(run(1, body))

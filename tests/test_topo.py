@@ -1,0 +1,131 @@
+"""Topology + neighborhood collective tests (≙ topo framework + coll/basic
+neighbor_*)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime, topo
+
+
+def test_dims_create():
+    assert topo.dims_create(12, 2) in ([4, 3], [3, 4], [6, 2])
+    assert np.prod(topo.dims_create(12, 2)) == 12
+    assert topo.dims_create(8, 3) == [2, 2, 2]
+    assert topo.dims_create(6, 2, [3, 0]) == [3, 2]
+    with pytest.raises(ValueError):
+        topo.dims_create(7, 2, [2, 0])
+
+
+def test_cart_coords_rank_roundtrip():
+    t = topo.CartTopo([3, 4], [True, False])
+    for r in range(12):
+        assert t.rank_of(t.coords(r)) == r
+    assert t.coords(0) == [0, 0]
+    assert t.coords(11) == [2, 3]
+    # periodic wrap on dim 0, hard edge on dim 1
+    src, dst = t.shift(0, 0, 1)
+    assert (src, dst) == (t.rank_of([2, 0]), t.rank_of([1, 0]))
+    src, dst = t.shift(0, 1, 1)
+    assert src is None and dst == t.rank_of([0, 1])
+
+
+def test_cart_create_and_shift_ring():
+    """4 ranks on a periodic 1-d ring: classic neighbor shift."""
+    def body(ctx):
+        comm = ctx.comm_world
+        cart = topo.cart_create(comm, [comm.size], periods=[True])
+        src, dst = cart.topo.shift(cart.rank, 0, 1)
+        sendbuf = np.array([float(cart.rank)])
+        recvbuf = np.zeros(1)
+        cart.sendrecv(sendbuf, dst, recvbuf, src)
+        assert recvbuf[0] == float((cart.rank - 1) % cart.size)
+        return True
+    assert all(runtime.run_ranks(4, body))
+
+
+def test_cart_sub():
+    def body(ctx):
+        comm = ctx.comm_world
+        cart = topo.cart_create(comm, [2, 2], periods=[False, False])
+        row = topo.cart_sub(cart, [False, True])   # keep columns → row comms
+        coords = cart.topo.coords(cart.rank)
+        assert row.size == 2
+        assert row.topo.dims == [2]
+        # ranks in the same row share the subcomm: allreduce of row index
+        out = row.coll.allreduce(row, np.array([float(coords[0])]))
+        assert out[0] == 2 * coords[0]
+        return True
+    assert all(runtime.run_ranks(4, body))
+
+
+def test_neighbor_allgather_cart():
+    """2x2 periodic torus: each rank gathers from 4 neighbors (dims*2)."""
+    def body(ctx):
+        comm = ctx.comm_world
+        cart = topo.cart_create(comm, [2, 2], periods=[True, True])
+        mine = np.array([float(cart.rank)])
+        got = cart.coll.neighbor_allgather(cart, mine)
+        expect = [float(n) for n in cart.topo.neighbors(cart.rank)]
+        np.testing.assert_array_equal(got.reshape(-1), expect)
+        return True
+    assert all(runtime.run_ranks(4, body))
+
+
+def test_neighbor_alltoall_dist_graph():
+    """Directed ring via dist_graph_create_adjacent: send right, recv left."""
+    def body(ctx):
+        comm = ctx.comm_world
+        left = (comm.rank - 1) % comm.size
+        right = (comm.rank + 1) % comm.size
+        dg = topo.dist_graph_create_adjacent(comm, sources=[left],
+                                             destinations=[right])
+        send = np.array([float(comm.rank * 10)])
+        got = dg.coll.neighbor_alltoall(dg, send)
+        assert got.reshape(-1)[0] == float(left * 10)
+        return True
+    assert all(runtime.run_ranks(3, body))
+
+
+def test_graph_create_neighbors():
+    # star graph: 0 connected to 1,2,3; MPI compressed index/edges format
+    index = [3, 4, 5, 6]
+    edges = [1, 2, 3, 0, 0, 0]
+
+    def body(ctx):
+        comm = ctx.comm_world
+        g = topo.graph_create(comm, index, edges)
+        if g.rank == 0:
+            assert g.topo.neighbors(0) == [1, 2, 3]
+        else:
+            assert g.topo.neighbors(g.rank) == [0]
+        mine = np.array([float(g.rank + 1)])
+        got = g.coll.neighbor_allgather(g, mine)
+        if g.rank == 0:
+            np.testing.assert_array_equal(got.reshape(-1), [2.0, 3.0, 4.0])
+        else:
+            np.testing.assert_array_equal(got.reshape(-1), [1.0])
+        return True
+    assert all(runtime.run_ranks(4, body))
+
+
+def test_halo_exchange_2d_stencil():
+    """The canonical cartesian use: 2-d halo exchange on a 2x2 grid."""
+    def body(ctx):
+        comm = ctx.comm_world
+        cart = topo.cart_create(comm, [2, 2], periods=[True, True])
+        local = np.full((4, 4), float(cart.rank))
+        halos = {}
+        reqs = []
+        for dim in (0, 1):
+            src, dst = cart.topo.shift(cart.rank, dim, 1)
+            edge = local[0] if dim == 0 else local[:, 0].copy()
+            halos[dim] = np.zeros(4)
+            reqs.append(cart.irecv(halos[dim], src, tag=50 + dim))
+            reqs.append(cart.isend(np.ascontiguousarray(edge), dst, tag=50 + dim))
+        from ompi_tpu.p2p.request import wait_all
+        wait_all(reqs)
+        for dim in (0, 1):
+            src, _ = cart.topo.shift(cart.rank, dim, 1)
+            np.testing.assert_array_equal(halos[dim], np.full(4, float(src)))
+        return True
+    assert all(runtime.run_ranks(4, body))
