@@ -63,6 +63,9 @@ class CollTable:
             fn = getattr(entries[name], name)
 
             def counted(comm, *a, **kw):
+                if comm.revoked:
+                    from ..ft.ulfm import RevokedError
+                    raise RevokedError(comm.name)
                 spc = getattr(comm.ctx, "spc", None)
                 if spc is not None:
                     spc.inc("collectives")

@@ -82,6 +82,10 @@ class Communicator:
         self._lock = threading.Lock()
         self.coll = None       # per-communicator collectives table (coll/)
         self.revoked = False
+        # cid → comm registry for FT revoke-by-cid delivery (ft/ulfm.py)
+        if not hasattr(ctx, "_ft_comms"):
+            ctx._ft_comms = {}
+        ctx._ft_comms[cid] = self
         self._attach_coll()
 
     # -- construction -------------------------------------------------------
@@ -99,11 +103,28 @@ class Communicator:
     def _world_dst(self, rank: int) -> int:
         return self.group.world_of_rank(rank)
 
+    def _ft_check(self, tag: int, peer_world: Optional[int] = None) -> None:
+        """ULFM semantics for user ops (tag ≥ 0 or ANY_TAG): raise on a
+        revoked comm or a failed peer; internal negative-tag traffic stays
+        allowed so revoke/shrink/agree still run on a broken communicator."""
+        if tag < 0 and tag != ANY_TAG:
+            return
+        if self.revoked:
+            from .ft.ulfm import RevokedError
+            raise RevokedError(self.name)
+        if peer_world is not None and \
+                peer_world in getattr(self.ctx, "failed", ()):
+            from .ft.ulfm import ProcFailedError
+            raise ProcFailedError(peer_world)
+
     def isend(self, buf, dst: int, tag: int = 0, **kw) -> Request:
-        return self.ctx.p2p.isend(buf, self._world_dst(dst), tag, self.cid, **kw)
+        wdst = self._world_dst(dst)
+        self._ft_check(tag, wdst)
+        return self.ctx.p2p.isend(buf, wdst, tag, self.cid, **kw)
 
     def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, **kw) -> Request:
         wsrc = src if src == ANY_SOURCE else self._world_dst(src)
+        self._ft_check(tag, None if src == ANY_SOURCE else wsrc)
         req = self.ctx.p2p.irecv(buf, wsrc, tag, self.cid, **kw)
 
         def fix_source(r):

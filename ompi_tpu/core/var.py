@@ -183,6 +183,13 @@ class VarRegistry:
             if name in self._vars:
                 self._resolve(self._vars[name])
 
+    def clear_cli(self, name: str) -> None:
+        """Drop a CLI assignment, falling back to lower-precedence sources."""
+        with self._lock:
+            self._cli_values.pop(name, None)
+            if name in self._vars:
+                self._resolve(self._vars[name])
+
     def set_override(self, name: str, value: Any) -> None:
         """Programmatic override — the highest-precedence source."""
         with self._lock:

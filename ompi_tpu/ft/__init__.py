@@ -1,0 +1,34 @@
+"""Fault tolerance — ULFM-style failure detection and recovery.
+
+≙ the reference's ULFM stack (docs/features/ulfm.rst:20-60, compiled under
+OPAL_ENABLE_FT_MPI):
+  * heartbeat-ring failure detector    ≙ ompi/communicator/ft/comm_ft_detector.c:49-86
+  * reliable revoke propagation        ≙ ompi/communicator/ft/comm_ft_revoke.c
+  * shrink (drop failed ranks)         ≙ ompi/communicator/ft/comm_ft.c shrink
+  * agreement (FT consensus)           ≙ ompi/mca/coll/ftagree
+  * error classes PROC_FAILED/REVOKED  ≙ MPIX_ERR_PROC_FAILED / MPIX_ERR_REVOKED
+
+TPU-first note: on a pod, in-slice chip failure takes down the whole XLA
+program — the unit of failure is the *slice/host*, detected here over the
+DCN control plane exactly where the reference detects peer processes over
+its RTE. Recovery composes with checkpointing (ompi_tpu.ckpt): detect →
+revoke → shrink → rebuild mesh from survivors → restore.
+"""
+
+from .ulfm import (  # noqa: F401
+    ProcFailedError,
+    RevokedError,
+    agree,
+    enable,
+    failed_ranks,
+    revoke,
+    shrink,
+    simulate_failure,
+)
+from .detector import FailureDetector  # noqa: F401
+
+__all__ = [
+    "ProcFailedError", "RevokedError", "FailureDetector",
+    "enable", "revoke", "shrink", "agree", "failed_ranks",
+    "simulate_failure",
+]

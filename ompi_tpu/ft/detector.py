@@ -1,0 +1,153 @@
+"""Heartbeat-ring failure detector (≙ comm_ft_detector.c:49-86).
+
+Design kept from the reference: ranks form an observation ring — each rank
+*emits* heartbeats to its right neighbor and *observes* its left neighbor;
+an observer that sees no heartbeat for ``timeout`` declares the observed
+rank dead and floods the verdict. The reference runs this off the progress
+engine with RDMA-put or send heartbeats and configurable period/timeout
+(detector period/timeout MCA vars); here it is a low-priority progress
+callback over the AM_FT active-message channel.
+
+On detection:
+  * the failed rank joins ``ctx.failed`` everywhere (flooded reliably);
+  * pending receives posted specifically from that rank complete with
+    ProcFailedError (ULFM requires ANY_SOURCE receives to error too —
+    handled at post time in ulfm.check_any_source);
+  * a bootstrap event is published for RTE-level observers
+    (≙ PMIx event handler registration, instance.c:440-466).
+
+When a rank's transport reports send failures to a peer (tcp failed_peers),
+the observer treats that as immediate evidence rather than waiting for the
+timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Set
+
+from ..core import var as _var
+from ..core.output import output
+from ..p2p import transport as T
+
+_var.register("ft", "detector", "period", 0.05, type=float, level=4,
+              help="Heartbeat emission period, seconds "
+                   "(≙ mpi_ft_detector_period).")
+_var.register("ft", "detector", "timeout", 0.5, type=float, level=4,
+              help="Silence after which the observed rank is declared dead "
+                   "(≙ mpi_ft_detector_timeout).")
+
+
+class FailureDetector:
+    """One per Context; started by ft.enable()."""
+
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.period = float(_var.get("ft_detector_period", 0.05))
+        self.timeout = float(_var.get("ft_detector_timeout", 0.5))
+        self.rank = ctx.rank
+        self.size = ctx.size
+        self._alive = True
+        self._lock = threading.Lock()
+        if not hasattr(ctx, "failed"):
+            ctx.failed = set()
+        self.failed: Set[int] = ctx.failed
+        now = time.monotonic()
+        self._last_emit = 0.0
+        self._last_seen: Dict[int, float] = {}
+        self._grace_until = now + self.timeout   # startup grace period
+        for t in ctx.layer.transports:
+            t.dispatch[T.AM_FT] = self._am_handler
+        ctx.engine.register(self._progress, low_priority=True)
+        self._on_failure = []      # callbacks(rank)
+
+    # ring neighbors skip already-dead ranks
+
+    def _observed(self) -> Optional[int]:
+        r = (self.rank - 1) % self.size
+        while r != self.rank:
+            if r not in self.failed:
+                return r
+            r = (r - 1) % self.size
+        return None
+
+    def _emit_to(self) -> Optional[int]:
+        r = (self.rank + 1) % self.size
+        while r != self.rank:
+            if r not in self.failed:
+                return r
+            r = (r + 1) % self.size
+        return None
+
+    def add_failure_callback(self, cb) -> None:
+        self._on_failure.append(cb)
+
+    def stop(self) -> None:
+        self._alive = False
+        self.ctx.engine.unregister(self._progress)
+
+    # -- progression ---------------------------------------------------------
+
+    def _progress(self) -> int:
+        if not self._alive or self.size == 1:
+            return 0
+        now = time.monotonic()
+        if now - self._last_emit >= self.period:
+            self._last_emit = now
+            to = self._emit_to()
+            if to is not None:
+                try:
+                    self.ctx.layer.send(to, T.AM_FT, {"k": "hb"}, b"")
+                except Exception:
+                    pass    # send failure surfaces via transport failed_peers
+        obs = self._observed()
+        if obs is None:
+            return 0
+        seen = self._last_seen.get(obs)
+        deadline = (seen if seen is not None else self._grace_until)
+        # transport-level send failure to the observed peer = hard evidence
+        hard = any(obs in getattr(t, "failed_peers", ())
+                   for t in self.ctx.layer.transports)
+        if hard or now - deadline > self.timeout:
+            self._declare_failed(obs, local=True)
+        return 0
+
+    def _am_handler(self, src: int, h: Dict[str, Any], payload: bytes) -> None:
+        k = h["k"]
+        if k == "hb":
+            self._last_seen[src] = time.monotonic()
+        elif k == "failed":
+            self._declare_failed(int(h["rank"]), local=False)
+        elif k == "revoke":
+            from .ulfm import _mark_revoked
+            _mark_revoked(self.ctx, int(h["cid"]), flood=True)
+        else:  # pragma: no cover
+            output.verbose(1, "ft", f"unknown ft frame {k!r} from {src}")
+
+    def _declare_failed(self, rank: int, local: bool) -> None:
+        with self._lock:
+            if rank in self.failed or rank == self.rank:
+                return
+            self.failed.add(rank)
+        output.verbose(1, "ft", f"rank {self.rank}: declaring {rank} FAILED")
+        # a newly observed peer gets a fresh grace window
+        self._grace_until = time.monotonic() + self.timeout
+        if local:
+            # reliable flood (≙ comm_ft_propagator reliable bcast)
+            for r in range(self.size):
+                if r not in self.failed and r != self.rank:
+                    try:
+                        self.ctx.layer.send(r, T.AM_FT,
+                                            {"k": "failed", "rank": rank}, b"")
+                    except Exception:
+                        pass
+            try:
+                self.ctx.bootstrap.publish_event(
+                    {"kind": "proc_failed", "rank": rank})
+            except Exception:
+                pass
+        from .ulfm import _fail_pending_recvs
+        _fail_pending_recvs(self.ctx, rank)
+        for cb in self._on_failure:
+            cb(rank)

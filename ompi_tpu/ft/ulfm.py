@@ -1,0 +1,208 @@
+"""ULFM operations: revoke / shrink / agree + failure error classes.
+
+≙ ompi/mpiext/ftmpi (MPIX_Comm_revoke / MPIX_Comm_shrink / MPIX_Comm_agree)
+with the revoke propagation of comm_ft_revoke.c and a simplified agreement
+(the reference's ftagree implements ERA consensus; here agreement is an
+all-to-all exchange with failure-detector-backed timeouts — weaker than ERA
+under partitions, sufficient for fail-stop ranks, and documented as such).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional, Set
+
+import numpy as np
+
+from ..p2p import transport as T
+from ..p2p.request import ANY_SOURCE
+
+# reserved tag space for FT internals (user ≥ 0, coll -100.., nbc -200..)
+T_SHRINK = -1001
+T_AGREE = -1002
+
+
+class ProcFailedError(RuntimeError):
+    """≙ MPIX_ERR_PROC_FAILED."""
+
+    def __init__(self, rank: int, msg: str = "") -> None:
+        super().__init__(msg or f"peer rank {rank} has failed")
+        self.rank = rank
+
+
+class RevokedError(RuntimeError):
+    """≙ MPIX_ERR_REVOKED."""
+
+    def __init__(self, comm_name: str = "comm") -> None:
+        super().__init__(f"communicator {comm_name} has been revoked")
+
+
+def enable(ctx) -> "FailureDetector":
+    """Start the failure detector for this rank (idempotent)."""
+    from .detector import FailureDetector
+    det = getattr(ctx, "_ft_detector", None)
+    if det is None:
+        det = FailureDetector(ctx)
+        ctx._ft_detector = det
+    return det
+
+
+def failed_ranks(ctx) -> Set[int]:
+    return set(getattr(ctx, "failed", set()))
+
+
+def simulate_failure(ctx) -> None:
+    """Test hook: this rank goes silent — stops heartbeats and stops serving
+    traffic (fail-stop). The observation ring then detects it."""
+    det = getattr(ctx, "_ft_detector", None)
+    if det is not None:
+        det.stop()
+    for t in ctx.layer.transports:
+        t.dispatch.clear()          # stop serving all AMs (silent process)
+        t.send = lambda *a, **kw: None   # and stop emitting
+
+
+# -- revoke -----------------------------------------------------------------
+
+def _mark_revoked(ctx, cid: int, flood: bool) -> None:
+    comms = getattr(ctx, "_ft_comms", {})
+    comm = comms.get(cid)
+    if comm is None or comm.revoked:
+        return
+    comm.revoked = True
+    if flood:
+        _flood_revoke(ctx, comm)
+
+
+def _flood_revoke(ctx, comm) -> None:
+    for r in comm.group.world_ranks:
+        if r != ctx.rank and r not in getattr(ctx, "failed", set()):
+            try:
+                ctx.layer.send(r, T.AM_FT, {"k": "revoke", "cid": comm.cid}, b"")
+            except Exception:
+                pass
+
+
+def revoke(comm) -> None:
+    """MPIX_Comm_revoke: mark locally, flood reliably (every receiver
+    re-floods once — comm_ft_revoke.c's reliable bcast property: delivery
+    reaches all survivors if any survivor delivers)."""
+    ctx = comm.ctx
+    enable(ctx)
+    _track(comm)
+    if comm.revoked:
+        return
+    comm.revoked = True
+    _flood_revoke(ctx, comm)
+
+
+def _track(comm) -> None:
+    """Register comm for revoke-by-cid lookup from the AM handler."""
+    ctx = comm.ctx
+    if not hasattr(ctx, "_ft_comms"):
+        ctx._ft_comms = {}
+    ctx._ft_comms[comm.cid] = comm
+
+
+# -- failure interaction with pending communication -------------------------
+
+def _fail_pending_recvs(ctx, failed_rank: int) -> None:
+    """Complete posted receives naming the failed rank with ProcFailedError
+    (ULFM: ops involving a failed process must not hang)."""
+    ctx.p2p.matching.fail_src(failed_rank, ProcFailedError(failed_rank))
+
+
+def check_peer(ctx, world_rank: int) -> None:
+    if world_rank in getattr(ctx, "failed", set()):
+        raise ProcFailedError(world_rank)
+
+
+# -- shrink -----------------------------------------------------------------
+
+def shrink(comm, name: Optional[str] = None):
+    """MPIX_Comm_shrink: agree on the failed set, return a new communicator
+    of the survivors (same relative rank order)."""
+    ctx = comm.ctx
+    enable(ctx)
+    # agreement over the failed set: exchange bitmaps until consensus
+    failed = _agree_failed_set(comm)
+    survivors = [w for w in comm.group.world_ranks if w not in failed]
+    from ..comm import Communicator, Group
+    # deterministic CID: survivors all derive the same child id
+    seq = getattr(comm, "_shrink_seq", 0)
+    comm._shrink_seq = seq + 1
+    cid = (comm.cid + 1) * 4096 + 512 + seq
+    newcomm = Communicator(ctx, Group(survivors), cid,
+                           name or f"{comm.name}.shrink")
+    _track(newcomm)
+    return newcomm
+
+
+def _agree_failed_set(comm) -> Set[int]:
+    """All-to-all exchange of locally-known failed sets with timeouts; two
+    sweeps so second-hand knowledge converges (fail-stop model)."""
+    ctx = comm.ctx
+    # exactly two sweeps on every rank — an early exit would desynchronize
+    # the per-instance exchange tags across ranks and deadlock
+    for _ in range(2):
+        known = np.zeros(ctx.size, np.int8)
+        for f in getattr(ctx, "failed", set()):
+            known[f] = 1
+        gathered = _exchange(comm, known, T_SHRINK)
+        merged = np.clip(np.sum(gathered, axis=0), 0, 1)
+        ctx.failed.update(int(i) for i in np.nonzero(merged)[0])
+    return set(int(i) for i in np.nonzero(merged)[0])
+
+
+# -- agreement --------------------------------------------------------------
+
+def agree(comm, flag: int) -> int:
+    """MPIX_Comm_agree: returns the bitwise AND of ``flag`` over surviving
+    ranks; uniform among survivors under fail-stop failures."""
+    ctx = comm.ctx
+    enable(ctx)
+    mine = np.array([flag, 0], np.int64)
+    rows = _exchange(comm, mine, T_AGREE)
+    out = ~np.int64(0)
+    for row in rows:
+        out &= np.int64(row[0])
+    return int(out)
+
+
+def _exchange(comm, vec: np.ndarray, tag: int):
+    """All-to-all with per-peer failure awareness: sends to everyone, waits
+    for each peer until it answers or is declared failed. Needs the failure
+    detector running (enable()) so dead peers eventually time out."""
+    ctx = comm.ctx
+    seq = getattr(comm, "_ft_xchg_seq", 0)
+    comm._ft_xchg_seq = seq + 1
+    xtag = tag - 10 * (seq % 90)       # per-instance tag isolation
+    rows = [None] * comm.size
+    rows[comm.rank] = vec.copy()
+    reqs = {}
+    for r in range(comm.size):
+        w = comm.group.world_of_rank(r)
+        if r == comm.rank or w in getattr(ctx, "failed", set()):
+            continue
+        inbox = np.zeros_like(vec)
+        reqs[r] = (comm.irecv(inbox, r, xtag), inbox)
+        comm.isend(vec, r, xtag)
+    deadline = time.monotonic() + 30.0
+    pending = dict(reqs)
+    while pending:
+        for r in list(pending):
+            req, inbox = pending[r]
+            w = comm.group.world_of_rank(r)
+            if req.done:
+                if req.error is None:
+                    rows[r] = inbox.copy()
+                del pending[r]
+            elif w in getattr(ctx, "failed", set()):
+                del pending[r]       # declared dead while we waited
+        if pending:
+            ctx.engine.progress()
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"ft exchange: no progress and no failure verdict for "
+                    f"peers {sorted(pending)}")
+    return [r for r in rows if r is not None]

@@ -27,12 +27,14 @@ def _tag_matches(posted_tag: int, msg_tag: int) -> bool:
 
 
 class Posted:
-    __slots__ = ("src", "tag", "on_match")
+    __slots__ = ("src", "tag", "on_match", "req")
 
-    def __init__(self, src: int, tag: int, on_match: Callable) -> None:
+    def __init__(self, src: int, tag: int, on_match: Callable,
+                 req: Any = None) -> None:
         self.src = src
         self.tag = tag
         self.on_match = on_match
+        self.req = req        # owning Request (FT: failed-peer completion)
 
 
 class Unexpected:
@@ -63,7 +65,7 @@ class MatchingEngine:
     # -- receive side -------------------------------------------------------
 
     def post_recv(self, cid: int, src: int, tag: int,
-                  on_match: Callable) -> Optional[Posted]:
+                  on_match: Callable, req: Any = None) -> Optional[Posted]:
         """Try to match an already-arrived message first; else enqueue.
 
         on_match(unexpected | None) is called immediately when an unexpected
@@ -73,9 +75,18 @@ class MatchingEngine:
         if match is not None:
             on_match(match)
             return None
-        p = Posted(src, tag, on_match)
+        p = Posted(src, tag, on_match, req)
         self._posted[cid].append(p)
         return p
+
+    def fail_src(self, src: int, err: Exception) -> None:
+        """Complete every posted receive naming ``src`` with ``err``
+        (ULFM: operations on a failed peer must not hang)."""
+        for lst in self._posted.values():
+            for p in [p for p in lst if p.src == src]:
+                lst.remove(p)
+                if p.req is not None:
+                    p.req.complete(err)
 
     def cancel(self, cid: int, posted: Posted) -> bool:
         lst = self._posted.get(cid, [])

@@ -176,7 +176,7 @@ class P2P:
                                 {"k": "ack", "sreq": u.header["sreq"],
                                  "rreq": rreq}, b"")
 
-        if self.matching.post_recv(cid, src, tag, on_match) is None:
+        if self.matching.post_recv(cid, src, tag, on_match, req=req) is None:
             self.spc.inc("matches_unexpected")
         return req
 

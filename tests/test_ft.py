@@ -1,0 +1,145 @@
+"""Fault-tolerance tests (≙ ULFM: detector, revoke, shrink, agree).
+
+The reference tests FT with real killed processes under mpirun; threaded
+ranks can't be killed, so ``ft.simulate_failure`` makes a rank fail-stop
+(silent: stops heartbeats and stops serving traffic) — the observation ring
+must detect it, pending ops must error rather than hang, and the survivors
+must shrink/agree their way out (docs/features/ulfm.rst recovery recipe).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ompi_tpu import ft, runtime
+from ompi_tpu.core import var
+
+
+@pytest.fixture(autouse=True)
+def fast_detector():
+    var.registry.set_cli("ft_detector_period", "0.02")
+    var.registry.set_cli("ft_detector_timeout", "0.3")
+    var.registry.reset_cache()
+    yield
+    var.registry.clear_cli("ft_detector_period")
+    var.registry.clear_cli("ft_detector_timeout")
+    var.registry.reset_cache()
+
+
+def test_detector_notices_silent_rank():
+    def body(ctx):
+        det = ft.enable(ctx)
+        ctx.comm_world.barrier()
+        if ctx.rank == 2:
+            ft.simulate_failure(ctx)
+            time.sleep(1.5)
+            return True
+        deadline = time.monotonic() + 10
+        while 2 not in ft.failed_ranks(ctx):
+            ctx.engine.progress()
+            assert time.monotonic() < deadline, "detector never fired"
+        return True
+    assert all(runtime.run_ranks(4, body, timeout=60))
+
+
+def test_pending_recv_fails_instead_of_hanging():
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 1:
+            ft.simulate_failure(ctx)
+            time.sleep(1.5)
+            return True
+        if ctx.rank == 0:
+            req = comm.irecv(np.zeros(4), src=1, tag=7)
+            with pytest.raises(ft.ProcFailedError):
+                req.wait(timeout=10)
+        return True
+    assert all(runtime.run_ranks(3, body, timeout=60))
+
+
+def test_send_to_known_failed_rank_raises():
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 1:
+            ft.simulate_failure(ctx)
+            time.sleep(1.2)
+            return True
+        deadline = time.monotonic() + 10
+        while 1 not in ft.failed_ranks(ctx):
+            ctx.engine.progress()
+            assert time.monotonic() < deadline
+        with pytest.raises(ft.ProcFailedError):
+            comm.send(np.zeros(1), 1, tag=3)
+        return True
+    assert all(runtime.run_ranks(3, body, timeout=60))
+
+
+def test_revoke_propagates_and_blocks_user_ops():
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 0:
+            ft.revoke(comm)
+        deadline = time.monotonic() + 10
+        while not comm.revoked:
+            ctx.engine.progress()
+            assert time.monotonic() < deadline, "revoke never arrived"
+        with pytest.raises(ft.RevokedError):
+            comm.send(np.zeros(1), (ctx.rank + 1) % ctx.size, tag=1)
+        with pytest.raises(ft.RevokedError):
+            comm.coll.allreduce(comm, np.zeros(1))
+        return True
+    assert all(runtime.run_ranks(3, body, timeout=60))
+
+
+def test_agree_over_survivors():
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 3:
+            ft.simulate_failure(ctx)
+            time.sleep(2.0)
+            return None
+        # wait until the failure is known, then agree
+        deadline = time.monotonic() + 10
+        while 3 not in ft.failed_ranks(ctx):
+            ctx.engine.progress()
+            assert time.monotonic() < deadline
+        flags = {0: 0b1110, 1: 0b0111, 2: 0b1111}
+        return ft.agree(comm, flags[ctx.rank])
+    res = runtime.run_ranks(4, body, timeout=60)
+    assert res[:3] == [0b0110] * 3
+
+
+def test_shrink_and_continue():
+    """The canonical ULFM recovery: detect → revoke → shrink → keep going."""
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 1:
+            ft.simulate_failure(ctx)
+            time.sleep(2.5)
+            return None
+        deadline = time.monotonic() + 10
+        while 1 not in ft.failed_ranks(ctx):
+            ctx.engine.progress()
+            assert time.monotonic() < deadline
+        ft.revoke(comm)
+        new = ft.shrink(comm)
+        assert new.size == comm.size - 1
+        assert 1 not in new.group.world_ranks
+        # survivors are fully operational on the shrunk communicator
+        out = new.coll.allreduce(new, np.array([float(ctx.rank)]))
+        return float(out[0])
+    res = runtime.run_ranks(4, body, timeout=60)
+    assert res[1] is None
+    expect = float(0 + 2 + 3)
+    assert [r for r in res if r is not None] == [expect] * 3
