@@ -190,10 +190,14 @@ class Communicator:
                 members.sort()
                 world_ranks = [w for _, w in members]
                 assignments.append((c, base_cid + idx, world_ranks))
-            # scatter each member its (cid, members); rank 0 handles itself
+            # scatter each member its (cid, new counter, members); the
+            # counter rides along so every member's copy of this comm's cid
+            # allocator stays in sync — shrink() draws from the same
+            # allocator and must see the same state on all survivors
             my_assign = None
             for c, cid, world_ranks in assignments:
-                payload = np.array([cid] + world_ranks, np.int64)
+                payload = np.array([cid, self._cid_counter] + world_ranks,
+                                   np.int64)
                 for w in world_ranks:
                     if w == self.ctx.rank:
                         my_assign = payload
@@ -201,12 +205,13 @@ class Communicator:
                         self.ctx.p2p.send(payload, w, TAG_COMM_CID, self.cid)
             for cc, k, w in rows:   # undefined-color members get an empty reply
                 if cc == -(1 << 62) and w != self.ctx.rank:
-                    self.ctx.p2p.send(np.array([-1], np.int64), int(w),
-                                      TAG_COMM_CID, self.cid)
+                    self.ctx.p2p.send(
+                        np.array([-1, self._cid_counter], np.int64), int(w),
+                        TAG_COMM_CID, self.cid)
             if color is None:
                 return None
             assert my_assign is not None
-            cid, world_ranks = int(my_assign[0]), [int(x) for x in my_assign[1:]]
+            cid, world_ranks = int(my_assign[0]), [int(x) for x in my_assign[2:]]
         else:
             self.ctx.p2p.send(mine, self._world_dst(0), TAG_COMM_SPLIT, self.cid)
             # variable-length reply: probe for size first
@@ -219,9 +224,11 @@ class Communicator:
             n = st["count"] // 8
             buf = np.zeros(n, np.int64)
             self.ctx.p2p.recv(buf, self._world_dst(0), TAG_COMM_CID, self.cid)
+            if n > 1:
+                self._cid_counter = max(self._cid_counter, int(buf[1]))
             if color is None or buf[0] < 0:
                 return None
-            cid, world_ranks = int(buf[0]), [int(x) for x in buf[1:]]
+            cid, world_ranks = int(buf[0]), [int(x) for x in buf[2:]]
         return Communicator(self.ctx, Group(world_ranks), cid,
                             name or f"{self.name}.split")
 

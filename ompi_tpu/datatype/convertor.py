@@ -16,11 +16,27 @@ Differences from the reference, by design:
 
 from __future__ import annotations
 
+import ctypes
 from typing import List, Tuple
 
 import numpy as np
 
+from .. import native
 from .datatype import Datatype
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+
+
+def _native_segs(dt: Datatype) -> np.ndarray:
+    """Flattened (offset, nbytes) table handed to the C++ loops, cached on
+    the datatype."""
+    segs = getattr(dt, "_native_segs", None)
+    if segs is None:
+        segs = np.array([(s.offset, s.nbytes) for s in dt.segments],
+                        np.int64).ravel()
+        dt._native_segs = segs
+    return segs
 
 
 def _as_bytes_view(buf) -> memoryview:
@@ -94,6 +110,19 @@ class Convertor:
                                    count=n, offset=self.position)
             self.position += n
             return out.tobytes()
+        lib = None if self.external32 else native.load()
+        if lib is not None:
+            # native segment walker (native/convertor.cpp ≙ the reference's
+            # compiled-description pack loop, opal_convertor.c:245)
+            n = len(out)
+            segs = _native_segs(self.dt)
+            lib.conv_pack_partial(
+                out.ctypes.data_as(_U8P),
+                np.frombuffer(src, np.uint8).ctypes.data_as(_U8P),
+                self.dt.extent, segs.ctypes.data_as(_I64P),
+                len(self.dt.segments), self.dt.size, self.position, n)
+            self.position += n
+            return out.tobytes()
         written = 0
         for raw, pos, n, sdt in self._iter_ranges(self.position, len(out)):
             chunk = np.frombuffer(src, np.uint8, count=n, offset=raw)
@@ -111,6 +140,17 @@ class Convertor:
         if self.dt.is_contiguous and not self.external32:
             n = min(len(src), self.packed_size - self.position)
             dst[self.position:self.position + n] = src[:n]
+            self.position += n
+            return n
+        lib = None if self.external32 else native.load()
+        if lib is not None:
+            n = min(len(src), self.packed_size - self.position)
+            segs = _native_segs(self.dt)
+            lib.conv_unpack_partial(
+                np.frombuffer(dst, np.uint8).ctypes.data_as(_U8P),
+                src.ctypes.data_as(_U8P),
+                self.dt.extent, segs.ctypes.data_as(_I64P),
+                len(self.dt.segments), self.dt.size, self.position, n)
             self.position += n
             return n
         consumed = 0

@@ -10,9 +10,9 @@ callback over the AM_FT active-message channel.
 
 On detection:
   * the failed rank joins ``ctx.failed`` everywhere (flooded reliably);
-  * pending receives posted specifically from that rank complete with
-    ProcFailedError (ULFM requires ANY_SOURCE receives to error too —
-    handled at post time in ulfm.check_any_source);
+  * pending receives posted from that rank — and ANY_SOURCE receives on
+    communicators containing it — complete with ProcFailedError
+    (matching.fail_src, driven by ulfm._fail_pending_recvs);
   * a bootstrap event is published for RTE-level observers
     (≙ PMIx event handler registration, instance.c:440-466).
 
@@ -122,6 +122,9 @@ class FailureDetector:
         elif k == "revoke":
             from .ulfm import _mark_revoked
             _mark_revoked(self.ctx, int(h["cid"]), flood=True)
+        elif k in ("ag_c", "ag_r", "ag_p"):
+            from .ulfm import handle_ag
+            handle_ag(self.ctx, src, h)
         else:  # pragma: no cover
             output.verbose(1, "ft", f"unknown ft frame {k!r} from {src}")
 
@@ -133,15 +136,18 @@ class FailureDetector:
         output.verbose(1, "ft", f"rank {self.rank}: declaring {rank} FAILED")
         # a newly observed peer gets a fresh grace window
         self._grace_until = time.monotonic() + self.timeout
+        # reliable flood on FIRST learn, local or relayed — every first-time
+        # receiver re-floods once, the same property the revoke path has
+        # (≙ comm_ft_propagator reliable bcast: reaches all survivors if any
+        # survivor delivers, even when the original detector dies mid-flood)
+        for r in range(self.size):
+            if r not in self.failed and r != self.rank:
+                try:
+                    self.ctx.layer.send(r, T.AM_FT,
+                                        {"k": "failed", "rank": rank}, b"")
+                except Exception:
+                    pass
         if local:
-            # reliable flood (≙ comm_ft_propagator reliable bcast)
-            for r in range(self.size):
-                if r not in self.failed and r != self.rank:
-                    try:
-                        self.ctx.layer.send(r, T.AM_FT,
-                                            {"k": "failed", "rank": rank}, b"")
-                    except Exception:
-                        pass
             try:
                 self.ctx.bootstrap.publish_event(
                     {"kind": "proc_failed", "rank": rank})

@@ -1,10 +1,10 @@
 """ULFM operations: revoke / shrink / agree + failure error classes.
 
 ≙ ompi/mpiext/ftmpi (MPIX_Comm_revoke / MPIX_Comm_shrink / MPIX_Comm_agree)
-with the revoke propagation of comm_ft_revoke.c and a simplified agreement
-(the reference's ftagree implements ERA consensus; here agreement is an
-all-to-all exchange with failure-detector-backed timeouts — weaker than ERA
-under partitions, sufficient for fail-stop ranks, and documented as such).
+with the revoke propagation of comm_ft_revoke.c and a rotating-coordinator
+agreement with decided-value adoption (the reference's ftagree implements
+ERA consensus; this protocol gives the same uniformity guarantee under
+fail-stop failures with an accurate detector, and is documented as such).
 """
 
 from __future__ import annotations
@@ -12,14 +12,8 @@ from __future__ import annotations
 import time
 from typing import Optional, Set
 
-import numpy as np
-
 from ..p2p import transport as T
-from ..p2p.request import ANY_SOURCE
 
-# reserved tag space for FT internals (user ≥ 0, coll -100.., nbc -200..)
-T_SHRINK = -1001
-T_AGREE = -1002
 
 
 class ProcFailedError(RuntimeError):
@@ -89,27 +83,25 @@ def revoke(comm) -> None:
     reaches all survivors if any survivor delivers)."""
     ctx = comm.ctx
     enable(ctx)
-    _track(comm)
     if comm.revoked:
         return
     comm.revoked = True
     _flood_revoke(ctx, comm)
 
 
-def _track(comm) -> None:
-    """Register comm for revoke-by-cid lookup from the AM handler."""
-    ctx = comm.ctx
-    if not hasattr(ctx, "_ft_comms"):
-        ctx._ft_comms = {}
-    ctx._ft_comms[comm.cid] = comm
-
-
 # -- failure interaction with pending communication -------------------------
 
 def _fail_pending_recvs(ctx, failed_rank: int) -> None:
-    """Complete posted receives naming the failed rank with ProcFailedError
-    (ULFM: ops involving a failed process must not hang)."""
-    ctx.p2p.matching.fail_src(failed_rank, ProcFailedError(failed_rank))
+    """Complete posted receives naming the failed rank — and ANY_SOURCE
+    receives on every communicator containing it — with ProcFailedError
+    (ULFM: ops involving a failed process must not hang; the reference
+    reports ANY_SOURCE as MPIX_ERR_PROC_FAILED_PENDING and lets the recv
+    stay posted — here it fail-stops, documented simplification)."""
+    comms = getattr(ctx, "_ft_comms", {})
+    cids = frozenset(cid for cid, c in comms.items()
+                     if failed_rank in c.group.world_ranks)
+    ctx.p2p.matching.fail_src(failed_rank, ProcFailedError(failed_rank),
+                              any_source_cids=cids)
 
 
 def check_peer(ctx, world_rank: int) -> None:
@@ -117,92 +109,189 @@ def check_peer(ctx, world_rank: int) -> None:
         raise ProcFailedError(world_rank)
 
 
+# -- agreement (coordinator-based, ≙ ompi/mca/coll/ftagree) -----------------
+#
+# MPIX_Comm_agree must return the SAME value on every rank that returns
+# (uniformity), even when ranks fail mid-operation. A plain all-to-all
+# cannot give that (rank P may deliver its flag to A but die before reaching
+# B). The reference's ftagree implements ERA consensus; here: a rotating
+# coordinator protocol with decided-value adoption —
+#
+#   * the lowest-ranked alive member coordinates: gathers contributions
+#     (flag + known-failed set + cid proposal) from every alive member,
+#     computes the decision, broadcasts it;
+#   * a member waiting on a coordinator that the detector declares failed
+#     re-elects the next-lowest and starts over;
+#   * a new coordinator first *pulls*: any rank that already holds a
+#     decision for this (cid, seq) answers with the decided result, which
+#     the new coordinator adopts verbatim instead of recomputing.
+#
+# Uniform under fail-stop failures with an accurate detector (the heartbeat
+# ring, detector.py): two different decisions would require a coordinator to
+# be declared failed while still delivering results, which accuracy rules
+# out. The decision also carries the agreed failed-set and the agreed next
+# communicator id, so shrink() gets a uniform survivor list and a collision-
+# free cid from the same decision.
+
+
+class _AgState:
+    """Per-context agreement state, serviced from the AM handler so ranks
+    that already returned can still answer pulls."""
+
+    def __init__(self) -> None:
+        self.results: dict = {}    # (cid, seq) -> decided result frame
+        self.contribs: dict = {}   # (cid, seq) -> {world_rank: contrib frame}
+        self.mine: dict = {}       # (cid, seq) -> this rank's contribution
+
+
+def _ag_state(ctx) -> _AgState:
+    st = getattr(ctx, "_ag_state", None)
+    if st is None:
+        st = _AgState()
+        ctx._ag_state = st
+    return st
+
+
+def handle_ag(ctx, src: int, h: dict) -> None:
+    """Agreement AM dispatch (called from the detector's AM handler)."""
+    st = _ag_state(ctx)
+    key = (int(h["cid"]), int(h["seq"]))
+    k = h["k"]
+    if k == "ag_c":                     # a member's contribution
+        st.contribs.setdefault(key, {})[src] = h
+    elif k == "ag_r":                   # a coordinator's decision
+        st.results[key] = h
+    elif k == "ag_p":                   # pull from a (new) coordinator
+        if key in st.results:
+            reply = st.results[key]
+        elif key in st.mine:
+            reply = st.mine[key]
+        else:
+            return                      # not entered yet; coordinator re-pulls
+        try:
+            ctx.layer.send(src, T.AM_FT, reply, b"")
+        except Exception:
+            pass
+
+
+def _agreement(comm, flag: int) -> dict:
+    """Run one agreement instance; returns the decided frame
+    {value, failed, cid_next} applied uniformly on every returning rank."""
+    ctx = comm.ctx
+    enable(ctx)
+    st = _ag_state(ctx)
+    seq = getattr(comm, "_ag_seq", 0)
+    comm._ag_seq = seq + 1
+    key = (comm.cid, seq)
+    members = list(comm.group.world_ranks)
+    mine = {"k": "ag_c", "cid": comm.cid, "seq": seq, "flag": int(flag),
+            "failed": sorted(int(f) for f in getattr(ctx, "failed", set())),
+            "cidprop": int(comm._cid_counter)}
+    st.mine[key] = mine
+    deadline = time.monotonic() + 120.0
+    sent_to = None
+    result = None
+    while result is None:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"agreement on {comm.name}: no decision within 120s")
+        alive = [w for w in members if w not in ctx.failed]
+        coord = alive[0]
+        if coord == ctx.rank:
+            result = _coordinate(comm, key, members)
+        else:
+            if sent_to != coord:        # (re-)submit to the current coordinator
+                try:
+                    ctx.layer.send(coord, T.AM_FT, mine, b"")
+                except Exception:
+                    pass
+                sent_to = coord
+            ctx.engine.progress()
+            result = st.results.get(key)
+            # coordinator died undecided → loop re-elects
+    st.results[key] = result
+    # apply the uniform knowledge
+    if not hasattr(ctx, "failed"):
+        ctx.failed = set()
+    ctx.failed.update(int(f) for f in result["failed"])
+    comm._cid_counter = max(comm._cid_counter, int(result["cid_next"]))
+    st.contribs.pop(key, None)
+    return result
+
+
+def _coordinate(comm, key, members) -> dict:
+    """Coordinator body: adopt any existing decision, else gather from all
+    alive members, decide, broadcast."""
+    ctx = comm.ctx
+    st = _ag_state(ctx)
+    cid, seq = key
+    last_pull = 0.0
+    deadline = time.monotonic() + 60.0
+    while True:
+        contribs = st.contribs.setdefault(key, {})
+        contribs[ctx.rank] = st.mine[key]
+        alive = [w for w in members if w not in ctx.failed]
+        decided = st.results.get(key)
+        if decided is None and all(w in contribs for w in alive):
+            flags_and = ~0
+            failed = set(int(f) for f in getattr(ctx, "failed", set()))
+            cid_next = int(comm._cid_counter)
+            for w in alive:
+                c = contribs[w]
+                flags_and &= int(c["flag"])
+                failed.update(int(f) for f in c["failed"])
+                cid_next = max(cid_next, int(c["cidprop"]))
+            failed.update(w for w in members if w not in alive)
+            decided = {"k": "ag_r", "cid": cid, "seq": seq,
+                       "value": int(flags_and),
+                       "failed": sorted(f for f in failed if f in members),
+                       "cid_next": cid_next}
+        if decided is not None:
+            for w in alive:
+                if w != ctx.rank:
+                    try:
+                        ctx.layer.send(w, T.AM_FT, decided, b"")
+                    except Exception:
+                        pass
+            return decided
+        now = time.monotonic()
+        if now > deadline:
+            raise TimeoutError(
+                f"agreement on {comm.name}: coordinator gathered "
+                f"{sorted(contribs)} of {alive} within 60s")
+        if now - last_pull > 0.05:
+            last_pull = now
+            for w in alive:
+                if w != ctx.rank and w not in contribs:
+                    try:
+                        ctx.layer.send(
+                            w, T.AM_FT,
+                            {"k": "ag_p", "cid": cid, "seq": seq}, b"")
+                    except Exception:
+                        pass
+        ctx.engine.progress()
+
+
+def agree(comm, flag: int) -> int:
+    """MPIX_Comm_agree: uniform bitwise AND of ``flag`` over surviving
+    ranks (ompi/mpiext/ftmpi semantics)."""
+    return int(_agreement(comm, int(flag))["value"])
+
+
 # -- shrink -----------------------------------------------------------------
 
 def shrink(comm, name: Optional[str] = None):
-    """MPIX_Comm_shrink: agree on the failed set, return a new communicator
-    of the survivors (same relative rank order)."""
+    """MPIX_Comm_shrink: agree (uniformly) on the failed set and return a
+    new communicator of the survivors, same relative rank order. The new
+    cid comes out of the same agreement, drawn from the parent's shared cid
+    counter (the allocator split() uses), so it cannot collide with split
+    children."""
     ctx = comm.ctx
-    enable(ctx)
-    # agreement over the failed set: exchange bitmaps until consensus
-    failed = _agree_failed_set(comm)
+    res = _agreement(comm, ~0)
+    failed = set(int(f) for f in res["failed"])
     survivors = [w for w in comm.group.world_ranks if w not in failed]
+    cid = int(res["cid_next"])
+    comm._cid_counter = max(comm._cid_counter, cid + 1)   # consume it
     from ..comm import Communicator, Group
-    # deterministic CID: survivors all derive the same child id
-    seq = getattr(comm, "_shrink_seq", 0)
-    comm._shrink_seq = seq + 1
-    cid = (comm.cid + 1) * 4096 + 512 + seq
-    newcomm = Communicator(ctx, Group(survivors), cid,
-                           name or f"{comm.name}.shrink")
-    _track(newcomm)
-    return newcomm
-
-
-def _agree_failed_set(comm) -> Set[int]:
-    """All-to-all exchange of locally-known failed sets with timeouts; two
-    sweeps so second-hand knowledge converges (fail-stop model)."""
-    ctx = comm.ctx
-    # exactly two sweeps on every rank — an early exit would desynchronize
-    # the per-instance exchange tags across ranks and deadlock
-    for _ in range(2):
-        known = np.zeros(ctx.size, np.int8)
-        for f in getattr(ctx, "failed", set()):
-            known[f] = 1
-        gathered = _exchange(comm, known, T_SHRINK)
-        merged = np.clip(np.sum(gathered, axis=0), 0, 1)
-        ctx.failed.update(int(i) for i in np.nonzero(merged)[0])
-    return set(int(i) for i in np.nonzero(merged)[0])
-
-
-# -- agreement --------------------------------------------------------------
-
-def agree(comm, flag: int) -> int:
-    """MPIX_Comm_agree: returns the bitwise AND of ``flag`` over surviving
-    ranks; uniform among survivors under fail-stop failures."""
-    ctx = comm.ctx
-    enable(ctx)
-    mine = np.array([flag, 0], np.int64)
-    rows = _exchange(comm, mine, T_AGREE)
-    out = ~np.int64(0)
-    for row in rows:
-        out &= np.int64(row[0])
-    return int(out)
-
-
-def _exchange(comm, vec: np.ndarray, tag: int):
-    """All-to-all with per-peer failure awareness: sends to everyone, waits
-    for each peer until it answers or is declared failed. Needs the failure
-    detector running (enable()) so dead peers eventually time out."""
-    ctx = comm.ctx
-    seq = getattr(comm, "_ft_xchg_seq", 0)
-    comm._ft_xchg_seq = seq + 1
-    xtag = tag - 10 * (seq % 90)       # per-instance tag isolation
-    rows = [None] * comm.size
-    rows[comm.rank] = vec.copy()
-    reqs = {}
-    for r in range(comm.size):
-        w = comm.group.world_of_rank(r)
-        if r == comm.rank or w in getattr(ctx, "failed", set()):
-            continue
-        inbox = np.zeros_like(vec)
-        reqs[r] = (comm.irecv(inbox, r, xtag), inbox)
-        comm.isend(vec, r, xtag)
-    deadline = time.monotonic() + 30.0
-    pending = dict(reqs)
-    while pending:
-        for r in list(pending):
-            req, inbox = pending[r]
-            w = comm.group.world_of_rank(r)
-            if req.done:
-                if req.error is None:
-                    rows[r] = inbox.copy()
-                del pending[r]
-            elif w in getattr(ctx, "failed", set()):
-                del pending[r]       # declared dead while we waited
-        if pending:
-            ctx.engine.progress()
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"ft exchange: no progress and no failure verdict for "
-                    f"peers {sorted(pending)}")
-    return [r for r in rows if r is not None]
+    return Communicator(ctx, Group(survivors), cid,
+                        name or f"{comm.name}.shrink")

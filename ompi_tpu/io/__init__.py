@@ -1,0 +1,28 @@
+"""MPI-IO (≙ ompi/mca/io/ompio + its fbtl/fcoll/fs/sharedfp sub-frameworks).
+
+The reference's native MPI-IO stack is OMPIO: POSIX byte transfer (fbtl),
+two-phase collective aggregation (fcoll/vulcan,
+ompi/mca/common/ompio/common_ompio_aggregators.c), filesystem dispatch (fs),
+and shared file pointers (sharedfp/sm|lockedfile). This package re-designs
+that stack host-side:
+
+  * ``File`` — open/close, independent read/write (+at/+all variants),
+    file views over derived datatypes (the convertor's segment walker maps
+    visible-byte space onto file offsets);
+  * two-phase collective IO — intents are exchanged over the communicator,
+    aggregator ranks merge file-domain chunks into large contiguous POSIX
+    operations;
+  * shared file pointers — a fetch-add window (osc) on rank 0's offset,
+    the same trick sharedfp/sm plays with a shared-memory segment.
+"""
+
+from .file import (  # noqa: F401
+    MODE_APPEND,
+    MODE_CREATE,
+    MODE_DELETE_ON_CLOSE,
+    MODE_EXCL,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+    File,
+)

@@ -1,0 +1,443 @@
+"""MPI-IO file handles (≙ ompi/mca/io/ompio, common_ompio_file_*.c).
+
+See package docstring for the sub-framework mapping. Offsets follow MPI
+semantics: explicit offsets and the individual/shared file pointers count
+*etypes relative to the current view*, and a view (disp, etype, filetype)
+tiles the file with ``filetype`` — only bytes under its segments are
+visible, in segment order (MPI-4 §14.3; the reference walks the same
+description through its convertor, common_ompio_file_view.c).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import var as _var
+from ..datatype import BYTE, Convertor, Datatype
+from ..op import SUM
+
+MODE_RDONLY = 0x01
+MODE_WRONLY = 0x02
+MODE_RDWR = 0x04
+MODE_CREATE = 0x08
+MODE_EXCL = 0x10
+MODE_APPEND = 0x20
+MODE_DELETE_ON_CLOSE = 0x40
+
+_TAG_IO = -400000          # collective two-phase internal band
+
+_var.register("io", "ompio", "num_aggregators", 0, type=int, level=4,
+              help="Aggregator count for two-phase collective IO "
+                   "(0 = auto, ≙ OMPIO's aggregator selection).")
+
+_DUMMY = np.zeros(0, np.uint8)
+
+
+class File:
+    """One communicator-wide file handle (MPI_File)."""
+
+    def __init__(self, comm, path: str, amode: int, fd: int) -> None:
+        self.comm = comm
+        self.path = path
+        self.amode = amode
+        self._fd = fd
+        self._lock = threading.Lock()
+        self._pos = 0                   # individual pointer, in etypes
+        self._coll_seq = 0
+        self._shared_win = None
+        self.disp = 0
+        self.etype: Datatype = BYTE
+        self.filetype: Optional[Datatype] = None    # None = contiguous
+        self.atomicity = False
+
+    # -- open/close ---------------------------------------------------------
+
+    @classmethod
+    def open(cls, comm, path: str, amode: int = MODE_RDONLY) -> "File":
+        """Collective open (MPI_File_open)."""
+        flags = 0
+        if amode & MODE_RDWR:
+            flags |= os.O_RDWR
+        elif amode & MODE_WRONLY:
+            flags |= os.O_WRONLY
+        else:
+            flags |= os.O_RDONLY
+        if amode & MODE_APPEND:
+            flags |= os.O_APPEND
+        err = None
+        fd = -1
+        if comm.rank == 0:
+            try:
+                cflags = flags
+                if amode & MODE_CREATE:
+                    cflags |= os.O_CREAT
+                if amode & MODE_EXCL:
+                    cflags |= os.O_EXCL
+                fd = os.open(path, cflags, 0o644)
+            except OSError as exc:
+                err = str(exc)
+        state = comm.coll.bcast(comm, np.array(
+            [0 if err is None else 1], np.int64))
+        if int(state[0]):
+            if fd >= 0:
+                os.close(fd)
+            raise IOError(f"MPI_File_open({path}): {err or 'root failed'}")
+        if comm.rank != 0:
+            fd = os.open(path, flags)
+        return cls(comm, path, amode, fd)
+
+    def close(self) -> None:
+        """Collective close (MPI_File_close)."""
+        self.sync()
+        self.comm.barrier()
+        os.close(self._fd)
+        self._fd = -1
+        if self.amode & MODE_DELETE_ON_CLOSE and self.comm.rank == 0:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+        if self._shared_win is not None:
+            self._shared_win.free()
+            self._shared_win = None
+
+    def sync(self) -> None:
+        if self._fd >= 0 and (self.amode & (MODE_WRONLY | MODE_RDWR)):
+            os.fsync(self._fd)
+
+    def size(self) -> int:
+        return os.fstat(self._fd).st_size
+
+    def set_size(self, nbytes: int) -> None:
+        """Collective truncate/extend (MPI_File_set_size)."""
+        if self.comm.rank == 0:
+            os.ftruncate(self._fd, nbytes)
+        self.comm.barrier()
+
+    def preallocate(self, nbytes: int) -> None:
+        if self.comm.rank == 0 and self.size() < nbytes:
+            os.ftruncate(self._fd, nbytes)
+        self.comm.barrier()
+
+    # -- views --------------------------------------------------------------
+
+    def set_view(self, disp: int = 0, etype: Optional[Datatype] = None,
+                 filetype: Optional[Datatype] = None) -> None:
+        """MPI_File_set_view: collective; resets both file pointers."""
+        self.disp = int(disp)
+        self.etype = etype or BYTE
+        if filetype is not None and filetype.size % self.etype.size:
+            raise ValueError("filetype size must be a multiple of etype size")
+        self.filetype = None if (filetype is None or
+                                 filetype.is_contiguous) else filetype
+        self._pos = 0
+        if self._shared_win is not None:
+            self._seed_shared(0)
+        self.comm.barrier()
+
+    def get_view(self):
+        return self.disp, self.etype, self.filetype or self.etype
+
+    def _view_ranges(self, voff: int, nbytes: int
+                     ) -> List[Tuple[int, int]]:
+        """Map [voff, voff+nbytes) of *visible* byte space to absolute
+        (file_offset, nbytes) runs through the current view."""
+        if self.filetype is None:
+            return [(self.disp + voff, nbytes)] if nbytes else []
+        dt = self.filetype
+        count = (voff + nbytes) // dt.size + 2
+        conv = Convertor(_DUMMY, dt, count)
+        return [(self.disp + raw, n)
+                for raw, _pos, n, _dt in conv._iter_ranges(voff, nbytes)]
+
+    # -- independent IO -----------------------------------------------------
+
+    def _rw_at(self, voff_bytes: int, data: Optional[bytes],
+               nbytes: int) -> bytes | int:
+        if data is None:                       # read
+            out = bytearray()
+            for off, n in self._view_ranges(voff_bytes, nbytes):
+                out += os.pread(self._fd, n, off)
+            return bytes(out)
+        done = 0
+        for off, n in self._view_ranges(voff_bytes, len(data)):
+            os.pwrite(self._fd, data[done:done + n], off)
+            done += n
+        return done
+
+    def read_at(self, offset: int, buf: np.ndarray,
+                count: Optional[int] = None) -> int:
+        """MPI_File_read_at: ``offset`` in etypes relative to the view."""
+        arr = np.asarray(buf).reshape(-1)
+        nbytes = arr.nbytes if count is None else count * arr.itemsize
+        data = self._rw_at(offset * self.etype.size, None, nbytes)
+        got = np.frombuffer(data, np.uint8)
+        arr.view(np.uint8)[: len(got)] = got
+        return len(got) // arr.itemsize
+
+    def write_at(self, offset: int, buf: np.ndarray,
+                 count: Optional[int] = None) -> int:
+        arr = np.ascontiguousarray(buf).reshape(-1)
+        if count is not None:
+            arr = arr[:count]
+        self._rw_at(offset * self.etype.size, arr.tobytes(), 0)
+        return arr.size
+
+    def read(self, buf: np.ndarray, count: Optional[int] = None) -> int:
+        n = self.read_at(self._pos, buf, count)
+        self._pos += (n * np.asarray(buf).itemsize) // self.etype.size
+        return n
+
+    def write(self, buf: np.ndarray, count: Optional[int] = None) -> int:
+        n = self.write_at(self._pos, buf, count)
+        self._pos += (n * np.asarray(buf).itemsize) // self.etype.size
+        return n
+
+    def seek(self, offset: int, whence: int = 0) -> None:
+        if whence == 0:
+            self._pos = offset
+        elif whence == 1:
+            self._pos += offset
+        else:
+            self._pos = self.size() // self.etype.size + offset
+
+    def tell(self) -> int:
+        return self._pos
+
+    def iread_at(self, offset: int, buf):
+        from ..p2p.request import CompletedRequest
+        n = self.read_at(offset, buf)
+        return CompletedRequest(result=n)
+
+    def iwrite_at(self, offset: int, buf):
+        from ..p2p.request import CompletedRequest
+        n = self.write_at(offset, buf)
+        return CompletedRequest(result=n)
+
+    # -- collective two-phase IO (≙ fcoll/vulcan) ---------------------------
+
+    def _aggregators(self) -> List[int]:
+        n = int(_var.get("io_ompio_num_aggregators", 0))
+        if n <= 0:
+            n = min(self.comm.size, 4)
+        return list(range(min(n, self.comm.size)))
+
+    def _two_phase(self, my_runs: List[Tuple[int, int]],
+                   data: Optional[bytes]) -> Optional[bytes]:
+        """Exchange runs with aggregators; write (data given) or read."""
+        comm = self.comm
+        seq = self._coll_seq
+        self._coll_seq += 1
+        aggs = self._aggregators()
+        # file-domain split: global [lo, hi) carved evenly across aggregators
+        my_lo = min((o for o, _n in my_runs), default=np.iinfo(np.int64).max)
+        my_hi = max((o + n for o, n in my_runs), default=0)
+        bounds = comm.coll.allreduce(
+            comm, np.array([-my_lo, my_hi], np.int64), op=None)  # MAX below
+        # (allreduce default op is SUM; we need min/max — use MIN via MAX of
+        # negation, done by encoding above)
+        from ..op import MAX as _MAX
+        bounds = comm.coll.allreduce(
+            comm, np.array([-my_lo, my_hi], np.int64), op=_MAX)
+        lo, hi = -int(bounds[0]), int(bounds[1])
+        if hi <= lo:
+            return b"" if data is None else None
+        domain = max((hi - lo + len(aggs) - 1) // len(aggs), 1)
+
+        def agg_of(off: int) -> int:
+            return aggs[min((off - lo) // domain, len(aggs) - 1)]
+
+        # split my runs on domain boundaries, grouped per aggregator
+        per_agg: dict = {a: [] for a in aggs}
+        cursor = 0
+        for off, n in my_runs:
+            while n > 0:
+                a = agg_of(off)
+                dom_end = lo + (((off - lo) // domain) + 1) * domain
+                take = min(n, dom_end - off)
+                per_agg[a].append((off, take, cursor))
+                cursor += take
+                off += take
+                n -= take
+
+        tag_meta = _TAG_IO - (seq % 1000) * 4
+        tag_data = tag_meta - 1
+        tag_reply = tag_meta - 2
+        # send intents (+payload when writing) to each aggregator
+        reqs = []
+        for a in aggs:
+            runs = per_agg[a]
+            meta = np.array([len(runs)] + [v for off, n, _c in runs
+                                           for v in (off, n)], np.int64)
+            reqs.append(comm.isend(meta, a, tag_meta))
+            if data is not None:
+                chunk = b"".join(data[c:c + n] for _o, n, c in runs)
+                reqs.append(comm.isend(
+                    np.frombuffer(chunk, np.uint8) if chunk else
+                    np.zeros(0, np.uint8), a, tag_data))
+
+        # aggregator role: collect, coalesce, hit the filesystem
+        if comm.rank in aggs:
+            gathered = []       # (off, n, src, order)
+            blobs = {}
+            for src in range(comm.size):
+                st = comm.probe(src, tag_meta, timeout=60)
+                meta = np.zeros(st["count"] // 8, np.int64)
+                comm.recv(meta, src, tag_meta)
+                runs = [(int(meta[1 + 2 * i]), int(meta[2 + 2 * i]))
+                        for i in range(int(meta[0]))]
+                if data is not None:
+                    total = sum(n for _o, n in runs)
+                    blob = np.zeros(total, np.uint8)
+                    comm.recv(blob, src, tag_data)
+                    blobs[src] = blob.tobytes()
+                pos = 0
+                for off, n in runs:
+                    gathered.append((off, n, src, pos))
+                    pos += n
+            if data is not None:
+                # merge in offset order → large sequential pwrites
+                for off, n, src, pos in sorted(gathered):
+                    os.pwrite(self._fd, blobs[src][pos:pos + n], off)
+            else:
+                for off, n, src, pos in sorted(gathered):
+                    piece = os.pread(self._fd, n, off)
+                    comm.send(np.frombuffer(piece, np.uint8), src,
+                              tag_reply - 3 - src % 1)
+
+        out: Optional[bytes] = None
+        if data is None:
+            # collect replies back into visible-byte order
+            chunks = bytearray(cursor)
+            for a in aggs:
+                for off, n, c in per_agg[a]:
+                    piece = np.zeros(n, np.uint8)
+                    comm.recv(piece, a, tag_reply - 3 - comm.rank % 1)
+                    chunks[c:c + n] = piece.tobytes()
+            out = bytes(chunks)
+        for r in reqs:
+            r.wait(timeout=60)
+        comm.barrier()
+        return out
+
+    def write_at_all(self, offset: int, buf: np.ndarray,
+                     count: Optional[int] = None) -> int:
+        """MPI_File_write_at_all: two-phase collective write."""
+        arr = np.ascontiguousarray(buf).reshape(-1)
+        if count is not None:
+            arr = arr[:count]
+        runs = self._view_ranges(offset * self.etype.size, arr.nbytes)
+        self._two_phase(runs, arr.tobytes())
+        return arr.size
+
+    def read_at_all(self, offset: int, buf: np.ndarray,
+                    count: Optional[int] = None) -> int:
+        arr = np.asarray(buf).reshape(-1)
+        nbytes = arr.nbytes if count is None else count * arr.itemsize
+        runs = self._view_ranges(offset * self.etype.size, nbytes)
+        data = self._two_phase(runs, None)
+        got = np.frombuffer(data, np.uint8)
+        arr.view(np.uint8)[: len(got)] = got
+        return len(got) // arr.itemsize
+
+    def write_all(self, buf, count: Optional[int] = None) -> int:
+        n = self.write_at_all(self._pos, buf, count)
+        self._pos += (n * np.asarray(buf).itemsize) // self.etype.size
+        return n
+
+    def read_all(self, buf, count: Optional[int] = None) -> int:
+        n = self.read_at_all(self._pos, buf, count)
+        self._pos += (n * np.asarray(buf).itemsize) // self.etype.size
+        return n
+
+    # -- shared file pointer (≙ sharedfp/sm) --------------------------------
+
+    def _shared(self):
+        if self._shared_win is None:
+            from ..osc import win_allocate
+            self._shared_win = win_allocate(self.comm, 1, np.int64)
+            self._seed_shared(0)
+        return self._shared_win
+
+    def _seed_shared(self, value: int) -> None:
+        if self.comm.rank == 0 and self._shared_win is not None:
+            self._shared_win.local[0] = value
+        self.comm.barrier()
+
+    def _fetch_add_shared(self, delta: int) -> int:
+        win = self._shared()
+        res = np.zeros(1, np.int64)
+        win.lock(0)
+        win.fetch_and_op(np.array([delta], np.int64), res, 0, op=SUM)
+        win.unlock(0)
+        return int(res[0])
+
+    def read_shared(self, buf, count: Optional[int] = None) -> int:
+        arr = np.asarray(buf)
+        n = (arr.size if count is None else count)
+        etypes = (n * arr.itemsize) // self.etype.size
+        off = self._fetch_add_shared(etypes)
+        return self.read_at(off, buf, count)
+
+    def write_shared(self, buf, count: Optional[int] = None) -> int:
+        arr = np.asarray(buf)
+        n = (arr.size if count is None else count)
+        etypes = (n * arr.itemsize) // self.etype.size
+        off = self._fetch_add_shared(etypes)
+        return self.write_at(off, buf, count)
+
+    def write_ordered(self, buf, count: Optional[int] = None) -> int:
+        """MPI_File_write_ordered: rank-ordered writes from the shared
+        pointer (exscan of sizes, then one shared-pointer bump)."""
+        comm = self.comm
+        arr = np.ascontiguousarray(buf).reshape(-1)
+        if count is not None:
+            arr = arr[:count]
+        etypes = arr.nbytes // self.etype.size
+        sizes = np.array([etypes], np.int64)
+        before = comm.coll.exscan(comm, sizes)
+        before_me = 0 if comm.rank == 0 else int(np.asarray(before)[0])
+        total = int(comm.coll.allreduce(comm, sizes)[0])
+        base = self._fetch_add_shared(total) if comm.rank == 0 else 0
+        base = int(comm.coll.bcast(comm, np.array([base], np.int64))[0])
+        n = self.write_at(base + before_me, arr)
+        comm.barrier()
+        return n
+
+    def read_ordered(self, buf, count: Optional[int] = None) -> int:
+        comm = self.comm
+        arr = np.asarray(buf).reshape(-1)
+        n_el = arr.size if count is None else count
+        etypes = (n_el * arr.itemsize) // self.etype.size
+        sizes = np.array([etypes], np.int64)
+        before = comm.coll.exscan(comm, sizes)
+        before_me = 0 if comm.rank == 0 else int(np.asarray(before)[0])
+        total = int(comm.coll.allreduce(comm, sizes)[0])
+        base = self._fetch_add_shared(total) if comm.rank == 0 else 0
+        base = int(comm.coll.bcast(comm, np.array([base], np.int64))[0])
+        got = self.read_at(base + before_me, buf, count)
+        comm.barrier()
+        return got
+
+    def seek_shared(self, offset: int, whence: int = 0) -> None:
+        if self.comm.rank == 0:
+            win = self._shared()
+            if whence == 0:
+                win.local[0] = offset
+            elif whence == 1:
+                win.local[0] += offset
+            else:
+                win.local[0] = self.size() // self.etype.size + offset
+        else:
+            self._shared()
+        self.comm.barrier()
+
+    def set_atomicity(self, flag: bool) -> None:
+        self.atomicity = bool(flag)
+
+    def get_atomicity(self) -> bool:
+        return self.atomicity

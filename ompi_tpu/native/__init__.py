@@ -1,0 +1,115 @@
+"""Native (C++) components — built on demand with the baked-in toolchain.
+
+The reference is native C throughout (SURVEY.md §2: "C for every
+component"); this package is the TPU framework's native core, kept to the
+pieces where native actually pays on a TPU *host*:
+
+  * ``shmbox.cpp``    — shared-memory SPSC ring channels (≙ btl/sm)
+  * ``convertor.cpp`` — derived-datatype pack/unpack loops (≙ opal_convertor)
+
+Build strategy (no pip, no pybind11 in the image): a single ``g++ -O3
+-shared -fPIC`` invocation at first import, cached next to the sources with
+an mtime staleness check; bindings via ctypes. If the toolchain is missing
+the package degrades gracefully — ``AVAILABLE`` is False and the pure-
+python paths stay in charge (the shm transport then simply reports itself
+unavailable at selection time, the same way reference components disqualify
+themselves in their query()).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["shmbox.cpp", "convertor.cpp"]
+_LIB_NAME = "_libompitpu.so"
+
+_lock = threading.Lock()
+_lib = None
+_err: str | None = None
+
+
+def _build(lib_path: str) -> None:
+    """Compile under an exclusive file lock: concurrent processes (e.g.
+    parallel pytest invocations) must not interleave g++ output into one
+    .so. The loser of the race re-checks staleness and skips."""
+    import fcntl
+
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    with open(lib_path + ".lock", "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        if (os.path.exists(lib_path) and
+                os.path.getmtime(lib_path) >= max(
+                    os.path.getmtime(s) for s in srcs)):
+            return      # someone else built it while we waited
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o",
+               tmp, *srcs, "-lrt", "-pthread"]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+            os.replace(tmp, lib_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    lib.shmbox_attach.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
+                                  ctypes.c_int]
+    lib.shmbox_attach.restype = ctypes.c_int
+    lib.shmbox_write.argtypes = [ctypes.c_int, u8p, ctypes.c_uint32, u8p,
+                                 ctypes.c_uint32]
+    lib.shmbox_write.restype = ctypes.c_int
+    lib.shmbox_peek.argtypes = [ctypes.c_int]
+    lib.shmbox_peek.restype = ctypes.c_uint32
+    lib.shmbox_read.argtypes = [ctypes.c_int, u8p, ctypes.c_uint32]
+    lib.shmbox_read.restype = ctypes.c_int
+    lib.shmbox_close.argtypes = [ctypes.c_int]
+    lib.shmbox_close.restype = None
+    for name in ("conv_pack", "conv_unpack"):
+        fn = getattr(lib, name)
+        fn.argtypes = [u8p, u8p, ctypes.c_uint64, ctypes.c_uint64, i64p,
+                       ctypes.c_uint64]
+        fn.restype = None
+    for name in ("conv_pack_partial", "conv_unpack_partial"):
+        fn = getattr(lib, name)
+        fn.argtypes = [u8p, u8p, ctypes.c_uint64, i64p, ctypes.c_uint64,
+                       ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
+        fn.restype = None
+    return lib
+
+
+def load():
+    """Build (if stale) and load the native library; returns the ctypes
+    CDLL or None when unavailable (error kept in ``native.error()``)."""
+    global _lib, _err
+    with _lock:
+        if _lib is not None or _err is not None:
+            return _lib
+        lib_path = os.path.join(_DIR, _LIB_NAME)
+        try:
+            srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+            stale = (not os.path.exists(lib_path) or
+                     os.path.getmtime(lib_path) < max(
+                         os.path.getmtime(s) for s in srcs))
+            if stale:
+                _build(lib_path)
+            _lib = _bind(ctypes.CDLL(lib_path))
+        except Exception as exc:  # toolchain missing / build broke
+            _err = f"{type(exc).__name__}: {exc}"
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def error() -> str | None:
+    load()
+    return _err

@@ -1,0 +1,111 @@
+// Native pack/unpack loops for derived datatypes — the hot path of the
+// convertor (≙ opal/datatype/opal_convertor.c:245 pack; the reference's
+// convertor walks a compiled segment description per element).
+//
+// The python convertor (datatype/convertor.py) vectorizes contiguous runs
+// through numpy; these loops take over when a datatype decomposes into many
+// small segments per element (vector/indexed/struct), where per-segment
+// python/numpy dispatch dominates. Layout contract matches the python
+// packer exactly: for element e in [0, count), for segment s in segments,
+// copy nbytes at (e * extent + s.offset) — so the two implementations are
+// interchangeable and cross-checked in tests/test_native.py.
+//
+// C ABI for ctypes; no python dependency in this file.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// segments: n pairs of (offset, nbytes), flattened int64[2n].
+void conv_pack(uint8_t* dst, const uint8_t* src, uint64_t count,
+               uint64_t extent, const int64_t* segs, uint64_t nsegs) {
+  uint64_t pos = 0;
+  for (uint64_t e = 0; e < count; ++e) {
+    const uint8_t* base = src + e * extent;
+    for (uint64_t s = 0; s < nsegs; ++s) {
+      const uint64_t off = (uint64_t)segs[2 * s];
+      const uint64_t n = (uint64_t)segs[2 * s + 1];
+      memcpy(dst + pos, base + off, n);
+      pos += n;
+    }
+  }
+}
+
+void conv_unpack(uint8_t* dst, const uint8_t* src, uint64_t count,
+                 uint64_t extent, const int64_t* segs, uint64_t nsegs) {
+  uint64_t pos = 0;
+  for (uint64_t e = 0; e < count; ++e) {
+    uint8_t* base = dst + e * extent;
+    for (uint64_t s = 0; s < nsegs; ++s) {
+      const uint64_t off = (uint64_t)segs[2 * s];
+      const uint64_t n = (uint64_t)segs[2 * s + 1];
+      memcpy(base + off, src + pos, n);
+      pos += n;
+    }
+  }
+}
+
+// Positioned variants: pack/unpack `size` packed bytes starting at packed
+// offset `position` (the property segmented collectives and the rendezvous
+// pipeline rely on). elem_size = sum of segment nbytes.
+void conv_pack_partial(uint8_t* dst, const uint8_t* src, uint64_t extent,
+                       const int64_t* segs, uint64_t nsegs,
+                       uint64_t elem_size, uint64_t position, uint64_t size) {
+  uint64_t done = 0;
+  uint64_t e = position / elem_size;
+  uint64_t within = position % elem_size;
+  while (done < size) {
+    const uint8_t* base = src + e * extent;
+    uint64_t seg_start = 0;
+    for (uint64_t s = 0; s < nsegs && done < size; ++s) {
+      const uint64_t off = (uint64_t)segs[2 * s];
+      const uint64_t n = (uint64_t)segs[2 * s + 1];
+      if (within >= seg_start + n) {
+        seg_start += n;
+        continue;
+      }
+      const uint64_t skip = within - seg_start;
+      uint64_t take = n - skip;
+      if (take > size - done) take = size - done;
+      memcpy(dst + done, base + off + skip, take);
+      done += take;
+      within += take;
+      seg_start += n;
+    }
+    ++e;
+    within = 0;
+  }
+}
+
+void conv_unpack_partial(uint8_t* dst, const uint8_t* src, uint64_t extent,
+                         const int64_t* segs, uint64_t nsegs,
+                         uint64_t elem_size, uint64_t position,
+                         uint64_t size) {
+  uint64_t done = 0;
+  uint64_t e = position / elem_size;
+  uint64_t within = position % elem_size;
+  while (done < size) {
+    uint8_t* base = dst + e * extent;
+    uint64_t seg_start = 0;
+    for (uint64_t s = 0; s < nsegs && done < size; ++s) {
+      const uint64_t off = (uint64_t)segs[2 * s];
+      const uint64_t n = (uint64_t)segs[2 * s + 1];
+      if (within >= seg_start + n) {
+        seg_start += n;
+        continue;
+      }
+      const uint64_t skip = within - seg_start;
+      uint64_t take = n - skip;
+      if (take > size - done) take = size - done;
+      memcpy(base + off + skip, src + done, take);
+      done += take;
+      within += take;
+      seg_start += n;
+    }
+    ++e;
+    within = 0;
+  }
+}
+
+}  // extern "C"

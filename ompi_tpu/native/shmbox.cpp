@@ -1,0 +1,190 @@
+// Shared-memory SPSC ring channels — the native data plane of the `shm`
+// transport (≙ opal/mca/btl/sm: shared-memory BTL with per-peer fast
+// boxes, btl_sm_fbox.h:31-35, over common/sm segment helpers).
+//
+// Design, TPU-host flavored: one POSIX shm segment per *directed* rank
+// pair, holding a single-producer single-consumer byte ring. Frames are
+// [u32 total][u32 hdr_len][hdr][payload] rounded up to 8 bytes; head/tail
+// are monotonic u64 offsets so free space is (capacity - (head - tail)).
+// Release/acquire atomics give the same lock-free ordering discipline the
+// reference's fbox sequence numbers provide; per-channel FIFO is exactly
+// the ordering guarantee the p2p protocol needs (single-transport
+// non-overtaking, like single-BTL ordering in the reference).
+//
+// C ABI only (called from python via ctypes — no pybind11 in this image).
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x544d4253;  // "SBMT"
+constexpr size_t kHdrBytes = 64;         // control block, cacheline padded
+
+struct Control {
+  uint32_t magic;
+  uint32_t capacity;                     // ring data bytes
+  std::atomic<uint64_t> head;            // writer position (monotonic)
+  char _pad1[40];
+  std::atomic<uint64_t> tail;            // reader position (monotonic)
+};
+static_assert(sizeof(Control) <= kHdrBytes, "control block too big");
+
+struct Chan {
+  Control* ctl = nullptr;
+  uint8_t* data = nullptr;
+  size_t map_len = 0;
+  bool creator = false;
+  char name[128] = {0};
+};
+
+// Stable-address handle table: heap-allocated entries so concurrent
+// attach() (threaded ranks) can never invalidate a Chan* another thread is
+// using mid-write the way vector<Chan> reallocation would.
+std::vector<Chan*>& table() {
+  static std::vector<Chan*> t;
+  return t;
+}
+std::mutex& table_mu() {
+  static std::mutex m;
+  return m;
+}
+
+inline uint64_t round8(uint64_t v) { return (v + 7) & ~uint64_t(7); }
+
+// copy into the ring at logical offset `pos` with wraparound
+void ring_write(Chan& c, uint64_t pos, const uint8_t* src, uint64_t n) {
+  const uint32_t cap = c.ctl->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  memcpy(c.data + off, src, first);
+  if (n > first) memcpy(c.data, src + first, n - first);
+}
+
+void ring_read(Chan& c, uint64_t pos, uint8_t* dst, uint64_t n) {
+  const uint32_t cap = c.ctl->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = (off + n <= cap) ? n : cap - off;
+  memcpy(dst, c.data + off, first);
+  if (n > first) memcpy(dst + first, c.data, n - first);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create (O_CREAT|O_TRUNC) or open an existing channel. Returns a handle
+// >= 0, or -1 on failure. `capacity` is ignored when opening.
+int shmbox_attach(const char* name, uint32_t capacity, int create) {
+  size_t map_len = kHdrBytes + (create ? capacity : 0);
+  int fd;
+  if (create) {
+    fd = shm_open(name, O_CREAT | O_TRUNC | O_RDWR, 0600);
+    if (fd < 0) return -1;
+    if (ftruncate(fd, (off_t)(kHdrBytes + capacity)) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return -1;
+    }
+    map_len = kHdrBytes + capacity;
+  } else {
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return -1;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || (size_t)st.st_size <= kHdrBytes) {
+      close(fd);
+      return -1;
+    }
+    map_len = (size_t)st.st_size;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return -1;
+
+  Chan c;
+  c.ctl = reinterpret_cast<Control*>(mem);
+  c.data = reinterpret_cast<uint8_t*>(mem) + kHdrBytes;
+  c.map_len = map_len;
+  c.creator = create != 0;
+  strncpy(c.name, name, sizeof(c.name) - 1);
+  if (create) {
+    c.ctl->capacity = capacity;
+    c.ctl->head.store(0, std::memory_order_relaxed);
+    c.ctl->tail.store(0, std::memory_order_relaxed);
+    std::atomic_thread_fence(std::memory_order_release);
+    c.ctl->magic = kMagic;
+  } else if (c.ctl->magic != kMagic) {
+    munmap(mem, map_len);
+    return -1;  // not initialized yet; caller retries
+  }
+  std::lock_guard<std::mutex> g(table_mu());
+  table().push_back(new Chan(c));
+  return (int)table().size() - 1;
+}
+
+// Write one frame. Returns 0 on success, -1 if the ring lacks space
+// (caller queues and retries), -2 if the frame can never fit.
+int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
+                 const uint8_t* payload, uint32_t plen) {
+  Chan& c = *table()[h];
+  const uint64_t need = round8(8ull + hlen + plen);
+  if (need > c.ctl->capacity) return -2;
+  uint64_t head = c.ctl->head.load(std::memory_order_relaxed);
+  uint64_t tail = c.ctl->tail.load(std::memory_order_acquire);
+  if (need > c.ctl->capacity - (head - tail)) return -1;
+  uint32_t lens[2] = {(uint32_t)(8 + hlen + plen), hlen};
+  ring_write(c, head, reinterpret_cast<uint8_t*>(lens), 8);
+  ring_write(c, head + 8, hdr, hlen);
+  ring_write(c, head + 8 + hlen, payload, plen);
+  c.ctl->head.store(head + need, std::memory_order_release);
+  return 0;
+}
+
+// Size in bytes of the next pending frame (without the 8-byte length
+// prefix), or 0 when empty.
+uint32_t shmbox_peek(int h) {
+  Chan& c = *table()[h];
+  uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
+  uint64_t head = c.ctl->head.load(std::memory_order_acquire);
+  if (head == tail) return 0;
+  uint32_t lens[2];
+  ring_read(c, tail, reinterpret_cast<uint8_t*>(lens), 8);
+  return lens[0] - 8;
+}
+
+// Pop the next frame into `buf` (must be >= shmbox_peek(h) bytes).
+// Returns header length, with header bytes first then payload; -1 if empty.
+int shmbox_read(int h, uint8_t* buf, uint32_t buflen) {
+  Chan& c = *table()[h];
+  uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
+  uint64_t head = c.ctl->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint32_t lens[2];
+  ring_read(c, tail, reinterpret_cast<uint8_t*>(lens), 8);
+  uint32_t body = lens[0] - 8;
+  if (body > buflen) return -1;
+  ring_read(c, tail + 8, buf, body);
+  c.ctl->tail.store(tail + round8(lens[0]), std::memory_order_release);
+  return (int)lens[1];
+}
+
+void shmbox_close(int h) {
+  std::lock_guard<std::mutex> g(table_mu());
+  Chan& c = *table()[h];
+  if (c.ctl) {
+    if (c.creator) shm_unlink(c.name);
+    munmap(c.ctl, c.map_len);
+    c.ctl = nullptr;
+    c.data = nullptr;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,248 @@
+"""Block flash attention as a Pallas TPU kernel.
+
+Design (pallas_guide.md patterns): grid = (batch*heads, q_blocks, kv_blocks)
+with the kv dimension innermost — on TPU the innermost grid dimension is
+sequential per core, so the online-softmax state (row max ``m``, denominator
+``l``, un-normalized accumulator ``acc``) lives in VMEM scratch and is
+carried across kv steps; the final kv step normalizes and writes the output
+block. Scores and accumulation are float32 on the MXU regardless of input
+dtype (bfloat16 inputs stay bfloat16 in HBM/VMEM).
+
+Two entry points:
+  * ``flash_attention`` — self-contained attention (optionally causal);
+  * ``flash_attention_partials`` — returns the *un-normalized* (o, m, l)
+    triple for a Q-shard against one visiting K/V shard, with global
+    position offsets for the causal mask.  This is the per-step block
+    compute of ring attention (parallel/ring.py), which merges partials
+    across ring hops — the kernel analog of the reference's segmented ring
+    schedule (coll_base_allreduce.c:621).
+
+Interpret mode (``interpret=True``) runs the same kernels on CPU for tests;
+on TPU backends the default is the compiled path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _default_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(s_q: int, s_k: int, block_q: Optional[int],
+                 block_k: Optional[int]) -> Tuple[int, int]:
+    bq = min(block_q or 256, s_q)
+    bk = min(block_k or 256, s_k)
+    if s_q % bq or s_k % bk:
+        raise ValueError(f"seq lengths ({s_q},{s_k}) must divide into "
+                         f"blocks ({bq},{bk})")
+    return bq, bk
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  kv_steps: int, q_off: int, kv_off: int):
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = pl.program_id(1)
+        rows = (q_off + qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        cols = (kv_off + ki * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(rows >= cols, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]
+    l_prev = l_ref[:, 0]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur[:, None])
+    l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+    acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                    + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32))
+    m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[:, 0], 1e-20)
+        o_ref[0] = (acc_ref[...] / denom[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    causal: bool = False, scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Attention over (batch, seq, heads, head_dim) inputs.
+
+    q may have a different sequence length than k/v (cross attention);
+    ``causal`` assumes both sequences start at position 0.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    kv_steps = s_k // bk
+
+    qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
+    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
+    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=float(scale), causal=bool(causal), block_q=bq,
+        block_k=bk, kv_steps=kv_steps, q_off=0, kv_off=0)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s_q // bq, kv_steps),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return jnp.moveaxis(out.reshape(b, h, s_q, d), 1, 2)
+
+
+def _partials_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_out, l_out,
+                     m_ref, l_ref, acc_ref, *, scale: float, causal: bool,
+                     block_q: int, block_k: int, kv_steps: int):
+    """Same state machine, but emits un-normalized (o, m, l).
+
+    ``off_ref`` is an SMEM (2,) int32 holding the (q, kv) global position
+    offsets — *runtime* values, so ring attention can feed it the traced
+    per-hop shard origin (lax.axis_index arithmetic)."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[...] = jnp.full(m_ref.shape, NEG_INF, m_ref.dtype)
+        l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
+        acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
+
+    q = q_ref[0].astype(jnp.float32)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = pl.program_id(1)
+        rows = (off_ref[0] + qi * block_q
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+        cols = (off_ref[1] + ki * block_k
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+        s = jnp.where(rows >= cols, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]
+    l_prev = l_ref[:, 0]
+    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+    alpha = jnp.exp(m_prev - m_cur)
+    p = jnp.exp(s - m_cur[:, None])
+    l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+    acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                    + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32))
+    m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+        m_out[0] = m_ref[:, 0]
+        l_out[0] = l_ref[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret", "vma"))
+def flash_attention_partials(q: jax.Array, k: jax.Array, v: jax.Array,
+                             causal: bool = False,
+                             scale: Optional[float] = None,
+                             q_offset=0, kv_offset=0,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None,
+                             interpret: Optional[bool] = None,
+                             vma=None):
+    """Un-normalized flash partials for ring attention's merge step.
+
+    q/k/v: (bh, seq, head_dim) — already folded (batch*heads) as in the ring
+    loop. ``q_offset``/``kv_offset`` are the *global* positions of the local
+    Q shard and the visiting K/V shard — python ints or traced int scalars
+    (ring attention passes lax.axis_index arithmetic). Returns (o, m, l):
+    o un-normalized (bh, s_q, d) float32, m/l (bh, s_q) float32.
+    """
+    if interpret is None:
+        interpret = _default_interpret()
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    kv_steps = s_k // bk
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+
+    kernel = functools.partial(
+        _partials_kernel, scale=float(scale), causal=bool(causal),
+        block_q=bq, block_k=bk, kv_steps=kv_steps)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=(bh, s_q // bq, kv_steps),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, bq), lambda b, qi, ki: (b, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q), jnp.float32, vma=vma),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, q, k, v)
+    return o, m, l

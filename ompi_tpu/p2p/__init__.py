@@ -12,3 +12,4 @@ from .request import (  # noqa: F401
 )
 from .transport import AM_COLL, AM_FT, AM_OSC, AM_P2P, Transport, TransportLayer  # noqa: F401
 from .pml import P2P, TruncateError  # noqa: F401
+from .part import precv_init, psend_init  # noqa: F401  (MPI-4 partitioned)

@@ -79,11 +79,17 @@ class MatchingEngine:
         self._posted[cid].append(p)
         return p
 
-    def fail_src(self, src: int, err: Exception) -> None:
-        """Complete every posted receive naming ``src`` with ``err``
-        (ULFM: operations on a failed peer must not hang)."""
-        for lst in self._posted.values():
-            for p in [p for p in lst if p.src == src]:
+    def fail_src(self, src: int, err: Exception,
+                 any_source_cids=frozenset()) -> None:
+        """Complete every posted receive naming ``src`` with ``err`` (ULFM:
+        operations on a failed peer must not hang). ANY_SOURCE receives are
+        failed too, on the communicators listed in ``any_source_cids`` (those
+        whose group contains the failed rank — computed by the caller, which
+        knows the cid→comm map)."""
+        for cid, lst in self._posted.items():
+            hit = [p for p in lst if p.src == src
+                   or (p.src == ANY_SOURCE and cid in any_source_cids)]
+            for p in hit:
                 lst.remove(p)
                 if p.req is not None:
                     p.req.complete(err)

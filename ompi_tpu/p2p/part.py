@@ -1,0 +1,197 @@
+"""Partitioned point-to-point (MPI-4, ≙ ompi/mca/part — part.h:30,124,150
+and the `persist` component).
+
+Partitioned communication lets a sender mark sub-ranges ("partitions") of
+one buffer ready independently — the fine-grained pipelining primitive
+pipeline-parallel training uses to overlap microbatch compute with
+transfers (SURVEY.md §2.6 maps PP onto partitioned sends).
+
+Design (persist component semantics, TPU-host flavored):
+  * ``psend_init``/``precv_init`` create persistent requests; ``start()``
+    arms one round, ``pready(i)`` releases sender partition i as its own
+    internal message, ``parrived(j)`` tests receiver partition j.
+  * The two sides may partition differently (MPI allows it; only the total
+    element count must match). Sender partition messages land at their
+    global element offset; receiver partition j is "arrived" when every
+    overlapping sender partition has landed.
+  * A one-time handshake on the user-visible (src, tag) channel carries the
+    sender's partitioning and a session id that scopes the internal
+    per-partition tags — the persistent-init matching the reference does
+    once per request pair (part.h setup exchange).
+
+Internal tags live in the -300000 band (user tags ≥ 0; coll/nbc bands are
+documented in coll/nbc.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from ..datatype import Datatype
+from .request import Request
+
+_TAG_PART_SETUP = -3000         # handshake rides (this - user_tag) channel
+_TAG_PART_BASE = -300000        # per-partition data tags
+_MAX_PARTS = 4096
+
+_sess_lock = threading.Lock()
+_sess_counter = 0
+
+
+def _new_session(rank: int) -> int:
+    global _sess_counter
+    with _sess_lock:
+        _sess_counter += 1
+        return rank * 100_000 + (_sess_counter % 100_000)
+
+
+def _part_tag(session: int, index: int) -> int:
+    return _TAG_PART_BASE - session * _MAX_PARTS - index
+
+
+class PartitionedRequest(Request):
+    """Base for both directions; inactive between rounds like persistent
+    requests (MPI_Start semantics)."""
+
+    def __init__(self, comm, buf, partitions: int, peer: int, tag: int) -> None:
+        super().__init__()
+        arr = np.asarray(buf)
+        if arr.size % partitions:
+            raise ValueError(
+                f"count {arr.size} not divisible into {partitions} partitions")
+        self.comm = comm
+        self.buf = arr
+        self.partitions = partitions
+        self.part_elems = arr.size // partitions
+        self.peer = peer
+        self.tag = tag
+        self.active = False
+        self.done = True          # inactive requests test as complete
+
+    def _flat(self) -> np.ndarray:
+        return self.buf.reshape(-1)
+
+
+class PsendRequest(PartitionedRequest):
+    def __init__(self, comm, buf, partitions: int, dst: int, tag: int) -> None:
+        super().__init__(comm, buf, partitions, dst, tag)
+        self.session = _new_session(comm.ctx.rank)
+        self._handshook = False
+        self._ready: List[bool] = []
+        self._reqs: List[Optional[Request]] = []
+
+    def start(self) -> "PsendRequest":
+        if self.active:
+            raise RuntimeError("partitioned request already active")
+        self.active = True
+        self.done = False
+        self.error = None
+        self._ready = [False] * self.partitions
+        self._reqs = [None] * self.partitions
+        if not self._handshook:
+            # one-time setup on the user tag channel: [session, nparts, total]
+            setup = np.array([self.session, self.partitions, self.buf.size],
+                             np.int64)
+            self.comm.isend(setup, self.peer, _TAG_PART_SETUP - max(self.tag, 0))
+            self._handshook = True
+        return self
+
+    def pready(self, index) -> None:
+        """MPI_Pready / MPI_Pready_range: release partition(s)."""
+        idxs = [index] if np.isscalar(index) else list(index)
+        flat = self._flat()
+        for i in idxs:
+            if not self.active:
+                raise RuntimeError("pready on inactive request")
+            if self._ready[i]:
+                raise RuntimeError(f"partition {i} already marked ready")
+            self._ready[i] = True
+            lo = i * self.part_elems
+            seg = flat[lo:lo + self.part_elems]
+            self._reqs[i] = self.comm.isend(
+                seg, self.peer, _part_tag(self.session, i))
+        if all(self._ready):
+            def _check(_req=None):
+                if all(r is not None and r.done for r in self._reqs):
+                    self.active = False
+                    self.complete()
+            for r in self._reqs:
+                r.add_completion_callback(lambda _r: _check())
+            _check()
+
+
+class PrecvRequest(PartitionedRequest):
+    def __init__(self, comm, buf, partitions: int, src: int, tag: int) -> None:
+        super().__init__(comm, buf, partitions, src, tag)
+        self._setup: Optional[np.ndarray] = None
+        self._arrived_elems = 0
+        self._landed: List[bool] = []     # per SENDER partition
+        self._sender_parts = 0
+        self._sender_elems = 0
+
+    def start(self) -> "PrecvRequest":
+        if self.active:
+            raise RuntimeError("partitioned request already active")
+        self.active = True
+        self.done = False
+        self.error = None
+        if self._setup is None:
+            setup = np.zeros(3, np.int64)
+            self.comm.recv(setup, self.peer,
+                           _TAG_PART_SETUP - max(self.tag, 0))
+            if int(setup[2]) != self.buf.size:
+                raise ValueError(
+                    f"partitioned total mismatch: sender {int(setup[2])} "
+                    f"elements, receiver {self.buf.size}")
+            self._setup = setup
+            self._sender_parts = int(setup[1])
+            self._sender_elems = self.buf.size // self._sender_parts
+        self._landed = [False] * self._sender_parts
+        session = int(self._setup[0])
+        flat = self._flat()
+        for i in range(self._sender_parts):
+            lo = i * self._sender_elems
+            seg = flat[lo:lo + self._sender_elems]
+            req = self.comm.irecv(seg, self.peer, _part_tag(session, i))
+            req.add_completion_callback(
+                lambda _r, i=i: self._on_landed(i, _r))
+        return self
+
+    def _on_landed(self, i: int, req: Request) -> None:
+        if req.error is not None:
+            self.active = False
+            self.complete(req.error)
+            return
+        self._landed[i] = True
+        if all(self._landed):
+            self.active = False
+            self.complete()
+
+    def parrived(self, index: int) -> bool:
+        """MPI_Parrived: has receiver partition ``index`` fully arrived?"""
+        lo = index * self.part_elems
+        hi = lo + self.part_elems
+        s0 = lo // self._sender_elems if self._sender_elems else 0
+        s1 = (hi - 1) // self._sender_elems if self._sender_elems else 0
+        self.comm.ctx.engine.progress()
+        return all(self._landed[s] for s in range(s0, s1 + 1))
+
+
+def psend_init(comm, buf, partitions: int, dst: int, tag: int = 0,
+               datatype: Optional[Datatype] = None) -> PsendRequest:
+    """MPI_Psend_init (contiguous numpy buffers; derived datatypes go
+    through the convertor at the pml layer as usual)."""
+    if partitions < 1 or partitions > _MAX_PARTS:
+        raise ValueError(f"partitions must be in [1, {_MAX_PARTS}]")
+    return PsendRequest(comm, buf, partitions, dst, tag)
+
+
+def precv_init(comm, buf, partitions: int, src: int, tag: int = 0,
+               datatype: Optional[Datatype] = None) -> PrecvRequest:
+    """MPI_Precv_init."""
+    if partitions < 1 or partitions > _MAX_PARTS:
+        raise ValueError(f"partitions must be in [1, {_MAX_PARTS}]")
+    return PrecvRequest(comm, buf, partitions, src, tag)

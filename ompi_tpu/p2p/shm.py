@@ -1,0 +1,162 @@
+"""Shared-memory transport over native SPSC rings (≙ opal/mca/btl/sm).
+
+The reference's fastest intra-node byte transport is the shared-memory BTL:
+per-peer mmap'd segments with lock-free "fast box" mailboxes
+(btl_sm_fbox.h:31-35). Here the ring machinery is native C++
+(native/shmbox.cpp) and this component owns the lifecycle:
+
+  * at init each rank *creates* one directed ring per peer for its inbound
+    side (peer→me) and publishes its host identity through the modex;
+  * senders lazily open the (me→peer) ring after the startup fence;
+  * per-channel FIFO gives the non-overtaking order p2p relies on;
+  * a full ring parks frames on a pending queue flushed from progress() —
+    ordering is preserved because new sends append behind pending ones.
+
+Selection: priority 50 — above tcp (10) for same-host peers, below self
+(100) for loopback. ``open()`` disqualifies the component when the native
+library can't be built, the same way reference components disqualify
+themselves in query (e.g. no /dev/shm → btl/sm out).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import socket
+from collections import deque
+from typing import Any, Dict, Optional
+
+from .. import native
+from ..core import var as _var
+from ..core.component import component
+from . import transport as T
+
+_var.register("transport", "shm", "ring_size", 1 << 21, type=int, level=4,
+              help="Bytes per directed shared-memory ring channel.")
+
+
+def _host_key() -> str:
+    return socket.gethostname()
+
+
+def _chan_name(job: str, src: int, dst: int) -> bytes:
+    safe = "".join(c for c in str(job) if c.isalnum())[-24:]
+    return f"/otpu_{safe}_{src}to{dst}".encode()
+
+
+@component("transport", "shm", priority=50)
+class ShmTransport(T.Transport):
+    name = "shm"
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.rank = -1
+        self.size = 0
+        self._bootstrap = None
+        self._lib = None
+        self._rx: Dict[int, int] = {}        # peer → handle (peer→me ring)
+        self._tx: Dict[int, int] = {}        # peer → handle (me→peer ring)
+        self._pending: Dict[int, deque] = {}  # peer → frames awaiting space
+        self._hosts: Dict[int, Optional[str]] = {}
+        self._ring = int(_var.get("transport_shm_ring_size", 1 << 21))
+        # cap fragments so one frame can never exceed half a ring
+        self.max_send_size = min(self.max_send_size, self._ring // 4)
+
+    def open(self) -> bool:
+        return native.available()
+
+    def init_job(self, bootstrap) -> None:
+        self._lib = native.load()
+        self.rank, self.size = bootstrap.rank, bootstrap.size
+        self._bootstrap = bootstrap
+        bootstrap.put("transport_shm_host", _host_key())
+        for peer in range(self.size):
+            if peer == self.rank:
+                continue
+            h = self._lib.shmbox_attach(
+                _chan_name(bootstrap.job_id, peer, self.rank), self._ring, 1)
+            if h >= 0:
+                self._rx[peer] = h
+
+    def reachable(self, peer: int) -> bool:
+        if peer == self.rank or not (0 <= peer < self.size):
+            return False
+        host = self._hosts.get(peer, False)
+        if host is False:
+            try:
+                host = self._bootstrap.get(peer, "transport_shm_host")
+            except Exception:
+                host = None
+            self._hosts[peer] = host
+        return host == _host_key()
+
+    # -- tx -----------------------------------------------------------------
+
+    def _tx_handle(self, peer: int) -> int:
+        h = self._tx.get(peer)
+        if h is None:
+            h = self._lib.shmbox_attach(
+                _chan_name(self._bootstrap.job_id, self.rank, peer), 0, 0)
+            if h < 0:
+                raise RuntimeError(
+                    f"shm transport: cannot open channel to rank {peer}")
+            self._tx[peer] = h
+        return h
+
+    def _try_write(self, peer: int, hdr: bytes, payload) -> bool:
+        h = self._tx_handle(peer)
+        hp = (ctypes.c_uint8 * len(hdr)).from_buffer_copy(hdr)
+        n = len(payload)
+        if n:
+            pp = (ctypes.c_uint8 * n).from_buffer_copy(payload)
+        else:
+            pp = (ctypes.c_uint8 * 1)()
+        rc = self._lib.shmbox_write(h, hp, len(hdr), pp, n)
+        if rc == -2:
+            raise ValueError(
+                f"frame of {len(hdr)}+{n} bytes exceeds shm ring capacity "
+                f"{self._ring} (raise transport_shm_ring_size)")
+        return rc == 0
+
+    def send(self, peer: int, tag: int, header: Dict[str, Any],
+             payload: bytes) -> None:
+        hdr = pickle.dumps((tag, header), protocol=pickle.HIGHEST_PROTOCOL)
+        q = self._pending.get(peer)
+        if q:
+            q.append((hdr, payload))    # keep FIFO behind parked frames
+            return
+        if not self._try_write(peer, hdr, payload):
+            self._pending.setdefault(peer, deque()).append((hdr, payload))
+
+    # -- rx / progress ------------------------------------------------------
+
+    def progress(self) -> int:
+        n = 0
+        for peer, q in list(self._pending.items()):
+            while q:
+                hdr, payload = q[0]
+                if not self._try_write(peer, hdr, payload):
+                    break
+                q.popleft()
+                n += 1
+        for peer, h in self._rx.items():
+            while True:
+                sz = self._lib.shmbox_peek(h)
+                if sz == 0:
+                    break
+                buf = (ctypes.c_uint8 * sz)()
+                hlen = self._lib.shmbox_read(h, buf, sz)
+                if hlen < 0:
+                    break
+                raw = bytes(buf)
+                tag, header = pickle.loads(raw[:hlen])
+                self.deliver(peer, tag, header, raw[hlen:])
+                n += 1
+        return n
+
+    def finalize(self) -> None:
+        for h in list(self._tx.values()) + list(self._rx.values()):
+            self._lib.shmbox_close(h)
+        self._tx.clear()
+        self._rx.clear()

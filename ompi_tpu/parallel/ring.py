@@ -10,8 +10,8 @@ softmax merging so sequence length scales linearly with ring size at O(seq/n)
 memory per chip.
 
 The inner block-attention is a plain jnp function by default (XLA fuses it
-well) and can be swapped for the Pallas flash kernel (ops/attention.py) via
-``block_fn`` for the VMEM-resident fast path.
+well); pass ``block_impl="pallas"`` to use the Pallas flash kernel
+(ops/attention.py flash_attention_partials) for the VMEM-resident fast path.
 """
 
 from __future__ import annotations
@@ -58,7 +58,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    axis: str = "sp", causal: bool = False,
                    scale: Optional[float] = None,
                    batch_axis: Optional[str] = None,
-                   head_axis: Optional[str] = None) -> jax.Array:
+                   head_axis: Optional[str] = None,
+                   block_impl: str = "jnp") -> jax.Array:
     """Attention over a sequence sharded on `axis`.
 
     q/k/v: (batch, seq, heads, head_dim) with seq sharded over `axis`;
@@ -71,13 +72,14 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
     return _build_ring(mesh, axis, bool(causal), float(scale),
-                       batch_axis, head_axis)(q, k, v)
+                       batch_axis, head_axis, block_impl)(q, k, v)
 
 
 @functools.lru_cache(maxsize=128)
 def _build_ring(mesh: Mesh, axis: str, causal: bool, scale: float,
                 batch_axis: Optional[str] = None,
-                head_axis: Optional[str] = None):
+                head_axis: Optional[str] = None,
+                block_impl: str = "jnp"):
     """Compiled-program cache: one executable per (mesh, axis, causal, scale)
     × (shape, dtype) — the coll/xla cache discipline (SURVEY.md §7)."""
     n = mesh.shape[axis]
@@ -96,16 +98,28 @@ def _build_ring(mesh: Mesh, axis: str, causal: bool, scale: float,
         def step(i, carry):
             o, m, l, kf, vf = carry
             src = (my - i) % n                   # whose K/V is visiting
-            kv_pos = src * s + jnp.arange(s)
-            if causal:
-                mask = jnp.where(q_pos[:, None] >= kv_pos[None, :],
-                                 0.0, NEG_INF).astype(qf.dtype)
+            if block_impl == "pallas":
+                # VMEM-resident flash kernel (ops/attention.py) with the
+                # traced global offsets driving the causal mask
+                from ..ops.attention import flash_attention_partials
+                bo, bm, bl = flash_attention_partials(
+                    qf, kf, vf, causal=causal, scale=scale,
+                    q_offset=my * s, kv_offset=src * s,
+                    vma=frozenset(a for a in (batch_axis, axis, head_axis)
+                                  if a is not None))
+                bo = bo.astype(qf.dtype)
+                bm = bm.astype(qf.dtype)
+                bl = bl.astype(qf.dtype)
             else:
-                mask = None
-
-            bo, bm, bl = jax.vmap(
-                lambda qq, kk, vv: _block_attn(qq, kk, vv, scale, mask)
-            )(qf, kf, vf)
+                kv_pos = src * s + jnp.arange(s)
+                if causal:
+                    mask = jnp.where(q_pos[:, None] >= kv_pos[None, :],
+                                     0.0, NEG_INF).astype(qf.dtype)
+                else:
+                    mask = None
+                bo, bm, bl = jax.vmap(
+                    lambda qq, kk, vv: _block_attn(qq, kk, vv, scale, mask)
+                )(qf, kf, vf)
             o, m, l = jax.vmap(_merge)(o, m, l, bo, bm, bl)
             # rotate K/V to the next ring position
             perm = [(j, (j + 1) % n) for j in range(n)]
@@ -113,21 +127,32 @@ def _build_ring(mesh: Mesh, axis: str, causal: bool, scale: float,
             vf = lax.ppermute(vf, axis, perm)
             return o, m, l, kf, vf
 
-        o0 = jnp.zeros_like(qf)
-        # mark the scalar accumulators device-varying over every manual axis
-        # so the fori carry types match the per-shard outputs (vma rules)
-        axes = tuple(mesh.axis_names)
-        m0 = lax.pcast(jnp.full(qf.shape[:2], NEG_INF, qf.dtype),
-                       axes, to="varying")
-        l0 = lax.pcast(jnp.zeros(qf.shape[:2], qf.dtype), axes,
-                       to="varying")
+        # mark the accumulators device-varying over exactly the mesh axes
+        # this program's inputs are sharded on, so the fori carry types match
+        # the per-shard outputs (vma rules)
+        axes = tuple(a for a in (batch_axis, axis, head_axis)
+                     if a is not None)
+
+        def vary_all(x):
+            if block_impl == "pallas":     # vma tracking is off (see below)
+                return x
+            missing = tuple(a for a in axes if a not in jax.typeof(x).vma)
+            return lax.pcast(x, missing, to="varying") if missing else x
+
+        o0 = vary_all(jnp.zeros_like(qf))
+        m0 = vary_all(jnp.full(qf.shape[:2], NEG_INF, qf.dtype))
+        l0 = vary_all(jnp.zeros(qf.shape[:2], qf.dtype))
         o, m, l, _, _ = lax.fori_loop(0, n, step, (o0, m0, l0, kf0, vf0))
         out = o / jnp.maximum(l, 1e-20)[:, :, None]
         return jnp.moveaxis(out.reshape(b, h, s, d), 1, 2)
 
     spec = P(batch_axis, axis, head_axis, None)
+    # check_vma off for the pallas block: the interpret-mode pallas_call
+    # lowering can't yet propagate varying-manual-axes through its internal
+    # dynamic_slice (jax suggests this exact workaround).
     return jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                                 out_specs=spec))
+                                 out_specs=spec,
+                                 check_vma=(block_impl != "pallas")))
 
 
 def attention_reference(q, k, v, causal: bool = False,

@@ -33,7 +33,7 @@ from .control import Bootstrap, from_environment
 from .core.component import frameworks
 from .core.output import output
 from .core.progress import ProgressEngine, set_engine
-from .p2p import selftrans, tcp  # noqa: F401  (register transport components)
+from .p2p import selftrans, shm, tcp  # noqa: F401  (register transports)
 from .p2p.pml import P2P
 from .p2p.transport import TransportLayer
 
@@ -111,14 +111,22 @@ def finalize() -> None:
         _process_ctx = None
 
 
+_job_seq = 0
+
+
 def run_ranks(n: int, fn: Callable[[Context], object],
               timeout: float = 60.0) -> List[object]:
     """Run ``fn(ctx)`` on n threaded ranks wired through a LocalBootstrap —
     the in-process analog of ``tpurun -np n`` used by the test suite
     (SURVEY.md §4: the reference tests multi-rank logic single-host)."""
+    import os
+
     from .control.bootstrap import LocalBootstrap
 
-    boots = LocalBootstrap.create_job(n, job_id="threaded")
+    global _job_seq
+    _job_seq += 1
+    boots = LocalBootstrap.create_job(
+        n, job_id=f"thr{os.getpid()}n{_job_seq}")
     results: List[object] = [None] * n
     errors: List[BaseException | None] = [None] * n
 

@@ -143,3 +143,43 @@ def test_shrink_and_continue():
     assert res[1] is None
     expect = float(0 + 2 + 3)
     assert [r for r in res if r is not None] == [expect] * 3
+
+
+def test_any_source_recv_fails_on_peer_death():
+    """ULFM: an ANY_SOURCE receive must not hang when a member of the
+    communicator dies (simplified here to fail-stop completion)."""
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 1:
+            ft.simulate_failure(ctx)
+            time.sleep(1.5)
+            return True
+        from ompi_tpu.p2p import ANY_SOURCE
+        req = comm.irecv(np.zeros(4), src=ANY_SOURCE, tag=9)
+        with pytest.raises(ft.ProcFailedError):
+            req.wait(timeout=10)
+        return True
+    assert all(runtime.run_ranks(2, body, timeout=60))
+
+
+def test_agree_uniform_with_mid_operation_failure():
+    """A rank dying *during* the agreement must not break uniformity: all
+    returning survivors get the same value (the coordinator protocol's
+    whole point)."""
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        if ctx.rank == 2:
+            # die just as the others start agreeing
+            time.sleep(0.1)
+            ft.simulate_failure(ctx)
+            time.sleep(2.5)
+            return None
+        flags = {0: 0b1110, 1: 0b0111, 2: 0b1011, 3: 0b1101}
+        return ft.agree(comm, flags[ctx.rank])
+    res = runtime.run_ranks(4, body, timeout=90)
+    vals = [r for r in res if r is not None]
+    assert len(set(vals)) == 1, f"non-uniform agreement: {res}"

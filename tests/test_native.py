@@ -1,0 +1,174 @@
+"""Native (C++) component tests: build, shmbox rings, convertor loops, and
+the shm transport end-to-end (≙ test/class + btl/sm behavior checks)."""
+
+import ctypes
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ompi_tpu import native, runtime
+from ompi_tpu.datatype import FLOAT64, INT32, Convertor, Datatype
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason=f"native build failed: {native.error()}")
+
+
+class TestShmbox:
+    def test_roundtrip(self):
+        lib = native.load()
+        name = f"/otpu_test_{os.getpid()}_rt".encode()
+        w = lib.shmbox_attach(name, 1 << 16, 1)
+        r = lib.shmbox_attach(name, 0, 0)
+        assert w >= 0 and r >= 0
+        hdr = pickle.dumps((7, {"x": 1}))
+        payload = b"abcdefgh" * 100
+        hp = (ctypes.c_uint8 * len(hdr)).from_buffer_copy(hdr)
+        pp = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        assert lib.shmbox_write(w, hp, len(hdr), pp, len(payload)) == 0
+        sz = lib.shmbox_peek(r)
+        assert sz == len(hdr) + len(payload)
+        buf = (ctypes.c_uint8 * sz)()
+        hlen = lib.shmbox_read(r, buf, sz)
+        assert hlen == len(hdr)
+        raw = bytes(buf)
+        assert pickle.loads(raw[:hlen]) == (7, {"x": 1})
+        assert raw[hlen:] == payload
+        assert lib.shmbox_peek(r) == 0
+        lib.shmbox_close(r)
+        lib.shmbox_close(w)
+
+    def test_fifo_and_wraparound(self):
+        lib = native.load()
+        name = f"/otpu_test_{os.getpid()}_wrap".encode()
+        w = lib.shmbox_attach(name, 1 << 12, 1)   # small ring forces wrap
+        r = lib.shmbox_attach(name, 0, 0)
+        hdr = b"h" * 16
+        hp = (ctypes.c_uint8 * 16).from_buffer_copy(hdr)
+        total = 0
+        for round_ in range(50):
+            payload = bytes([round_ % 251]) * 700
+            pp = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+            rc = lib.shmbox_write(w, hp, 16, pp, len(payload))
+            if rc == -1:   # full: drain one and retry
+                sz = lib.shmbox_peek(r)
+                buf = (ctypes.c_uint8 * sz)()
+                hlen = lib.shmbox_read(r, buf, sz)
+                assert hlen == 16
+                assert bytes(buf)[16] == total % 251
+                total += 1
+                rc = lib.shmbox_write(w, hp, 16, pp, len(payload))
+            assert rc == 0
+        # drain the rest, checking FIFO order survived the wraparounds
+        while True:
+            sz = lib.shmbox_peek(r)
+            if sz == 0:
+                break
+            buf = (ctypes.c_uint8 * sz)()
+            lib.shmbox_read(r, buf, sz)
+            assert bytes(buf)[16] == total % 251
+            total += 1
+        assert total == 50
+        lib.shmbox_close(r)
+        lib.shmbox_close(w)
+
+    def test_oversize_frame_rejected(self):
+        lib = native.load()
+        name = f"/otpu_test_{os.getpid()}_big".encode()
+        w = lib.shmbox_attach(name, 1 << 10, 1)
+        big = (ctypes.c_uint8 * 2048)()
+        assert lib.shmbox_write(w, big, 16, big, 2048) == -2
+        lib.shmbox_close(w)
+
+
+class TestNativeConvertor:
+    def test_vector_pack_matches_python(self):
+        """The C++ walker and the numpy walker implement one layout contract;
+        cross-check them on a strided vector type."""
+        dt = Datatype.vector(count=4, blocklength=3, stride=5, base=FLOAT64)
+        ext = dt.extent // 8      # MPI vector extent: (count-1)*stride+blocklen
+        buf = np.arange(ext * 2 + 8, dtype=np.float64)
+        packed = Convertor(buf, dt, count=2).pack()
+        # reference layout by hand: 4 blocks of 3 doubles every 5, per element
+        expect = []
+        for e in range(2):
+            base = e * ext
+            for b in range(4):
+                expect.extend(buf[base + b * 5: base + b * 5 + 3])
+        np.testing.assert_array_equal(
+            np.frombuffer(packed, np.float64), np.array(expect))
+
+    def test_native_matches_python_walker(self, monkeypatch):
+        """Force the pure-python walker and compare byte-for-byte with the
+        native one on an irregular indexed type."""
+        dt = Datatype.indexed([3, 1, 4, 2], [0, 5, 9, 17], INT32)
+        buf = np.arange(200, dtype=np.int32)
+        nat = Convertor(buf, dt, count=6).pack()
+        from ompi_tpu import native as nat_mod
+        monkeypatch.setattr(nat_mod, "load", lambda: None)
+        py = Convertor(buf, dt, count=6).pack()
+        assert nat == py
+        # unpack cross-check: native unpack of the python-packed bytes
+        monkeypatch.undo()
+        out = np.zeros_like(buf)
+        Convertor(out, dt, count=6).unpack(np.frombuffer(py, np.uint8))
+        assert Convertor(out, dt, count=6).pack() == py
+
+    def test_partial_positions(self):
+        dt = Datatype.vector(count=8, blocklength=2, stride=3, base=INT32)
+        buf = np.arange(8 * 3 * 3, dtype=np.int32)
+        whole = Convertor(buf, dt, count=3).pack()
+        # re-pack in awkward chunk sizes through the positioned path
+        conv = Convertor(buf, dt, count=3)
+        chunks = []
+        for sz in (5, 17, 1, 64, 9, 10 ** 6):
+            chunks.append(conv.pack(sz))
+        assert b"".join(chunks) == whole
+        # and unpack back into a clean buffer in different chunks
+        out = np.zeros_like(buf)
+        conv2 = Convertor(out, dt, count=3)
+        off = 0
+        for sz in (3, 29, 11, 64, 10 ** 6):
+            take = whole[off:off + sz]
+            if not take:
+                break
+            conv2.unpack(np.frombuffer(take, np.uint8))
+            off += len(take)
+        packed_again = Convertor(out, dt, count=3).pack()
+        assert packed_again == whole
+
+
+class TestShmTransport:
+    def test_selected_for_same_host_peers(self):
+        def body(ctx):
+            return ctx.layer.for_peer((ctx.rank + 1) % 2).name
+        res = runtime.run_ranks(2, body)
+        assert res == ["shm", "shm"]
+
+    def test_ring_over_shm(self):
+        def body(ctx):
+            import numpy as np
+            nxt = (ctx.rank + 1) % ctx.size
+            prv = (ctx.rank - 1) % ctx.size
+            buf = np.zeros(1024, np.float32)
+            if ctx.rank == 0:
+                ctx.p2p.send(np.full(1024, 3.5, np.float32), nxt, tag=1)
+                ctx.p2p.recv(buf, prv, tag=1)
+            else:
+                ctx.p2p.recv(buf, prv, tag=1)
+                ctx.p2p.send(buf, nxt, tag=1)
+            return float(buf[0])
+        assert runtime.run_ranks(4, body) == [3.5] * 4
+
+    def test_large_message_multifragment(self):
+        n = 1 << 20   # 4MB of float32 — many fragments through the ring
+        def body(ctx):
+            import numpy as np
+            if ctx.rank == 0:
+                ctx.p2p.send(np.arange(n, dtype=np.float32), 1, tag=2)
+                return True
+            buf = np.zeros(n, np.float32)
+            ctx.p2p.recv(buf, 0, tag=2)
+            return bool((buf == np.arange(n, dtype=np.float32)).all())
+        assert all(runtime.run_ranks(2, body, timeout=120))

@@ -71,4 +71,4 @@ def test_transport_matrix():
         return None
 
     res = runtime.run_ranks(2, fn)
-    assert res[0][1] == "tcp" and res[0][0] == "self"
+    assert res[0][1] == "shm" and res[0][0] == "self"

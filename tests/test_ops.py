@@ -1,0 +1,169 @@
+"""Pallas kernel tests (interpret mode on the CPU mesh).
+
+Interpret mode executes the same kernel logic the TPU backend compiles, so
+these validate the online-softmax state machine and the ring matmul
+schedules; the real-chip numbers come from bench.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ompi_tpu.ops import (allgather_matmul, flash_attention,
+                          flash_attention_partials, matmul_reduce_scatter)
+from ompi_tpu.parallel import make_mesh
+from ompi_tpu.parallel.ring import attention_reference
+
+
+def _qkv(b=2, s=256, h=2, d=16, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+class TestFlashAttention:
+    def test_matches_reference(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, block_q=64, block_k=64,
+                              interpret=True)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal(self):
+        q, k, v = _qkv(s=128)
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                              interpret=True)
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_single_block(self):
+        q, k, v = _qkv(s=64)
+        out = flash_attention(q, k, v, block_q=64, block_k=64,
+                              interpret=True)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_bfloat16_inputs(self):
+        q, k, v = _qkv(dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, block_q=128, block_k=128,
+                              interpret=True)
+        ref = attention_reference(q.astype(jnp.float32),
+                                  k.astype(jnp.float32),
+                                  v.astype(jnp.float32))
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref), rtol=0.06, atol=0.06)
+
+
+class TestFlashPartials:
+    def test_merge_across_shards_equals_dense(self):
+        """Two K/V shards merged with the ring combine == dense attention —
+        the exact contract ring attention relies on."""
+        b, s, h, d = 1, 128, 2, 16
+        q, k, v = _qkv(b=b, s=s, h=h, d=d)
+        qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s, d)
+        kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s, d)
+        vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s, d)
+
+        half = s // 2
+        o1, m1, l1 = flash_attention_partials(
+            qf, kf[:, :half], vf[:, :half], block_q=64, block_k=64,
+            interpret=True)
+        o2, m2, l2 = flash_attention_partials(
+            qf, kf[:, half:], vf[:, half:], block_q=64, block_k=64,
+            interpret=True)
+        m = jnp.maximum(m1, m2)
+        a1 = jnp.exp(m1 - m)[..., None]
+        a2 = jnp.exp(m2 - m)[..., None]
+        o = (o1 * jnp.exp(m1 - m)[..., None] + o2 * a2)
+        l = l1 * jnp.exp(m1 - m) + l2 * jnp.exp(m2 - m)
+        out = (o / l[..., None]).reshape(b, h, s, d)
+        out = jnp.moveaxis(out, 1, 2)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_causal_offsets(self):
+        """Partials with a kv_offset reproduce the causal mask of a shard
+        that sits later in the global sequence."""
+        b, s, h, d = 1, 128, 1, 16
+        q, k, v = _qkv(b=b, s=s, h=h, d=d)
+        qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s, d)
+        kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s, d)
+        vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s, d)
+        half = s // 2
+        # Q is the SECOND half of a 2s sequence; kv shard 0 = first half.
+        o1, m1, l1 = flash_attention_partials(
+            qf, kf, vf, causal=True, q_offset=s, kv_offset=0,
+            block_q=64, block_k=64, interpret=True)
+        # offset s => every kv position is visible: equals non-causal
+        o_ref, m_ref, l_ref = flash_attention_partials(
+            qf, kf, vf, causal=False, block_q=64, block_k=64, interpret=True)
+        np.testing.assert_allclose(np.asarray(o1 / l1[..., None]),
+                                   np.asarray(o_ref / l_ref[..., None]),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestCollectiveMatmul:
+    def test_allgather_matmul(self):
+        mesh = make_mesh({"tp": 4, "dp": -1})
+        m, k, n = 32, 16, 24
+        x = jax.random.normal(jax.random.key(1), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.key(2), (k, n), jnp.float32)
+        out = allgather_matmul(x, w, mesh, "tp")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_allgather_matmul_w_column_sharded(self):
+        mesh = make_mesh({"sp": 2, "tp": 2, "dp": -1})
+        m, k, n = 16, 8, 32
+        x = jax.random.normal(jax.random.key(1), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.key(2), (k, n), jnp.float32)
+        out = allgather_matmul(x, w, mesh, "sp", w_sharded_axis="tp")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_matmul_reduce_scatter(self):
+        mesh = make_mesh({"tp": 4, "dp": -1})
+        m, k, n = 32, 64, 24
+        x = jax.random.normal(jax.random.key(3), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.key(4), (k, n), jnp.float32)
+        out = matmul_reduce_scatter(x, w, mesh, "tp")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_matmul_reduce_scatter_ring2(self):
+        mesh = make_mesh({"x": 2, "y": -1})
+        m, k, n = 8, 16, 8
+        x = jnp.arange(m * k, dtype=jnp.float32).reshape(m, k) / 37.0
+        w = jnp.arange(k * n, dtype=jnp.float32).reshape(k, n) / 53.0
+        out = matmul_reduce_scatter(x, w, mesh, "x")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-4, atol=1e-4)
+
+
+class TestRingPallas:
+    def test_ring_attention_pallas_block(self):
+        from ompi_tpu.parallel.ring import ring_attention
+        mesh = make_mesh({"sp": 4, "dp": -1})
+        b, s, h, d = 2, 64, 2, 16
+        q, k, v = _qkv(b=b, s=s, h=h, d=d)
+        out = ring_attention(q, k, v, mesh, axis="sp", block_impl="pallas")
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_ring_attention_pallas_causal(self):
+        from ompi_tpu.parallel.ring import ring_attention
+        mesh = make_mesh({"sp": 4, "dp": -1})
+        b, s, h, d = 1, 64, 2, 16
+        q, k, v = _qkv(b=b, s=s, h=h, d=d, seed=3)
+        out = ring_attention(q, k, v, mesh, axis="sp", causal=True,
+                             block_impl="pallas")
+        ref = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)

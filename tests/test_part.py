@@ -1,0 +1,126 @@
+"""Partitioned p2p tests (MPI-4 Psend/Precv semantics, ≙ ompi/mca/part)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime
+from ompi_tpu.p2p import precv_init, psend_init
+
+
+def test_basic_partitioned_transfer():
+    n, parts = 64, 4
+
+    def body(ctx):
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            data = np.arange(n, dtype=np.float32)
+            req = psend_init(comm, data, parts, dst=1, tag=5)
+            req.start()
+            for i in range(parts):
+                req.pready(i)
+            req.wait(timeout=30)
+            return True
+        buf = np.zeros(n, np.float32)
+        req = precv_init(comm, buf, parts, src=0, tag=5)
+        req.start()
+        req.wait(timeout=30)
+        return bool((buf == np.arange(n, dtype=np.float32)).all())
+
+    assert all(runtime.run_ranks(2, body))
+
+
+def test_out_of_order_pready_and_parrived():
+    n, parts = 32, 4
+
+    def body(ctx):
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            data = np.arange(n, dtype=np.int64)
+            req = psend_init(comm, data, parts, dst=1, tag=1)
+            req.start()
+            for i in (2, 0, 3, 1):       # any order
+                req.pready(i)
+            req.wait(timeout=30)
+            return True
+        buf = np.zeros(n, np.int64)
+        req = precv_init(comm, buf, parts, src=0, tag=1)
+        req.start()
+        # poll partitions individually (MPI_Parrived)
+        import time
+        deadline = time.monotonic() + 30
+        seen = set()
+        while len(seen) < parts:
+            assert time.monotonic() < deadline
+            for j in range(parts):
+                if j not in seen and req.parrived(j):
+                    lo = j * (n // parts)
+                    assert (buf[lo:lo + n // parts]
+                            == np.arange(lo, lo + n // parts)).all()
+                    seen.add(j)
+        req.wait(timeout=30)
+        return True
+
+    assert all(runtime.run_ranks(2, body))
+
+
+def test_mismatched_partitioning():
+    """Sender 8 partitions, receiver 2 — only totals must match (MPI-4)."""
+    n = 64
+
+    def body(ctx):
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            data = np.arange(n, dtype=np.float64)
+            req = psend_init(comm, data, 8, dst=1, tag=2)
+            req.start()
+            req.pready(range(8))
+            req.wait(timeout=30)
+            return True
+        buf = np.zeros(n, np.float64)
+        req = precv_init(comm, buf, 2, src=0, tag=2)
+        req.start()
+        req.wait(timeout=30)
+        assert req.parrived(0) and req.parrived(1)
+        return bool((buf == np.arange(n, dtype=np.float64)).all())
+
+    assert all(runtime.run_ranks(2, body))
+
+
+def test_persistent_restart():
+    """start() re-arms: two rounds through one request pair."""
+    n, parts = 16, 2
+
+    def body(ctx):
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            data = np.zeros(n, np.float32)
+            req = psend_init(comm, data, parts, dst=1, tag=3)
+            for round_ in range(2):
+                data[:] = round_ + 1
+                req.start()
+                req.pready(range(parts))
+                req.wait(timeout=30)
+            return True
+        buf = np.zeros(n, np.float32)
+        req = precv_init(comm, buf, parts, src=0, tag=3)
+        out = []
+        for _ in range(2):
+            req.start()
+            req.wait(timeout=30)
+            out.append(float(buf[0]))
+        return out
+
+    res = runtime.run_ranks(2, body)
+    assert res[1] == [1.0, 2.0]
+
+
+def test_validation():
+    def body(ctx):
+        comm = ctx.comm_world
+        with pytest.raises(ValueError):
+            psend_init(comm, np.zeros(10), 3, dst=0)   # 10 % 3 != 0
+        with pytest.raises(ValueError):
+            precv_init(comm, np.zeros(8), 0, src=0)
+        return True
+
+    assert all(runtime.run_ranks(1, body))
