@@ -159,6 +159,16 @@ def handle_ag(ctx, src: int, h: dict) -> None:
     k = h["k"]
     if k == "ag_c":                     # a member's contribution
         st.contribs.setdefault(key, {})[src] = h
+        # Liveness: if this key is already decided here (e.g. this rank
+        # coordinated, returned from agree(), and a waiter just re-elected
+        # us after the old coordinator died), nothing will run _coordinate
+        # again — answer with the decided frame directly so the waiter's
+        # decided-value adoption path actually fires.
+        if key in st.results:
+            try:
+                ctx.layer.send(src, T.AM_FT, st.results[key], b"")
+            except Exception:
+                pass
     elif k == "ag_r":                   # a coordinator's decision
         st.results[key] = h
     elif k == "ag_p":                   # pull from a (new) coordinator

@@ -8,8 +8,10 @@ pieces where native actually pays on a TPU *host*:
   * ``convertor.cpp`` — derived-datatype pack/unpack loops (≙ opal_convertor)
 
 Build strategy (no pip, no pybind11 in the image): a single ``g++ -O3
--shared -fPIC`` invocation at first import, cached next to the sources with
-an mtime staleness check; bindings via ctypes. If the toolchain is missing
+-shared -fPIC`` invocation at first import. The artifact name embeds a
+content hash of the sources, so the cache is correct across clones and
+checkout orders (mtimes are meaningless after a fresh clone) and the
+binary itself is never committed; bindings via ctypes. If the toolchain is missing
 the package degrades gracefully — ``AVAILABLE`` is False and the pure-
 python paths stay in charge (the shm transport then simply reports itself
 unavailable at selection time, the same way reference components disqualify
@@ -25,35 +27,54 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["shmbox.cpp", "convertor.cpp"]
-_LIB_NAME = "_libompitpu.so"
 
 _lock = threading.Lock()
 _lib = None
 _err: str | None = None
 
+_CXXFLAGS = ["-O3", "-shared", "-fPIC", "-std=c++17"]
+_LDFLAGS = ["-lrt", "-pthread"]
+
+
+def _source_hash() -> str:
+    """Cache key: source contents + the compile command, so flag changes
+    rebuild just like source changes do."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(" ".join(_CXXFLAGS + _LDFLAGS).encode())
+    for s in _SOURCES:
+        with open(os.path.join(_DIR, s), "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
 
 def _build(lib_path: str) -> None:
     """Compile under an exclusive file lock: concurrent processes (e.g.
     parallel pytest invocations) must not interleave g++ output into one
-    .so. The loser of the race re-checks staleness and skips."""
+    .so. The loser of the race finds the hash-named artifact and skips."""
     import fcntl
+    import glob
 
     srcs = [os.path.join(_DIR, s) for s in _SOURCES]
-    with open(lib_path + ".lock", "w") as lock:
+    with open(os.path.join(_DIR, "_build.lock"), "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
-        if (os.path.exists(lib_path) and
-                os.path.getmtime(lib_path) >= max(
-                    os.path.getmtime(s) for s in srcs)):
+        if os.path.exists(lib_path):
             return      # someone else built it while we waited
         tmp = f"{lib_path}.{os.getpid()}.tmp"
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o",
-               tmp, *srcs, "-lrt", "-pthread"]
+        cmd = ["g++", *_CXXFLAGS, "-o", tmp, *srcs, *_LDFLAGS]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=300)
             os.replace(tmp, lib_path)
         finally:
             if os.path.exists(tmp):
                 os.unlink(tmp)
+        for old in glob.glob(os.path.join(_DIR, "_libompitpu-*.so")):
+            if old != lib_path:      # superseded artifacts
+                try:
+                    os.unlink(old)
+                except OSError:
+                    pass
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -91,13 +112,10 @@ def load():
     with _lock:
         if _lib is not None or _err is not None:
             return _lib
-        lib_path = os.path.join(_DIR, _LIB_NAME)
         try:
-            srcs = [os.path.join(_DIR, s) for s in _SOURCES]
-            stale = (not os.path.exists(lib_path) or
-                     os.path.getmtime(lib_path) < max(
-                         os.path.getmtime(s) for s in srcs))
-            if stale:
+            lib_path = os.path.join(
+                _DIR, f"_libompitpu-{_source_hash()}.so")
+            if not os.path.exists(lib_path):
                 _build(lib_path)
             _lib = _bind(ctypes.CDLL(lib_path))
         except Exception as exc:  # toolchain missing / build broke

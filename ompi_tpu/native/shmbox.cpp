@@ -22,7 +22,7 @@
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
-#include <vector>
+
 
 namespace {
 
@@ -46,16 +46,27 @@ struct Chan {
   char name[128] = {0};
 };
 
-// Stable-address handle table: heap-allocated entries so concurrent
-// attach() (threaded ranks) can never invalidate a Chan* another thread is
-// using mid-write the way vector<Chan> reallocation would.
-std::vector<Chan*>& table() {
-  static std::vector<Chan*> t;
-  return t;
-}
+// Stable-address handle table: a fixed-capacity append-only array of
+// heap-allocated entries. Slots are published with a release store of the
+// count, so the data-plane ops (write/peek/read — the per-frame hot path)
+// resolve handles with one acquire load and NO lock; the mutex only
+// serializes attach/close. (A vector would need the lock on every index
+// read, since attach() could reallocate its buffer mid-access.)
+constexpr int kMaxChans = 65536;   // 256 threaded ranks all-to-all
+Chan* g_slots[kMaxChans];
+std::atomic<int> g_nslots{0};
+
 std::mutex& table_mu() {
   static std::mutex m;
   return m;
+}
+
+// Lock-free handle resolution for the data plane. Returns nullptr for
+// out-of-range handles and channels already closed.
+Chan* chan_of(int h) {
+  if (h < 0 || h >= g_nslots.load(std::memory_order_acquire)) return nullptr;
+  Chan* c = g_slots[h];
+  return (c && c->ctl) ? c : nullptr;
 }
 
 inline uint64_t round8(uint64_t v) { return (v + 7) & ~uint64_t(7); }
@@ -126,15 +137,23 @@ int shmbox_attach(const char* name, uint32_t capacity, int create) {
     return -1;  // not initialized yet; caller retries
   }
   std::lock_guard<std::mutex> g(table_mu());
-  table().push_back(new Chan(c));
-  return (int)table().size() - 1;
+  int h = g_nslots.load(std::memory_order_relaxed);
+  if (h >= kMaxChans) {
+    munmap(mem, map_len);
+    return -1;
+  }
+  g_slots[h] = new Chan(c);
+  g_nslots.store(h + 1, std::memory_order_release);
+  return h;
 }
 
 // Write one frame. Returns 0 on success, -1 if the ring lacks space
 // (caller queues and retries), -2 if the frame can never fit.
 int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
                  const uint8_t* payload, uint32_t plen) {
-  Chan& c = *table()[h];
+  Chan* cp = chan_of(h);
+  if (!cp) return -3;  // invalid handle
+  Chan& c = *cp;
   const uint64_t need = round8(8ull + hlen + plen);
   if (need > c.ctl->capacity) return -2;
   uint64_t head = c.ctl->head.load(std::memory_order_relaxed);
@@ -151,7 +170,9 @@ int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
 // Size in bytes of the next pending frame (without the 8-byte length
 // prefix), or 0 when empty.
 uint32_t shmbox_peek(int h) {
-  Chan& c = *table()[h];
+  Chan* cp = chan_of(h);
+  if (!cp) return 0;
+  Chan& c = *cp;
   uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
   uint64_t head = c.ctl->head.load(std::memory_order_acquire);
   if (head == tail) return 0;
@@ -163,7 +184,9 @@ uint32_t shmbox_peek(int h) {
 // Pop the next frame into `buf` (must be >= shmbox_peek(h) bytes).
 // Returns header length, with header bytes first then payload; -1 if empty.
 int shmbox_read(int h, uint8_t* buf, uint32_t buflen) {
-  Chan& c = *table()[h];
+  Chan* cp = chan_of(h);
+  if (!cp) return -1;
+  Chan& c = *cp;
   uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
   uint64_t head = c.ctl->head.load(std::memory_order_acquire);
   if (head == tail) return -1;
@@ -178,11 +201,12 @@ int shmbox_read(int h, uint8_t* buf, uint32_t buflen) {
 
 void shmbox_close(int h) {
   std::lock_guard<std::mutex> g(table_mu());
-  Chan& c = *table()[h];
+  if (h < 0 || h >= g_nslots.load(std::memory_order_relaxed)) return;
+  Chan& c = *g_slots[h];
   if (c.ctl) {
     if (c.creator) shm_unlink(c.name);
     munmap(c.ctl, c.map_len);
-    c.ctl = nullptr;
+    c.ctl = nullptr;   // chan_of() now reports this handle invalid
     c.data = nullptr;
   }
 }

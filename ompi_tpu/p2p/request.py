@@ -64,10 +64,11 @@ class Request:
 
 
 class CompletedRequest(Request):
-    def __init__(self, count: int = 0) -> None:
+    def __init__(self, count: int = 0, result: Any = None) -> None:
         super().__init__()
         self.done = True
         self.status.count = count
+        self.result = result
 
 
 def wait_all(requests: List[Request], timeout: Optional[float] = None) -> List[Status]:

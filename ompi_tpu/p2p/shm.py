@@ -155,6 +155,10 @@ class ShmTransport(T.Transport):
                 n += 1
         return n
 
+    def pending_count(self, exclude: frozenset = frozenset()) -> int:
+        return sum(len(q) for p, q in self._pending.items()
+                   if p not in exclude)
+
     def finalize(self) -> None:
         for h in list(self._tx.values()) + list(self._rx.values()):
             self._lib.shmbox_close(h)

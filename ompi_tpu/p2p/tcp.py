@@ -215,6 +215,10 @@ class TcpTransport(T.Transport):
             if c is conn:
                 del self._tx[peer]
 
+    def pending_count(self, exclude: frozenset = frozenset()) -> int:
+        return sum(1 for p, c in self._tx.items()
+                   if c.outbuf and p not in exclude)
+
     def finalize(self) -> None:
         for conn in list(self._tx.values()) + list(self._rx):
             if conn.sock.fileno() < 0:

@@ -73,6 +73,14 @@ class Transport(Component):
     def progress(self) -> int:
         return 0
 
+    def pending_count(self, exclude: frozenset = frozenset()) -> int:
+        """Frames accepted by send() but not yet on the wire, not counting
+        peers in ``exclude`` (dead ranks never drain their ring). Finalize
+        must progress until every transport reports 0 — the reference spins
+        opal_progress inside every blocking point for the same reason
+        (opal/runtime/opal_progress.c:216)."""
+        return 0
+
     def finalize(self) -> None:
         pass
 

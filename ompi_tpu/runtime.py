@@ -77,6 +77,26 @@ class Context:
         self.spc._v["time_in_wait"] = self.engine.time_waiting
         if _var.get("spc_dump_enabled", False):
             self.spc.dump(self.rank)
+        # Drain transports before fencing: frames parked when a ring/socket
+        # was full (e.g. shm's _pending queue) must reach the wire, or a
+        # peer still blocked in recv never completes. The reference runs
+        # opal_progress inside every blocking point for exactly this
+        # (opal/runtime/opal_progress.c:216); finalize is a blocking point.
+        # Frames destined to failed ranks are not waited on (their ring
+        # never drains), and an idle spin yields so a 1-core host can run
+        # the peers whose progress we're waiting for.
+        import time as _time
+        dead = frozenset(getattr(self, "failed", ()))
+        deadline = _time.monotonic() + 10.0
+        while any(t.pending_count(dead) for t in self.layer.transports):
+            if self.engine.progress() == 0:
+                _time.sleep(0.0005)
+            if _time.monotonic() > deadline:
+                output.verbose(
+                    1, "runtime",
+                    "finalize: transports still have pending frames after "
+                    "10s; proceeding to fence anyway")
+                break
         try:
             self.bootstrap.fence()
         except Exception as exc:

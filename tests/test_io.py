@@ -1,0 +1,313 @@
+"""MPI-IO tests (≙ the role the OMPIO test programs play, and the coverage
+ADVICE.md r1 flagged as absent): open/read/write, explicit offsets, views
+over derived datatypes, two-phase collective IO, shared/ordered pointers,
+non-blocking independent IO, split collectives, atomic mode.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime
+from ompi_tpu.datatype import INT32, Datatype
+from ompi_tpu.io import (
+    MODE_CREATE,
+    MODE_DELETE_ON_CLOSE,
+    MODE_RDONLY,
+    MODE_RDWR,
+    MODE_WRONLY,
+    File,
+)
+
+
+def run(n, fn, timeout=90):
+    return runtime.run_ranks(n, fn, timeout=timeout)
+
+
+def _tmppath():
+    fd, path = tempfile.mkstemp(prefix="ompitpu_io_")
+    os.close(fd)
+    return path
+
+
+def test_open_write_read_roundtrip():
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        data = np.arange(16, dtype=np.int32) + 100 * comm.rank
+        f.write_at(comm.rank * data.nbytes, data)
+        f.sync()
+        comm.barrier()
+        got = np.zeros(16, np.int32)
+        peer = (comm.rank + 1) % comm.size
+        f.read_at(peer * got.nbytes, got)
+        np.testing.assert_array_equal(got, np.arange(16) + 100 * peer)
+        f.close()
+        return True
+
+    try:
+        assert all(run(3, body))
+    finally:
+        os.unlink(path)
+
+
+def test_individual_pointer_and_seek():
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        if comm.rank == 0:
+            f.write(np.arange(8, dtype=np.float64))
+            assert f.tell() == 8 * 8          # etype=BYTE
+        comm.barrier()
+        f.seek(3 * 8)
+        got = np.zeros(2)
+        f.read(got)
+        np.testing.assert_array_equal(got, [3.0, 4.0])
+        f.close()
+        return True
+
+    try:
+        assert all(run(2, body))
+    finally:
+        os.unlink(path)
+
+
+def test_file_view_interleaves_ranks():
+    """Classic striped view: each rank sees every size-th block of 4 ints
+    through a vector filetype — writes land interleaved in the file."""
+    path = _tmppath()
+    n = 4
+    blk = 4
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        ft = Datatype.vector(count=8, blocklength=blk,
+                             stride=blk * comm.size, base=INT32)
+        f.set_view(disp=comm.rank * blk * 4, etype=INT32, filetype=ft)
+        data = np.full(2 * blk, comm.rank, np.int32)
+        f.write_at(0, data)
+        f.sync()
+        comm.barrier()
+        f.close()
+        return True
+
+    try:
+        assert all(run(n, body))
+        whole = np.fromfile(path, np.int32)
+        expect = np.repeat(np.tile(np.arange(n), 2), blk)
+        np.testing.assert_array_equal(whole, expect)
+    finally:
+        os.unlink(path)
+
+
+def test_collective_write_read_at_all():
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        count = 64
+        data = (np.arange(count) + 1000 * comm.rank).astype(np.int64)
+        f.write_at_all(comm.rank * data.nbytes, data)
+        f.sync()
+        got = np.zeros(count, np.int64)
+        peer = (comm.rank + comm.size - 1) % comm.size
+        f.read_at_all(peer * got.nbytes, got)
+        np.testing.assert_array_equal(got, np.arange(count) + 1000 * peer)
+        f.close()
+        return True
+
+    try:
+        assert all(run(4, body))
+    finally:
+        os.unlink(path)
+
+
+def test_collective_io_with_interleaved_views_8_ranks():
+    """VERDICT next#8's acceptance shape: interleaved filetype views across
+    8 ranks through the two-phase collective path."""
+    path = _tmppath()
+    n = 8
+    blk = 8
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        ft = Datatype.vector(count=4, blocklength=blk,
+                             stride=blk * comm.size, base=INT32)
+        f.set_view(disp=comm.rank * blk * 4, etype=INT32, filetype=ft)
+        data = np.full(4 * blk, comm.rank, np.int32)
+        f.write_at_all(0, data)
+        f.sync()
+        comm.barrier()
+        got = np.zeros(4 * blk, np.int32)
+        f.read_at_all(0, got)
+        np.testing.assert_array_equal(got, data)
+        f.close()
+        return True
+
+    try:
+        assert all(run(n, body, timeout=120))
+        whole = np.fromfile(path, np.int32)
+        expect = np.repeat(np.tile(np.arange(n), 4), blk)
+        np.testing.assert_array_equal(whole, expect)
+    finally:
+        os.unlink(path)
+
+
+def test_shared_pointer_concurrent_appends():
+    """Shared-pointer concurrency (VERDICT next#8): every rank appends
+    through write_shared; the fetch-add must hand out disjoint regions."""
+    path = _tmppath()
+    n = 4
+    per = 32
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        data = np.full(per, comm.rank, np.uint8)
+        f.write_shared(data)
+        f.sync()
+        comm.barrier()
+        f.close()
+        return True
+
+    try:
+        assert all(run(n, body))
+        whole = np.fromfile(path, np.uint8)
+        assert len(whole) == n * per
+        # each rank's block is contiguous and exactly `per` long
+        for r in range(n):
+            assert np.count_nonzero(whole == r) == per
+        blocks = whole.reshape(n, per)
+        assert all(len(set(b.tolist())) == 1 for b in blocks)
+    finally:
+        os.unlink(path)
+
+
+def test_write_ordered_is_rank_ordered():
+    """ADVICE r1 high: write_ordered deadlocked when the shared window was
+    created lazily by rank 0 alone. Window now created at open; the result
+    must be rank-ordered regardless of arrival order."""
+    path = _tmppath()
+    n = 4
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        data = np.full(8 + comm.rank, ord("a") + comm.rank, np.uint8)
+        f.write_ordered(data)
+        f.sync()
+        f.seek_shared(0)
+        got = np.zeros(8 + comm.rank, np.uint8)
+        f.read_ordered(got)
+        f.close()
+        assert set(got.tolist()) == {ord("a") + comm.rank}
+        return True
+
+    try:
+        assert all(run(n, body))
+        whole = bytes(np.fromfile(path, np.uint8))
+        expect = b"".join(bytes([ord("a") + r]) * (8 + r) for r in range(n))
+        assert whole == expect
+    finally:
+        os.unlink(path)
+
+
+def test_iread_iwrite_at_complete():
+    """ADVICE r1 high: iread_at/iwrite_at raised TypeError on construction."""
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        data = np.arange(32, dtype=np.int32) + comm.rank
+        req = f.iwrite_at(comm.rank * data.nbytes, data)
+        req.wait()
+        assert req.result == 32
+        f.sync()
+        comm.barrier()
+        got = np.zeros(32, np.int32)
+        req = f.iread_at(comm.rank * got.nbytes, got)
+        req.wait()
+        np.testing.assert_array_equal(got, data)
+        f.close()
+        return True
+
+    try:
+        assert all(run(2, body))
+    finally:
+        os.unlink(path)
+
+
+def test_split_collectives():
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        data = np.arange(16, dtype=np.int64) * (comm.rank + 1)
+        f.write_at_all_begin(comm.rank * data.nbytes, data)
+        assert f.write_at_all_end(data) == 16
+        f.sync()
+        got = np.zeros(16, np.int64)
+        f.read_at_all_begin(comm.rank * got.nbytes, got)
+        f.read_at_all_end(got)
+        np.testing.assert_array_equal(got, data)
+        with pytest.raises(RuntimeError):
+            f.read_at_all_end(got)      # no matching begin
+        f.close()
+        return True
+
+    try:
+        assert all(run(3, body))
+    finally:
+        os.unlink(path)
+
+
+def test_atomic_mode_lock_roundtrip():
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        f.set_atomicity(True)
+        assert f.get_atomicity()
+        data = np.full(64, comm.rank, np.uint8)
+        f.write_at(comm.rank * 64, data)
+        comm.barrier()
+        got = np.zeros(64, np.uint8)
+        peer = (comm.rank + 1) % comm.size
+        f.read_at(peer * 64, got)
+        np.testing.assert_array_equal(got, np.full(64, peer, np.uint8))
+        f.close()
+        return True
+
+    try:
+        assert all(run(3, body))
+    finally:
+        os.unlink(path)
+
+
+def test_delete_on_close_and_size():
+    path = _tmppath()
+    os.unlink(path)
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path,
+                      MODE_RDWR | MODE_CREATE | MODE_DELETE_ON_CLOSE)
+        f.set_size(4096)
+        assert f.size() == 4096
+        f.close()
+        return True
+
+    assert all(run(2, body))
+    assert not os.path.exists(path)
