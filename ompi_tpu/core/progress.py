@@ -28,6 +28,9 @@ class ProgressEngine:
         self._lock = threading.RLock()
         self.polls = 0                  # lifetime pass count (SPC + low-pri gate)
         self.time_waiting = 0.0         # seconds inside wait_until (SPC)
+        self.idle_wait: Callable[[float], None] | None = None
+        # blocking idle hook (e.g. the shm transport's doorbell): when a
+        # wait loop goes idle, block here instead of sleeping blind
 
     def register(self, fn: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:
@@ -62,8 +65,18 @@ class ProgressEngine:
             while not cond():
                 if self.progress() == 0:
                     idle += 1
-                    if idle > 100:        # back off when nothing is moving
-                        time.sleep(0.0001)
+                    # Back off fast: on a busy host the *peer* needs our
+                    # timeslice to produce the frame we're waiting for, so
+                    # spinning delays our own completion. First yield, then
+                    # block on the idle hook (doorbell) so the sender can
+                    # wake us in µs rather than a scheduler quantum.
+                    if idle > 4:
+                        if self.idle_wait is not None:
+                            self.idle_wait(0.0005)
+                        else:
+                            time.sleep(0.0001)
+                    elif idle > 1:
+                        time.sleep(0)     # sched_yield
                 else:
                     idle = 0
                 if deadline is not None and time.monotonic() > deadline:

@@ -211,12 +211,13 @@ class File:
                 for off, n in runs:
                     out += os.pread(self._fd, n, off)
                 return bytes(out)
+            # (no fsync here: atomicity is inter-process *visibility*, which
+            # the shared page cache + the byte-range lock already give;
+            # durability is MPI_File_sync's job)
             done = 0
             for off, n in runs:
                 os.pwrite(self._fd, data[done:done + n], off)
                 done += n
-            if self.atomicity:
-                os.fsync(self._fd)
             return done
         finally:
             if lock:

@@ -92,6 +92,14 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.shmbox_read.restype = ctypes.c_int
     lib.shmbox_close.argtypes = [ctypes.c_int]
     lib.shmbox_close.restype = None
+    lib.doorbell_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.doorbell_open.restype = ctypes.c_int
+    lib.doorbell_post.argtypes = [ctypes.c_int]
+    lib.doorbell_post.restype = None
+    lib.doorbell_wait.argtypes = [ctypes.c_int, ctypes.c_long]
+    lib.doorbell_wait.restype = ctypes.c_int
+    lib.doorbell_close.argtypes = [ctypes.c_int, ctypes.c_char_p]
+    lib.doorbell_close.restype = None
     for name in ("conv_pack", "conv_unpack"):
         fn = getattr(lib, name)
         fn.argtypes = [u8p, u8p, ctypes.c_uint64, ctypes.c_uint64, i64p,

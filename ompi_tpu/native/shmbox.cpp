@@ -17,8 +17,10 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <fcntl.h>
 #include <mutex>
+#include <semaphore.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -137,18 +139,27 @@ int shmbox_attach(const char* name, uint32_t capacity, int create) {
     return -1;  // not initialized yet; caller retries
   }
   std::lock_guard<std::mutex> g(table_mu());
-  int h = g_nslots.load(std::memory_order_relaxed);
-  if (h >= kMaxChans) {
+  int n = g_nslots.load(std::memory_order_relaxed);
+  // reuse a closed slot first (long-lived processes run many jobs)
+  for (int i = 0; i < n; i++) {
+    if (g_slots[i] && !g_slots[i]->ctl) {
+      *g_slots[i] = c;
+      return i;
+    }
+  }
+  if (n >= kMaxChans) {
     munmap(mem, map_len);
     return -1;
   }
-  g_slots[h] = new Chan(c);
-  g_nslots.store(h + 1, std::memory_order_release);
-  return h;
+  g_slots[n] = new Chan(c);
+  g_nslots.store(n + 1, std::memory_order_release);
+  return n;
 }
 
-// Write one frame. Returns 0 on success, -1 if the ring lacks space
-// (caller queues and retries), -2 if the frame can never fit.
+// Write one frame. Returns 1 on success into an empty ring (receiver may
+// be blocked on its doorbell — post it), 0 on success into a non-empty
+// ring, -1 if the ring lacks space (caller queues and retries), -2 if the
+// frame can never fit, -3 for an invalid handle.
 int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
                  const uint8_t* payload, uint32_t plen) {
   Chan* cp = chan_of(h);
@@ -164,7 +175,7 @@ int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
   ring_write(c, head + 8, hdr, hlen);
   ring_write(c, head + 8 + hlen, payload, plen);
   c.ctl->head.store(head + need, std::memory_order_release);
-  return 0;
+  return head == tail ? 1 : 0;
 }
 
 // Size in bytes of the next pending frame (without the 8-byte length
@@ -197,6 +208,70 @@ int shmbox_read(int h, uint8_t* buf, uint32_t buflen) {
   ring_read(c, tail + 8, buf, body);
   c.ctl->tail.store(tail + round8(lens[0]), std::memory_order_release);
   return (int)lens[1];
+}
+
+// ---- doorbells -----------------------------------------------------------
+//
+// Named-semaphore wakeup for idle receivers. Spinning in the progress loop
+// is right on dedicated cores (the reference's default) but wrong on an
+// oversubscribed host, where the spinner burns exactly the timeslice the
+// sender needs (the reference's answer is mpi_yield_when_idle). A doorbell
+// lets an idle rank block in sem_timedwait and be woken by the writer's
+// sem_post in microseconds instead of a scheduler quantum.
+
+constexpr int kMaxBells = 4096;
+sem_t* g_bells[kMaxBells];
+std::atomic<int> g_nbells{0};
+
+int doorbell_open(const char* name, int create) {
+  sem_t* s = create ? sem_open(name, O_CREAT, 0600, 0) : sem_open(name, 0);
+  if (s == SEM_FAILED) return -1;
+  std::lock_guard<std::mutex> g(table_mu());
+  int n = g_nbells.load(std::memory_order_relaxed);
+  for (int i = 0; i < n; i++) {
+    if (!g_bells[i]) {         // reuse a closed slot
+      g_bells[i] = s;
+      return i;
+    }
+  }
+  if (n >= kMaxBells) {
+    sem_close(s);
+    return -1;
+  }
+  g_bells[n] = s;
+  g_nbells.store(n + 1, std::memory_order_release);
+  return n;
+}
+
+void doorbell_post(int h) {
+  if (h < 0 || h >= g_nbells.load(std::memory_order_acquire)) return;
+  sem_post(g_bells[h]);  // EOVERFLOW just means plenty of pending wakeups
+}
+
+// Wait up to timeout_us for a post; drains one post. Returns 1 if posted,
+// 0 on timeout, -1 on error.
+int doorbell_wait(int h, long timeout_us) {
+  if (h < 0 || h >= g_nbells.load(std::memory_order_acquire)) return -1;
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_nsec += timeout_us * 1000;
+  ts.tv_sec += ts.tv_nsec / 1000000000;
+  ts.tv_nsec %= 1000000000;
+  while (true) {
+    if (sem_timedwait(g_bells[h], &ts) == 0) return 1;
+    if (errno == EINTR) continue;
+    return errno == ETIMEDOUT ? 0 : -1;
+  }
+}
+
+void doorbell_close(int h, const char* unlink_name) {
+  std::lock_guard<std::mutex> g(table_mu());
+  if (h < 0 || h >= g_nbells.load(std::memory_order_relaxed)) return;
+  if (g_bells[h]) {
+    sem_close(g_bells[h]);
+    g_bells[h] = nullptr;
+  }
+  if (unlink_name && unlink_name[0]) sem_unlink(unlink_name);
 }
 
 void shmbox_close(int h) {

@@ -22,7 +22,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import pickle
 import socket
 from collections import deque
 from typing import Any, Dict, Optional
@@ -31,6 +30,7 @@ from .. import native
 from ..core import var as _var
 from ..core.component import component
 from . import transport as T
+from . import wire
 
 _var.register("transport", "shm", "ring_size", 1 << 21, type=int, level=4,
               help="Bytes per directed shared-memory ring channel.")
@@ -43,6 +43,11 @@ def _host_key() -> str:
 def _chan_name(job: str, src: int, dst: int) -> bytes:
     safe = "".join(c for c in str(job) if c.isalnum())[-24:]
     return f"/otpu_{safe}_{src}to{dst}".encode()
+
+
+def _bell_name(job: str, rank: int) -> bytes:
+    safe = "".join(c for c in str(job) if c.isalnum())[-24:]
+    return f"/otpu_{safe}_bell{rank}".encode()
 
 
 @component("transport", "shm", priority=50)
@@ -60,6 +65,8 @@ class ShmTransport(T.Transport):
         self._pending: Dict[int, deque] = {}  # peer → frames awaiting space
         self._hosts: Dict[int, Optional[str]] = {}
         self._ring = int(_var.get("transport_shm_ring_size", 1 << 21))
+        self._bell = -1
+        self._tx_bells: Dict[int, int] = {}
         # cap fragments so one frame can never exceed half a ring
         self.max_send_size = min(self.max_send_size, self._ring // 4)
 
@@ -78,6 +85,11 @@ class ShmTransport(T.Transport):
                 _chan_name(bootstrap.job_id, peer, self.rank), self._ring, 1)
             if h >= 0:
                 self._rx[peer] = h
+        # our doorbell: senders post it after writing into an empty ring so
+        # an idle_wait()-blocked receiver wakes in µs, not a scheduler
+        # quantum (≙ mpi_yield_when_idle for oversubscribed hosts)
+        self._bell = self._lib.doorbell_open(
+            _bell_name(bootstrap.job_id, self.rank), 1)
 
     def reachable(self, peer: int) -> bool:
         if peer == self.rank or not (0 <= peer < self.size):
@@ -117,11 +129,18 @@ class ShmTransport(T.Transport):
             raise ValueError(
                 f"frame of {len(hdr)}+{n} bytes exceeds shm ring capacity "
                 f"{self._ring} (raise transport_shm_ring_size)")
-        return rc == 0
+        if rc == 1:      # ring was empty → peer may be blocked on its bell
+            bell = self._tx_bells.get(peer)
+            if bell is None:
+                bell = self._lib.doorbell_open(
+                    _bell_name(self._bootstrap.job_id, peer), 0)
+                self._tx_bells[peer] = bell
+            self._lib.doorbell_post(bell)
+        return rc >= 0
 
     def send(self, peer: int, tag: int, header: Dict[str, Any],
              payload: bytes) -> None:
-        hdr = pickle.dumps((tag, header), protocol=pickle.HIGHEST_PROTOCOL)
+        hdr = wire.encode(tag, header)
         q = self._pending.get(peer)
         if q:
             q.append((hdr, payload))    # keep FIFO behind parked frames
@@ -150,7 +169,7 @@ class ShmTransport(T.Transport):
                 if hlen < 0:
                     break
                 raw = bytes(buf)
-                tag, header = pickle.loads(raw[:hlen])
+                tag, header = wire.decode(memoryview(raw)[:hlen])
                 self.deliver(peer, tag, header, raw[hlen:])
                 n += 1
         return n
@@ -159,8 +178,25 @@ class ShmTransport(T.Transport):
         return sum(len(q) for p, q in self._pending.items()
                    if p not in exclude)
 
+    def idle_wait(self, timeout: float) -> None:
+        """Block until a sender rings our doorbell (or timeout) — called by
+        the progress engine when a wait loop goes idle."""
+        if any(self._pending.values()):
+            return              # our own parked frames need progress, not sleep
+        if self._bell < 0:      # no doorbell: plain sleep beats a hot spin
+            import time
+            time.sleep(timeout)
+            return
+        self._lib.doorbell_wait(self._bell, int(timeout * 1e6))
+
     def finalize(self) -> None:
         for h in list(self._tx.values()) + list(self._rx.values()):
             self._lib.shmbox_close(h)
         self._tx.clear()
         self._rx.clear()
+        for bell in self._tx_bells.values():
+            self._lib.doorbell_close(bell, None)
+        if self._bell >= 0:
+            self._lib.doorbell_close(
+                self._bell, _bell_name(self._bootstrap.job_id, self.rank))
+            self._bell = -1

@@ -54,6 +54,10 @@ class Context:
             raise RuntimeError("no transport components available")
         self.bootstrap.fence()
         self.layer = TransportLayer(mods)
+        for t in mods:
+            if hasattr(t, "idle_wait"):
+                self.engine.idle_wait = t.idle_wait
+                break
         from .spc import Counters
         self.spc = Counters()
         self.p2p = P2P(self.bootstrap, self.layer, self.engine, spc=self.spc)
