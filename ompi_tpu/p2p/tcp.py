@@ -155,11 +155,12 @@ class TcpTransport(T.Transport):
     def progress(self) -> int:
         # A rank whose traffic all rides shm still pays this select()
         # syscall every poll. With zero established connections the only
-        # thing to catch is a first accept — check that every 32nd poll
-        # (a connecting peer retries via the blocking connect, so the
-        # worst case is bounded, and the happy path gets ~30µs cheaper).
+        # thing to catch is a first accept — check that every 8th poll.
+        # (connect() itself succeeds against the listen backlog, so this
+        # only delays processing of the first frames; kept small because
+        # idle polls can each block ~0.5 ms in the shm doorbell.)
         if not self._tx and not self._rx:
-            self._poll_skip = (self._poll_skip + 1) % 32
+            self._poll_skip = (self._poll_skip + 1) % 8
             if self._poll_skip:
                 return 0
         events = 0
@@ -233,6 +234,11 @@ class TcpTransport(T.Transport):
     def pending_count(self, exclude: frozenset = frozenset()) -> int:
         return sum(1 for p, c in self._tx.items()
                    if c.outbuf and p not in exclude)
+
+    def has_activity(self) -> bool:
+        """True when live connections exist — the runtime caps doorbell
+        blocking then, since tcp peers cannot ring a local semaphore."""
+        return bool(self._tx or self._rx)
 
     def finalize(self) -> None:
         for conn in list(self._tx.values()) + list(self._rx):

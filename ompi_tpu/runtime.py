@@ -54,15 +54,30 @@ class Context:
             raise RuntimeError("no transport components available")
         self.bootstrap.fence()
         self.layer = TransportLayer(mods)
-        for t in mods:
-            if hasattr(t, "idle_wait"):
-                self.engine.idle_wait = t.idle_wait
-                break
+        self._install_idle_hook(mods)
         from .spc import Counters
         self.spc = Counters()
         self.p2p = P2P(self.bootstrap, self.layer, self.engine, spc=self.spc)
         self._comm_world = None
         self.finalized = False
+
+    def _install_idle_hook(self, mods) -> None:
+        """Wire the engine's blocking idle hook: block on the shm doorbell
+        when going idle, but cap the block to ~100µs while doorbell-less
+        transports (tcp) have live connections — their frames arrive in
+        kernel buffers no semaphore announces."""
+        waiter = next((t.idle_wait for t in mods if hasattr(t, "idle_wait")),
+                      None)
+        if waiter is None:
+            return
+        others = [t.has_activity for t in mods if hasattr(t, "has_activity")]
+
+        def hook(timeout: float) -> None:
+            if any(act() for act in others):
+                timeout = min(timeout, 0.0001)
+            waiter(timeout)
+
+        self.engine.idle_wait = hook
 
     @property
     def comm_world(self):
