@@ -12,10 +12,13 @@ process per rank with the environment contract:
 ``--mca name value`` CLI assignments are forwarded as OMPI_TPU_<name> env
 vars, preserving the reference's source-precedence semantics (§5.6).
 
-Rank-per-chip: with ``--chips-per-rank 1`` (default) each rank process is
-pinned to one TPU chip via JAX's multi-process initialization
-(OMPI_TPU_VISIBLE_DEVICE index), matching the north star's
-one-rank-per-chip model (BASELINE.json north_star).
+Rank-per-chip (north star, BASELINE.json): ``--chips-per-rank N`` pins each
+rank to its own TPU chip(s) by setting ``TPU_VISIBLE_DEVICES`` to the
+rank's local chip indices; ``--device-plane cpu`` instead gives every rank
+one virtual CPU device (JAX_PLATFORMS=cpu + 1 host device) — the test
+fabric. Ranks then call ``parallel.device_plane.init_device_plane(ctx)`` to
+wire ``jax.distributed`` across the job (the coordination-service address
+travels through the modex).
 """
 
 from __future__ import annotations
@@ -31,14 +34,32 @@ from .tcp import Coordinator
 
 
 def build_env(base: Dict[str, str], rank: int, size: int, coord: str,
-              job: str, mca: List[str]) -> Dict[str, str]:
+              job: str, mca: List[str], chips_per_rank: int = 0,
+              device_plane: str = "none") -> Dict[str, str]:
     env = dict(base)
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
     env["OMPI_TPU_COORD"] = coord
     env["OMPI_TPU_JOB"] = job
-    env["OMPI_TPU_LOCAL_RANK"] = str(rank)   # single-host launcher
+    local_rank = rank                         # single-host launcher
+    env["OMPI_TPU_LOCAL_RANK"] = str(local_rank)
     env["OMPI_TPU_NUM_LOCAL"] = str(size)
+    if device_plane == "cpu":
+        # test fabric: one virtual CPU device per rank process. The env var
+        # alone is NOT enough — a sitecustomize-registered TPU plugin can
+        # ignore it and wedge on concurrent init; init_device_plane also
+        # forces the platform through jax.config (OMPI_TPU_DEVICE_PLANE).
+        env["JAX_PLATFORMS"] = "cpu"
+        env["OMPI_TPU_DEVICE_PLANE"] = "cpu"
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=1"
+                            ).strip()
+    elif chips_per_rank > 0:
+        # chip binding (≙ PRRTE binding, ompi_rte.c:536): the TPU runtime
+        # honors TPU_VISIBLE_DEVICES as the list of local chips to expose
+        env["TPU_VISIBLE_DEVICES"] = ",".join(
+            str(local_rank * chips_per_rank + i)
+            for i in range(chips_per_rank))
     for assign in mca:
         name, _, value = assign.partition("=")
         env[f"OMPI_TPU_{name}"] = value
@@ -55,11 +76,20 @@ def main(argv: List[str] | None = None) -> int:
                     help="set variable NAME to VALUE for all ranks")
     ap.add_argument("--timeout", type=float, default=None,
                     help="kill the job after this many seconds")
+    ap.add_argument("--chips-per-rank", type=int, default=0,
+                    help="pin each rank to this many TPU chips via "
+                         "TPU_VISIBLE_DEVICES (0 = no pinning)")
+    ap.add_argument("--device-plane", choices=["none", "cpu"], default="none",
+                    help="'cpu' gives each rank one virtual CPU device "
+                         "(multi-process test fabric)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and args (a python script or executable)")
     args = ap.parse_args(argv)
     if not args.command:
         ap.error("no command given")
+    if args.device_plane == "cpu" and args.chips_per_rank > 0:
+        ap.error("--device-plane cpu and --chips-per-rank conflict "
+                 "(the CPU fabric has no chips to pin)")
 
     coord = Coordinator(size=args.np, job_id=f"tpurun-{os.getpid()}")
     host, port = coord.address
@@ -76,7 +106,8 @@ def main(argv: List[str] | None = None) -> int:
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env_base["PYTHONPATH"] = pkg_root + os.pathsep + env_base.get("PYTHONPATH", "")
     for rank in range(args.np):
-        env = build_env(env_base, rank, args.np, coord_str, coord.job_id, mca)
+        env = build_env(env_base, rank, args.np, coord_str, coord.job_id,
+                        mca, args.chips_per_rank, args.device_plane)
         procs.append(subprocess.Popen(cmd, env=env))
 
     def kill_all(sig=signal.SIGTERM):
@@ -92,6 +123,7 @@ def main(argv: List[str] | None = None) -> int:
         remaining = list(procs)
         import time
         deadline = None if args.timeout is None else time.monotonic() + args.timeout
+        term_at = None          # when SIGTERM went out (escalate to KILL)
         while remaining:
             for p in list(remaining):
                 rc = p.poll()
@@ -102,6 +134,12 @@ def main(argv: List[str] | None = None) -> int:
                     exit_code = rc
                     # a failed rank takes the job down, like mpirun
                     kill_all()
+                    term_at = time.monotonic()
+            if term_at is not None and time.monotonic() - term_at > 5.0:
+                # a rank ignored SIGTERM (e.g. wedged in a native collective
+                # init) — escalate so the job always terminates
+                kill_all(signal.SIGKILL)
+                term_at = None
             if deadline is not None and time.monotonic() > deadline:
                 print("tpurun: timeout — killing job", file=sys.stderr)
                 kill_all(signal.SIGKILL)

@@ -21,6 +21,7 @@ from .hierarchy import (  # noqa: F401
     hierarchical_allreduce,
     hierarchical_psum,
 )
+from .device_plane import device_plane_active, init_device_plane  # noqa: F401
 from .collectives import (  # noqa: F401
     DeviceComm,
     all_gather_axis,

@@ -150,6 +150,26 @@ class DeviceComm:
         host = np.asarray(jax.device_get(x))
         return [host[i] for i in range(host.shape[0])]
 
+    # -- multi-process (rank-per-chip) layout helpers -----------------------
+    # In the device-plane model (parallel/device_plane.py) each process owns
+    # only its own rows; the global array is assembled from per-process
+    # shards — the multi-process analog of from_ranks/to_ranks.
+
+    def from_local(self, local_rows: np.ndarray) -> jax.Array:
+        """This process's rows (r, *e) → the global (R, *e) sharded array."""
+        return jax.make_array_from_process_local_data(
+            self.sharding(), np.asarray(local_rows))
+
+    def to_local(self, x: jax.Array) -> np.ndarray:
+        """This process's rows of a global array, as one host ndarray.
+        Deduplicates replicated shards (meshes with extra axes hold one
+        copy per replica device)."""
+        by_start = {}
+        for s in x.addressable_shards:
+            by_start.setdefault(s.index[0].start or 0, s)
+        return np.concatenate(
+            [np.asarray(by_start[k].data) for k in sorted(by_start)], axis=0)
+
     # -- compiled-collective cache (≙ the coll/xla executable cache,
     #    SURVEY.md §7 "ICI collectives outside a single XLA program") -------
 
@@ -345,6 +365,11 @@ class DeviceComm:
                 return lax.psum(xs, self.axis)
             return self._shard_map(inner, P(self.axis), P())
 
-        token = jax.device_put(
-            jnp.zeros((self.n,), jnp.int32), self.sharding())
+        # from_local works in both the single-controller and multi-process
+        # (rank-per-chip) regimes — device_put would reject the
+        # non-addressable devices of other processes
+        pid = jax.process_index()
+        n_local = sum(1 for d in self.mesh.devices.flat
+                      if d.process_index == pid)
+        token = self.from_local(np.zeros((n_local,), np.int32))
         self._compiled(key, build)(token).block_until_ready()

@@ -1,0 +1,95 @@
+"""Multi-process device plane (north star: rank-per-chip, VERDICT r1 #2).
+
+Launches real tpurun jobs whose ranks each own ONE device and wire
+``jax.distributed`` through the bootstrap modex — then checks the
+multi-process collective result equals the single-controller result.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpurun(n, script_body, timeout=240, extra=("--device-plane", "cpu")):
+    """Run `script_body` under tpurun -np n; returns stdout."""
+    script = os.path.join("/tmp", f"dp_{os.getpid()}_{abs(hash(script_body)) % 99999}.py")
+    with open(script, "w") as f:
+        f.write(textwrap.dedent(script_body))
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)       # launcher sets the device plane
+    env["XLA_FLAGS"] = ""                # drop conftest's 8-device forcing
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", str(n),
+             *extra, script],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd="/tmp")
+        assert r.returncode == 0, f"rc={r.returncode}\n{r.stdout}\n{r.stderr}"
+        return r.stdout
+    finally:
+        os.unlink(script)
+
+
+def test_multiprocess_allreduce_matches_single_controller():
+    out = _tpurun(8, """
+        import numpy as np
+        from ompi_tpu import runtime
+        from ompi_tpu.op import SUM
+        from ompi_tpu.parallel import DeviceComm, init_device_plane, make_mesh
+
+        ctx = runtime.init()
+        init_device_plane(ctx)
+        import jax
+        assert jax.process_count() == 8, jax.process_count()
+        mesh = make_mesh({"x": len(jax.devices())})
+        dc = DeviceComm(mesh, "x")
+        count = 4096
+        rng = np.random.default_rng(7)        # same stream on every rank
+        rows = rng.standard_normal((8, count)).astype(np.float32)
+        x = dc.from_local(rows[ctx.rank:ctx.rank + 1])
+        y = dc.allreduce(x, SUM)
+        got = dc.to_local(y)[0]
+        # single-controller equivalent = plain numpy reduction of all rows
+        # tolerance covers gloo's non-deterministic reduction order
+        np.testing.assert_allclose(got, rows.sum(axis=0), rtol=1e-3,
+                                   atol=1e-4)
+        print(f"RANK{ctx.rank}_OK", flush=True)
+        runtime.finalize()
+    """)
+    for r in range(8):
+        assert f"RANK{r}_OK" in out
+
+
+def test_multiprocess_coll_xla_component_path():
+    out = _tpurun(2, """
+        import numpy as np
+        from ompi_tpu import runtime
+        from ompi_tpu.op import SUM
+        from ompi_tpu.parallel import (DeviceComm, attach_mesh,
+                                       init_device_plane, make_mesh)
+
+        ctx = runtime.init()
+        init_device_plane(ctx)
+        import jax
+        mesh = make_mesh({"x": len(jax.devices())})
+        comm = ctx.comm_world
+        attach_mesh(comm, mesh, "x")
+        dc = comm.device_comm
+        x = dc.from_local(np.full((1, 64), ctx.rank + 1.0, np.float32))
+        z = comm.coll.allreduce(comm, x, op=SUM)
+        assert np.all(dc.to_local(z) == 3.0)          # 1+2
+        b = comm.coll.bcast(comm, x, root=1)
+        assert np.all(dc.to_local(b) == 2.0)          # root owns row 1
+        comm.barrier()
+        print(f"RANK{ctx.rank}_COLL_OK", flush=True)
+        runtime.finalize()
+    """)
+    for r in range(2):
+        assert f"RANK{r}_COLL_OK" in out
