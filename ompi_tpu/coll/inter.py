@@ -1,0 +1,98 @@
+"""Intercommunicator collectives (≙ ompi/mca/coll/inter).
+
+MPI-4 §6.8: on an intercommunicator every all-* collective returns the
+reduction/concatenation of the REMOTE group's contributions; rooted
+collectives (bcast/reduce/...) run from one group's root to the other
+group. The reference's coll/inter component implements these by composing
+the local intracomm's collectives with leader-to-leader exchanges over the
+intercomm — the same structure used here: local collective → leaders swap →
+local bcast.
+
+Rooted-op addressing uses the MPI sentinels re-exported by ``comm``:
+``ROOT`` (I am the root), ``PROC_NULL`` (in the root group, not the root),
+or the root's rank in the remote group (receiving side).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..op import SUM, Op
+
+
+class InterColl:
+    """Per-intercommunicator collective table."""
+
+    def _lc(self, comm):
+        lc = comm.local_comm
+        if lc is None:
+            raise RuntimeError(
+                f"intercomm {comm.name} has no local_comm attached")
+        return lc
+
+    def barrier(self, comm) -> None:
+        from ..comm import TAG_INTER_COLL
+        lc = self._lc(comm)
+        lc.barrier()
+        if lc.rank == 0:
+            tok = np.zeros(1, np.int8)
+            comm.sendrecv(tok, 0, tok, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        lc.barrier()
+
+    def bcast(self, comm, buf, root: int = 0):
+        """Rooted: root passes ROOT, other root-group members PROC_NULL,
+        receivers pass the root's remote rank."""
+        from ..comm import PROC_NULL, ROOT, TAG_INTER_COLL
+        lc = self._lc(comm)
+        buf = np.asarray(buf)
+        if root == PROC_NULL:
+            return buf
+        if root == ROOT:
+            # I am the root: feed the remote side through its leader
+            comm.send(buf, 0, TAG_INTER_COLL)
+            return buf
+        # receiving group: remote rank `root` sent to our leader
+        if lc.rank == 0:
+            comm.recv(buf, root, TAG_INTER_COLL)
+        return lc.coll.bcast(lc, buf, root=0)
+
+    def allreduce(self, comm, sendbuf, recvbuf=None, op: Op = None):
+        """Each side receives the reduction of the REMOTE group."""
+        from ..comm import TAG_INTER_COLL
+        op = op or SUM
+        lc = self._lc(comm)
+        local_red = np.asarray(lc.coll.allreduce(lc, sendbuf, op=op))
+        remote_red = np.empty_like(local_red)
+        if lc.rank == 0:
+            comm.sendrecv(local_red, 0, remote_red, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        out = lc.coll.bcast(lc, remote_red, root=0)
+        if recvbuf is not None:
+            np.copyto(np.asarray(recvbuf), out)
+            return recvbuf
+        return out
+
+    def allgather(self, comm, sendbuf, recvbuf=None):
+        """Every rank receives the concatenation of the REMOTE group's
+        buffers. When the two sides contribute different per-rank counts
+        (legal in MPI — recvcount describes the remote side), pass a
+        ``recvbuf`` shaped (remote_size, *remote_elem); without one the
+        remote shape is assumed symmetric to the local sendbuf."""
+        from ..comm import TAG_INTER_COLL
+        lc = self._lc(comm)
+        sendbuf = np.asarray(sendbuf)
+        local_cat = np.asarray(lc.coll.allgather(lc, sendbuf))
+        if recvbuf is not None:
+            shape, dtype = np.asarray(recvbuf).shape, np.asarray(recvbuf).dtype
+        else:
+            shape, dtype = (comm.remote_size,) + sendbuf.shape, sendbuf.dtype
+        remote_cat = np.empty(shape, dtype)
+        if lc.rank == 0:
+            comm.sendrecv(local_cat, 0, remote_cat, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        out = lc.coll.bcast(lc, remote_cat, root=0)
+        if recvbuf is not None:
+            np.copyto(np.asarray(recvbuf), out)
+            return recvbuf
+        return out

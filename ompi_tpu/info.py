@@ -1,0 +1,50 @@
+"""MPI_Info objects (≙ ompi/info + opal/util/info.c).
+
+String-keyed hint dictionaries with MPI's case-insensitive keys and
+dup/get/set/delete surface. Hints are advisory everywhere (the reference
+ignores unknown hints too, MPI-4 §10)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+
+class Info:
+    ENV_KEYS = ("command", "argv", "maxprocs", "soft", "host", "arch", "wdir")
+
+    def __init__(self, items: Optional[Dict[str, str]] = None) -> None:
+        self._d: Dict[str, str] = {}
+        for k, v in (items or {}).items():
+            self.set(k, v)
+
+    @staticmethod
+    def _norm(key: str) -> str:
+        return str(key).lower()
+
+    def set(self, key: str, value: str) -> None:
+        self._d[self._norm(key)] = str(value)
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._d.get(self._norm(key), default)
+
+    def delete(self, key: str) -> None:
+        self._d.pop(self._norm(key), None)
+
+    def dup(self) -> "Info":
+        return Info(dict(self._d))
+
+    @property
+    def nkeys(self) -> int:
+        return len(self._d)
+
+    def keys(self) -> Iterator[str]:
+        return iter(self._d)
+
+    def __contains__(self, key: str) -> bool:
+        return self._norm(key) in self._d
+
+    def __repr__(self) -> str:
+        return f"Info({self._d!r})"
+
+
+INFO_NULL = Info()

@@ -13,7 +13,7 @@ Frame layout (transport-independent):
     u8 fmt       0 = pickled (am_tag, header) tuple
                  1 = p2p fixed header
                  2 = hello (tcp connection identification)
-    fmt 1: u8 am_tag | u8 kind | i32 cid | i64 tag | u32 seq |
+    fmt 1: u8 am_tag | u8 kind | i64 cid | i64 tag | u32 seq |
            u64 size | i64 a | i64 b     (a/b: sreq/rreq/off per kind)
     fmt 2: u32 rank
 """
@@ -24,7 +24,7 @@ import pickle
 import struct
 from typing import Any, Dict, Tuple
 
-_P2P = struct.Struct("<BBBiqIQqq")     # fmt, am_tag, kind, cid, tag, seq, size, a, b
+_P2P = struct.Struct("<BBBqqIQqq")     # fmt, am_tag, kind, cid, tag, seq, size, a, b
 _HELLO = struct.Struct("<BI")
 
 _FMT_PICKLE = 0

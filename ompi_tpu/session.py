@@ -1,0 +1,116 @@
+"""MPI-4 Sessions (≙ ompi/instance/instance.c — the Sessions-capable init).
+
+The reference's v5 init is session-based underneath: MPI_Init just creates
+an implicit instance, and explicit MPI_Session_init/finalize retain/release
+the same refcounted instance (instance.c:809 ompi_mpi_instance_init, with
+ompi_mpi_instance_retain at :359). The same shape here: a Session retains
+the process Context; the Context tears down when the last holder releases
+it. Process sets are the sessions-model naming for "which ranks": the two
+standard ones are exposed, and groups/communicators are created from them
+without requiring a parent communicator.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import List, Optional
+
+from .comm import Communicator, Group
+from .info import Info
+
+_lock = threading.Lock()
+_refs = 0
+
+WORLD_PSET = "mpi://WORLD"
+SELF_PSET = "mpi://SELF"
+
+
+class Session:
+    """An isolated handle on the runtime (MPI_Session)."""
+
+    def __init__(self, info: Optional[Info] = None, ctx=None) -> None:
+        from . import runtime
+
+        global _refs
+        if ctx is not None:       # threaded ranks / embedding: borrow a ctx
+            self.ctx = ctx
+            self._owns_runtime = False
+        else:
+            # if the user already did runtime.init() directly, they own the
+            # Context's lifetime — sessions then never tear it down
+            # (instance.c's retain/release: the implicit init holds a ref)
+            preexisting = (runtime._process_ctx is not None
+                           and not runtime._process_ctx.finalized)
+            with _lock:
+                self.ctx = runtime.init()
+                self._owns_runtime = not preexisting
+                if self._owns_runtime:
+                    _refs += 1
+        self.info = info or Info()
+        self._finalized = False
+        self._issued: dict = {}   # cid-signature → issue count
+
+    # -- process sets -------------------------------------------------------
+
+    def psets(self) -> List[str]:
+        return [WORLD_PSET, SELF_PSET]
+
+    def pset_info(self, name: str) -> Info:
+        n = self._pset_ranks(name)
+        return Info({"size": str(len(n)), "mpi_size": str(len(n))})
+
+    def _pset_ranks(self, name: str) -> List[int]:
+        if name == WORLD_PSET:
+            return list(range(self.ctx.size))
+        if name == SELF_PSET:
+            return [self.ctx.rank]
+        raise ValueError(f"unknown process set {name!r}")
+
+    def group_from_pset(self, name: str) -> Group:
+        return Group(self._pset_ranks(name))
+
+    # -- communicator creation (no parent needed) ---------------------------
+
+    def comm_from_group(self, group: Group, tag: str = "",
+                        name: str = "session-comm") -> Communicator:
+        """MPI_Comm_create_from_group: every member calls with an identical
+        (group, tag); the CID derives deterministically from both, so no
+        parent communicator or agreement round is needed. Distinct
+        (group, tag) pairs map to distinct CIDs (hash-based namespace above
+        the split()-allocated range; the reference instead runs its CID
+        agreement directly over the group, comm_cid.c). Repeated calls with
+        the same (group, tag) are collective on every member, so a per-call
+        sequence keeps each returned communicator's CID distinct."""
+        sig = ",".join(map(str, group.world_ranks)) + "|" + tag
+        n = self._issued.get(sig, 0)
+        self._issued[sig] = n + 1
+        cid = (1 << 40) | zlib.crc32(f"{sig}#{n}".encode())
+        return Communicator(self.ctx, group, cid, name)
+
+    def comm_world(self) -> Communicator:
+        return self.comm_from_group(self.group_from_pset(WORLD_PSET),
+                                    tag="world", name="session-world")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def finalize(self) -> None:
+        from . import runtime
+
+        global _refs
+        if self._finalized:
+            return
+        self._finalized = True
+        if not self._owns_runtime:
+            return
+        with _lock:
+            _refs -= 1
+            last = _refs <= 0
+        if last:
+            runtime.finalize()
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finalize()

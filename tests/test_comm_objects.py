@@ -1,0 +1,251 @@
+"""Communicator object model: collective CID agreement, intercommunicators,
+attributes/errhandlers, Info, Sessions (VERDICT r1 next#4, missing #3/#5).
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime
+from ompi_tpu.comm import PROC_NULL, ROOT, Communicator, Group
+from ompi_tpu.info import Info
+from ompi_tpu.op import SUM
+from ompi_tpu.session import Session
+
+
+def run(n, fn, timeout=90):
+    return runtime.run_ranks(n, fn, timeout=timeout)
+
+
+# -- split / CID agreement ---------------------------------------------------
+
+def test_split_agreement_no_root():
+    """Split is now one allgather + local computation; all members of all
+    colors agree on CIDs and the parent counter stays in sync."""
+    def body(ctx):
+        comm = ctx.comm_world
+        sub = comm.split(color=ctx.rank % 2, key=-ctx.rank)
+        # key=-rank reverses the order within each color
+        mates = [w for w in range(comm.size) if w % 2 == ctx.rank % 2]
+        assert sub.group.world_ranks == sorted(mates, reverse=True)
+        assert sub.size == len(mates)
+        # counters agree → a second split agrees on fresh cids
+        sub2 = comm.split(color=0, key=ctx.rank)
+        return (sub.cid, sub2.cid, comm._cid_counter)
+    results = run(4, body)
+    assert len({r[2] for r in results}) == 1          # counters uniform
+    assert len({r[1] for r in results}) == 1          # same cid for color 0
+    cids_by_color = {results[i][0] for i in range(4)}
+    assert len(cids_by_color) == 2                    # two colors → two cids
+
+
+def test_split_64_threaded_ranks():
+    """The round-1 rank-0-linear path had a 60s probe timeout and O(p)
+    serialization; the allgather path must handle 64 ranks quickly."""
+    def body(ctx):
+        comm = ctx.comm_world
+        sub = comm.split(color=ctx.rank % 4, key=ctx.rank)
+        assert sub.size == 16
+        x = np.array([1.0])
+        out = sub.coll.allreduce(sub, x)
+        assert out[0] == 16.0
+        return sub.cid
+    results = run(64, body, timeout=240)
+    assert len(set(results)) == 4
+
+
+def test_split_undefined_color():
+    def body(ctx):
+        comm = ctx.comm_world
+        sub = comm.split(color=None if ctx.rank == 1 else 7)
+        if ctx.rank == 1:
+            assert sub is None
+            return -1
+        return sub.size
+    assert run(3, body) == [2, -1, 2]
+
+
+# -- intercommunicators ------------------------------------------------------
+
+def test_intercomm_create_p2p_and_remote_size():
+    def body(ctx):
+        world = ctx.comm_world
+        side = ctx.rank % 2                     # evens vs odds
+        local = world.split(color=side, key=ctx.rank)
+        inter = local.create_intercomm(
+            local_leader=0, bridge_comm=world,
+            remote_leader=1 - side)             # world rank of other leader
+        assert inter.is_inter
+        assert inter.remote_size == world.size - local.size
+        assert inter.size == local.size
+        # p2p: rank i sends to remote rank i (pairs up across sides)
+        buf = np.array([10.0 * ctx.rank])
+        got = np.zeros(1)
+        st = inter.sendrecv(buf, inter.rank, got, inter.rank)
+        peer_world = inter.remote_group.world_of_rank(inter.rank)
+        assert got[0] == 10.0 * peer_world
+        assert st.source == inter.rank
+        return inter.cid
+    results = run(4, body)
+    assert len(set(results)) == 1               # same cid on both sides
+
+
+def test_intercomm_collectives_and_merge():
+    def body(ctx):
+        world = ctx.comm_world
+        side = 0 if ctx.rank < 2 else 1         # {0,1} vs {2,3,4}
+        local = world.split(color=side, key=ctx.rank)
+        inter = local.create_intercomm(0, world, 2 if side == 0 else 0)
+        # barrier runs
+        inter.barrier()
+        # allreduce: result = sum over REMOTE group
+        mine = np.array([float(ctx.rank + 1)])
+        red = inter.coll.allreduce(inter, mine, op=SUM)
+        expect = {0: 3 + 4 + 5, 1: 1 + 2}[side]
+        assert red[0] == expect, (red, expect)
+        # allgather of remote contributions
+        cat = inter.coll.allgather(inter, mine)
+        assert cat.shape[0] == inter.remote_size
+        # rooted bcast: world rank 0 (local rank 0 of side 0) → side 1
+        data = np.array([99.0 if ctx.rank == 0 else 0.0])
+        if side == 0:
+            inter.coll.bcast(inter, data, root=ROOT if ctx.rank == 0
+                             else PROC_NULL)
+            out = data
+        else:
+            out = inter.coll.bcast(inter, data, root=0)
+        if side == 1:
+            assert out[0] == 99.0
+        # merge: low side first
+        merged = inter.merge(high=(side == 1))
+        assert merged.size == world.size
+        assert merged.group.world_ranks == [0, 1, 2, 3, 4]
+        tot = merged.coll.allreduce(merged, np.array([1.0]))
+        assert tot[0] == 5.0
+        return merged.cid
+    results = run(5, body, timeout=120)
+    assert len(set(results)) == 1
+
+
+# -- attributes / errhandlers ------------------------------------------------
+
+def test_attributes_propagate_on_dup_only():
+    def body(ctx):
+        comm = ctx.comm_world
+        kv_copy = Communicator.create_keyval(
+            copy_fn=lambda c, k, v: v + 1)
+        kv_nocopy = Communicator.create_keyval()
+        comm.set_attr(kv_copy, 10)
+        comm.set_attr(kv_nocopy, 20)
+        assert comm.get_attr(kv_copy) == 10
+        child = comm.dup()
+        assert child.get_attr(kv_copy) == 11          # copy_fn applied
+        assert child.get_attr(kv_nocopy) is None      # MPI default: dropped
+        split = comm.split(0, ctx.rank)
+        assert split.get_attr(kv_copy) is None        # split never copies
+        deleted = []
+        kv_del = Communicator.create_keyval(
+            delete_fn=lambda c, k, v: deleted.append(v))
+        comm.set_attr(kv_del, 5)
+        comm.delete_attr(kv_del)
+        assert deleted == [5]
+        return True
+    assert all(run(2, body))
+
+
+def test_errhandler_return_vs_fatal():
+    def body(ctx):
+        comm = ctx.comm_world
+        with pytest.raises(ValueError):
+            comm.call_errhandler(ValueError("boom"))  # default: fatal
+        seen = []
+        comm.set_errhandler(lambda c, e: seen.append((c.name, str(e))))
+        comm.call_errhandler(ValueError("soft"))
+        assert seen == [("world", "soft")]
+        comm.set_errhandler(None)
+        with pytest.raises(ValueError):
+            comm.call_errhandler(ValueError("again"))
+        return True
+    assert all(run(1, body))
+
+
+# -- info / sessions ---------------------------------------------------------
+
+def test_info_case_insensitive_dup():
+    i = Info({"Host": "tpu-a", "WDIR": "/x"})
+    assert i.get("host") == "tpu-a"
+    assert "wdir" in i and "HOST" in i
+    j = i.dup()
+    j.set("host", "tpu-b")
+    assert i.get("host") == "tpu-a" and j.get("host") == "tpu-b"
+    j.delete("WDIR")
+    assert j.nkeys == 1 and i.nkeys == 2
+
+
+def test_session_world_and_self():
+    def body(ctx):
+        with Session(ctx=ctx) as ses:
+            assert set(ses.psets()) == {"mpi://WORLD", "mpi://SELF"}
+            assert ses.pset_info("mpi://WORLD").get("size") == "3"
+            wg = ses.group_from_pset("mpi://WORLD")
+            comm = ses.comm_from_group(wg, tag="t1")
+            out = comm.coll.allreduce(comm, np.array([2.0]))
+            assert out[0] == 6.0
+            sg = ses.group_from_pset("mpi://SELF")
+            selfc = ses.comm_from_group(sg, tag="s")
+            assert selfc.size == 1
+            # deterministic, distinct cids per (group, tag)
+            c2 = ses.comm_from_group(wg, tag="t2")
+            assert c2.cid != comm.cid
+        return comm.cid
+    results = run(3, body)
+    assert len(set(results)) == 1
+    assert all(run(3, body))  # repeatable
+
+
+def test_intercomm_dup_and_split_guard():
+    """dup() on an intercomm agrees a fresh cid on both sides (review r2
+    finding: the intracomm allgather carve corrupted intercomm dups);
+    split() raises instead of corrupting."""
+    def body(ctx):
+        world = ctx.comm_world
+        side = ctx.rank % 2
+        local = world.split(side, ctx.rank)
+        inter = local.create_intercomm(0, world, 1 - side)
+        d = inter.dup()
+        assert d.is_inter and d.cid != inter.cid
+        assert d.remote_size == inter.remote_size
+        # p2p still works on the dup: pair local rank i with remote rank i
+        got = np.zeros(1)
+        d.sendrecv(np.array([float(ctx.rank)]), d.rank, got, d.rank)
+        assert got[0] == float(d.remote_group.world_of_rank(d.rank))
+        with pytest.raises(NotImplementedError):
+            inter.split(0, 0)
+        return d.cid
+    results = run(4, body)
+    assert len(set(results)) == 1
+
+
+def test_session_repeat_same_tag_distinct_cids():
+    def body(ctx):
+        ses = Session(ctx=ctx)
+        g = ses.group_from_pset("mpi://WORLD")
+        c1 = ses.comm_from_group(g, tag="same")
+        c2 = ses.comm_from_group(g, tag="same")
+        assert c1.cid != c2.cid
+        out = c2.coll.allreduce(c2, np.array([1.0]))
+        assert out[0] == 2.0
+        return (c1.cid, c2.cid)
+    results = run(2, body)
+    assert results[0] == results[1]          # deterministic across ranks
+
+
+def test_intercomm_ft_guard():
+    """User-tag traffic to a peer rank resolves through the remote group
+    for FT checks too (no crash on plain sends after revoke-free setup)."""
+    def body(ctx):
+        world = ctx.comm_world
+        local = world.split(ctx.rank % 2, ctx.rank)
+        inter = local.create_intercomm(0, world, 1 - ctx.rank % 2)
+        assert inter._world_dst(0) == inter.remote_group.world_of_rank(0)
+        return True
+    assert all(run(2, body))
