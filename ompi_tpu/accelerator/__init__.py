@@ -1,0 +1,84 @@
+"""Accelerator framework: device-memory interrogation + staging contract.
+
+≙ the reference's ``accelerator`` MCA framework (opal/mca/accelerator/
+accelerator.h:171-557) with components cuda/rocm/ze/null; here the components
+are ``jax`` (PJRT-backed, jaxacc.py) and ``null`` (host-only). Selection is
+the standard priority query through the component registry — ``jax`` wins
+whenever jax imports; ``--mca accelerator null`` forces the host-only path
+exactly like ``--mca accelerator null`` does in the reference.
+
+Consumers (pml, coll/xla) call :func:`current` / :func:`check_addr` instead
+of type-sniffing jax at the call site.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core.component import Component, component, frameworks
+from .base import (AcceleratorModule, AddrInfo, CompletedEvent, DeviceBuffer,
+                   Event, StagingJob)
+
+__all__ = ["AcceleratorModule", "AddrInfo", "CompletedEvent", "DeviceBuffer",
+           "Event", "StagingJob", "current", "check_addr"]
+
+
+class NullAccelerator(AcceleratorModule):
+    """Host-only module (≙ accelerator/null): check_addr always says host."""
+
+    name = "null"
+
+    def check_addr(self, buf) -> Optional[AddrInfo]:
+        return None
+
+
+@component("accelerator", "null", priority=1)
+class NullComponent(Component):
+    def query(self, scope):
+        return self.priority, NullAccelerator()
+
+
+@component("accelerator", "jax", priority=50)
+class JaxComponent(Component):
+    def open(self) -> bool:
+        try:
+            import jax  # noqa: F401
+        except ImportError:  # pragma: no cover
+            return False
+        return True
+
+    def query(self, scope):
+        from .jaxacc import JaxAccelerator
+
+        return self.priority, JaxAccelerator()
+
+
+_lock = threading.Lock()
+_current: Optional[AcceleratorModule] = None
+
+
+def current() -> AcceleratorModule:
+    """The selected accelerator module (process-wide, selected once)."""
+    global _current
+    if _current is None:
+        with _lock:
+            if _current is None:
+                try:
+                    _, mod = frameworks.framework("accelerator").select(None)
+                except RuntimeError:
+                    mod = NullAccelerator()
+                _current = mod
+    return _current
+
+
+def check_addr(buf) -> Optional[AddrInfo]:
+    import sys
+
+    # Fast path: if jax was never imported in this process, no buffer can be
+    # device-resident — don't drag the jax runtime into host-only ranks
+    # (the reference's check_addr is likewise a cheap pointer interrogation,
+    # accelerator.h:171).
+    if "jax" not in sys.modules and not isinstance(buf, DeviceBuffer):
+        return None
+    return current().check_addr(buf)

@@ -1,0 +1,127 @@
+"""Accelerator framework contract.
+
+TPU-native re-design of the reference's accelerator framework interface
+(opal/mca/accelerator/accelerator.h):
+  * ``check_addr`` — buffer-type interrogation (accelerator.h:171): is this
+    memory device-resident, and on which device(s)?  Here the unit is a
+    framework-level array object (jax.Array), not a raw pointer — PJRT never
+    exposes raw device pointers to clients.
+  * streams/events (accelerator.h:184-243) — PJRT executions are ordered per
+    device; the observable completion object is the array's ready-future,
+    wrapped as :class:`Event` (record/query/wait).
+  * async memcpy (accelerator.h:265) — ``memcpy_d2h_async`` returns an Event
+    per bounded chunk so large device payloads stage without a monolithic
+    blocking transfer; H2D goes through ``device_put`` (asynchronous by PJRT
+    semantics — it returns before the copy lands).
+  * mem alloc (accelerator.h:324) — ``mem_alloc`` creates an HBM buffer.
+  * IPC handles (accelerator.h:395-481) are deliberately absent: TPU device
+    memory moves between processes over ICI via compiled collectives (the
+    device plane), never by exporting HBM handles — SURVEY.md §5.8.
+
+Device-side non-contiguous pack/unpack (the reference packs on host,
+opal_convertor.c:245) is implemented with XLA gather/scatter over a cached
+element-index map — see ``JaxAccelerator.pack_device``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass
+class AddrInfo:
+    """Result of check_addr for device-resident memory (accelerator.h:171
+    flags + device id out-params)."""
+
+    platform: str                 # "tpu" | "cpu" | "gpu" (PJRT platform name)
+    device_ids: List[int]         # addressable device ids holding shards
+    nbytes: int
+    dtype: np.dtype
+    shape: Tuple[int, ...]
+    sharded: bool = False         # True when the array spans >1 device
+
+
+class Event:
+    """Completion object (accelerator.h:184-243 record/query/wait/sync).
+
+    ``query()`` is non-blocking; ``wait()`` blocks until the recorded work
+    (device compute producing the arrays, or their host copies) is done.
+    """
+
+    def query(self) -> bool:  # pragma: no cover - interface
+        return True
+
+    def wait(self) -> None:  # pragma: no cover - interface
+        pass
+
+
+class CompletedEvent(Event):
+    pass
+
+
+@dataclass
+class StagingJob:
+    """An in-flight chunked D2H staging transfer: one Event per chunk plus
+    the host-side chunk destinations, joined by :meth:`wait`."""
+
+    chunks: List[object] = field(default_factory=list)   # per-chunk handles
+    events: List[Event] = field(default_factory=list)
+
+    def query(self) -> bool:
+        return all(e.query() for e in self.events)
+
+    def wait(self) -> bytes:
+        raise NotImplementedError
+
+
+class AcceleratorModule:
+    """Component module contract. ``null`` declines everything (host-only);
+    ``jax`` implements the PJRT-backed paths."""
+
+    name = "base"
+
+    # -- interrogation ------------------------------------------------------
+    def check_addr(self, buf) -> Optional[AddrInfo]:
+        return None
+
+    # -- memory -------------------------------------------------------------
+    def mem_alloc(self, shape: Sequence[int], dtype, device=None):
+        raise NotImplementedError
+
+    # -- transfers ----------------------------------------------------------
+    def memcpy_d2h_async(self, arr, chunk_bytes: int) -> "StagingJob":
+        raise NotImplementedError
+
+    def memcpy_h2d(self, host: np.ndarray, like=None):
+        raise NotImplementedError
+
+    # -- datatype staging (pml entry points) --------------------------------
+    def stage_out(self, buf, datatype, count) -> bytes:
+        """Device buffer → packed host bytes (send side)."""
+        raise NotImplementedError
+
+    def stage_in(self, data: bytes, template, datatype, count):
+        """Packed host bytes → new device array shaped like ``template``
+        (recv side); gap bytes of non-contiguous datatypes keep the
+        template's values, matching receive semantics on host buffers."""
+        raise NotImplementedError
+
+
+class DeviceBuffer:
+    """Mutable holder for a device array used as a *receive* destination.
+
+    jax arrays are immutable, so a receive cannot scribble into the caller's
+    array the way the reference writes through a raw pointer; receiving into
+    a DeviceBuffer replaces ``.array`` with the received contents instead.
+    """
+
+    __slots__ = ("array",)
+
+    def __init__(self, array) -> None:
+        self.array = array
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"DeviceBuffer({self.array!r})"

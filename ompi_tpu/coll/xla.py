@@ -28,9 +28,9 @@ from .tuned import TunedModule
 
 
 def _is_device(x) -> bool:
-    import jax
+    from .. import accelerator
 
-    return isinstance(x, jax.Array)
+    return accelerator.check_addr(x) is not None
 
 
 class XlaModule(CollModule):

@@ -203,11 +203,11 @@ class Communicator:
     def dup(self, name: Optional[str] = None) -> "Communicator":
         if self.is_inter:
             cid = self._inter_agree_cid()
-            child = Communicator(
+            child = self._inherit(Communicator(
                 self.ctx, Group(list(self.group.world_ranks)), cid,
                 name or f"{self.name}.dup",
                 remote_group=Group(list(self.remote_group.world_ranks)),
-                local_comm=self.local_comm)
+                local_comm=self.local_comm))
         else:
             child = self.split(color=0, key=self.rank,
                                name=name or f"{self.name}.dup")
@@ -267,8 +267,14 @@ class Communicator:
             (int(rows[r, 1]), r) for r in range(self.size)
             if int(rows[r, 0]) == int(color))
         world_ranks = [int(rows[r, 2]) for _k, r in members]
-        return Communicator(self.ctx, Group(world_ranks), cid,
-                            name or f"{self.name}.split")
+        return self._inherit(Communicator(self.ctx, Group(world_ranks), cid,
+                                          name or f"{self.name}.split"))
+
+    def _inherit(self, child: "Communicator") -> "Communicator":
+        """New communicators inherit the parent's error handler (MPI-4
+        §9.5; attributes propagate only on dup — _copy_attrs_to)."""
+        child.errhandler = self.errhandler
+        return child
 
     def create_intercomm(self, local_leader: int, bridge_comm: "Communicator",
                          remote_leader: int, tag: int = 0,
@@ -287,10 +293,13 @@ class Communicator:
         group_arr = np.array(self.group.world_ranks, np.int64)
         wire_tag = TAG_INTERCOMM_BASE - (int(tag) % 1000)
         if self.rank == local_leader:
-            # leaders exchange [proposal, n, members...]
+            # leaders exchange [proposal, n, members...]; isend-then-probe —
+            # both leaders sending blocking first would deadlock once the
+            # payload crosses the eager limit (rendezvous needs the peer's
+            # recv posted)
             payload = np.concatenate(
                 [np.array([my_prop, self.size], np.int64), group_arr])
-            bridge_comm.send(payload, remote_leader, wire_tag)
+            sreq = bridge_comm.isend(payload, remote_leader, wire_tag)
             st = bridge_comm.probe(remote_leader, wire_tag, timeout=60)
             if st is None:
                 raise RuntimeError(
@@ -298,6 +307,7 @@ class Communicator:
                     f"leader (bridge rank {remote_leader}) within 60s")
             other = np.zeros(st["count"] // 8, np.int64)
             bridge_comm.recv(other, remote_leader, wire_tag)
+            sreq.wait()
         else:
             other = None
         # local bcast of the remote side's payload (variable length: size
@@ -312,10 +322,10 @@ class Communicator:
         cid = max(my_prop, remote_prop)
         with self._lock:
             self._cid_counter = max(self._cid_counter, cid + 1)
-        return Communicator(
+        return self._inherit(Communicator(
             self.ctx, Group(list(self.group.world_ranks)), cid,
             name or f"{self.name}.inter", remote_group=Group(remote_ranks),
-            local_comm=self)
+            local_comm=self))
 
     def merge(self, high: bool = False,
               name: Optional[str] = None) -> "Communicator":
@@ -343,8 +353,8 @@ class Communicator:
         else:
             union = list(self.remote_group.world_ranks) + \
                 list(self.group.world_ranks)
-        return Communicator(self.ctx, Group(union), cid,
-                            name or f"{self.name}.merged")
+        return self._inherit(Communicator(self.ctx, Group(union), cid,
+                                          name or f"{self.name}.merged"))
 
     # -- attributes & error handlers (≙ ompi/attribute, ompi/errhandler) ----
 

@@ -12,9 +12,17 @@ Implements MPI send/recv semantics over the byte transports:
     (completion implies the receive was matched).
 
 Payloads are packed/unpacked through the datatype convertor; contiguous
-numpy buffers take the single-copy fast path. Device (jax) arrays are staged
-via numpy here — the ICI path for device data is the coll/xla component, not
-host p2p (SURVEY.md §5.8).
+numpy buffers take the single-copy fast path. Device (jax) arrays are
+detected through the accelerator framework (``accelerator.check_addr``,
+≙ accelerator.h:171 — not an implicit np.asarray) and staged explicitly:
+sends pack on device where the datatype allows (XLA gather) then D2H in
+bounded async chunks; receives land in a host staging buffer and are
+uploaded once complete. Receiving *into* a device destination uses
+``accelerator.DeviceBuffer`` (jax arrays are immutable); the received array
+also lands on ``request.result``. The ICI path for bulk device data
+remains the coll/xla component (SURVEY.md §5.8) — p2p staging is for the
+control-scale messages MPI apps send between device computations
+(≙ pml_ob1_accelerator.c's role).
 """
 
 from __future__ import annotations
@@ -25,6 +33,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from .. import accelerator as _accel
 from ..core import var as _var
 from ..core.output import show_help
 from ..core.progress import ProgressEngine
@@ -38,6 +47,22 @@ class TruncateError(RuntimeError):
     pass
 
 
+def _capacity_count(nbytes: int, dt: Datatype) -> int:
+    """How many datatype elements fit in nbytes — extent-aware: element i
+    occupies [i*extent, i*extent + span) where span is the used byte range
+    (≙ opal_datatype true extent accounting). Using size here would
+    overcount strided types and let the convertor write past the buffer."""
+    if not dt.size:
+        return 0
+    if dt.is_contiguous:
+        return nbytes // dt.size
+    span = max(s.offset + s.nbytes for s in dt.segments)
+    n = nbytes // dt.extent
+    if nbytes - n * dt.extent >= span:
+        n += 1
+    return n
+
+
 def _buffer_args(buf, datatype: Optional[Datatype], count: Optional[int]
                  ) -> Tuple[np.ndarray, Datatype, int]:
     arr = np.asarray(buf)
@@ -46,7 +71,7 @@ def _buffer_args(buf, datatype: Optional[Datatype], count: Optional[int]
         if count is None:
             count = arr.size
     elif count is None:
-        count = (arr.nbytes // datatype.size) if datatype.size else 0
+        count = _capacity_count(arr.nbytes, datatype)
     return arr, datatype, count
 
 
@@ -61,13 +86,33 @@ class _SendState:
 
 
 class _RecvState:
-    __slots__ = ("req", "conv", "received", "total")
+    __slots__ = ("req", "conv", "received", "total", "finish")
 
-    def __init__(self, req: Request, conv: Convertor, total: int) -> None:
+    def __init__(self, req: Request, conv, total: int,
+                 finish=None) -> None:
         self.req = req
         self.conv = conv
         self.received = 0
         self.total = total
+        self.finish = finish     # device staging upload, run at completion
+
+
+class _PackedSink:
+    """Convertor-shaped accumulator for device receives: frags land in a
+    host bytearray; the single H2D + device scatter happens at completion
+    (pml device path, ≙ pml_ob1_accelerator.c staging protocol)."""
+
+    def __init__(self, total: int) -> None:
+        self.data = bytearray(total)
+        self.position = 0
+
+    def set_position(self, position: int) -> None:
+        self.position = position
+
+    def unpack(self, payload: bytes) -> int:
+        self.data[self.position:self.position + len(payload)] = payload
+        self.position += len(payload)
+        return len(payload)
 
 
 class P2P:
@@ -98,8 +143,15 @@ class P2P:
     def isend(self, buf, dst: int, tag: int = 0, cid: int = 0,
               datatype: Optional[Datatype] = None, count: Optional[int] = None,
               sync: bool = False) -> Request:
-        arr, dt, cnt = _buffer_args(buf, datatype, count)
-        data = Convertor(arr, dt, cnt).pack() if cnt else b""
+        info = _accel.check_addr(buf)
+        if info is not None:   # explicit device staging, never np.asarray
+            if datatype is not None and count is None:
+                count = _capacity_count(info.nbytes, datatype)
+            data = _accel.current().stage_out(buf, datatype, count)
+            self.spc.inc("device_stage_out_bytes", len(data))
+        else:
+            arr, dt, cnt = _buffer_args(buf, datatype, count)
+            data = Convertor(arr, dt, cnt).pack() if cnt else b""
         req = Request()
         req.status.source = self.rank
         req.status.tag = tag
@@ -136,9 +188,26 @@ class P2P:
     def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
               cid: int = 0, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
-        arr, dt, cnt = _buffer_args(buf, datatype, count)
+        dinfo = _accel.check_addr(buf)
+        if dinfo is not None:
+            # device destination: stage packed stream on host, upload once
+            template = buf.array if isinstance(buf, _accel.DeviceBuffer) else buf
+            dt = datatype if datatype is not None else from_numpy(dinfo.dtype)
+            cnt = count if count is not None else (
+                template.size if datatype is None
+                else _capacity_count(dinfo.nbytes, dt))
+            arr = None
+        else:
+            arr, dt, cnt = _buffer_args(buf, datatype, count)
         req = Request()
         self.spc.inc("recvs")
+
+        def deliver(data: bytes) -> None:
+            result = _accel.current().stage_in(data, template, dt, cnt)
+            if isinstance(buf, _accel.DeviceBuffer):
+                buf.array = result
+            req.result = result
+            self.spc.inc("device_stage_in_bytes", len(data))
 
         def on_match(u: Unexpected) -> None:
             self.spc.inc("bytes_recvd", u.header["size"])
@@ -159,17 +228,27 @@ class P2P:
                     f"recv buffer {capacity}B < message {u.header['size']}B"))
                 return
             if u.kind == "match":
-                if u.payload:
+                if dinfo is not None:
+                    deliver(u.payload)
+                elif u.payload:
                     Convertor(arr, dt, cnt).unpack(u.payload)
                 req.status.count = len(u.payload)
                 req.complete()
             else:  # rendezvous: ACK with a recv-request id, collect FRAGs
                 rreq = next(self._rreq)
-                conv = Convertor(arr, dt, cnt)
-                self._pending_recv[rreq] = _RecvState(req, conv, u.header["size"])
+                if dinfo is not None:
+                    sink = _PackedSink(u.header["size"])
+                    state = _RecvState(req, sink, u.header["size"],
+                                       finish=lambda: deliver(bytes(sink.data)))
+                else:
+                    state = _RecvState(req, Convertor(arr, dt, cnt),
+                                       u.header["size"])
+                self._pending_recv[rreq] = state
                 req.status.count = u.header["size"]
                 if u.header["size"] == 0:
                     del self._pending_recv[rreq]
+                    if state.finish is not None:
+                        state.finish()
                     req.complete()
                     # still ACK so the sender's request completes
                 self.layer.send(u.src, T.AM_P2P,
@@ -237,6 +316,8 @@ class P2P:
             state.received += len(payload)
             if state.received >= state.total:
                 del self._pending_recv[header["rreq"]]
+                if state.finish is not None:
+                    state.finish()
                 state.req.complete()
         else:
             raise RuntimeError(f"unknown p2p frame kind {k!r}")

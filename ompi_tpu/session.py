@@ -21,6 +21,7 @@ from .info import Info
 
 _lock = threading.Lock()
 _refs = 0
+_session_owned = False    # True while the Context was created BY a session
 
 WORLD_PSET = "mpi://WORLD"
 SELF_PSET = "mpi://SELF"
@@ -32,24 +33,29 @@ class Session:
     def __init__(self, info: Optional[Info] = None, ctx=None) -> None:
         from . import runtime
 
-        global _refs
+        global _refs, _session_owned
         if ctx is not None:       # threaded ranks / embedding: borrow a ctx
             self.ctx = ctx
             self._owns_runtime = False
         else:
-            # if the user already did runtime.init() directly, they own the
-            # Context's lifetime — sessions then never tear it down
-            # (instance.c's retain/release: the implicit init holds a ref)
-            preexisting = (runtime._process_ctx is not None
-                           and not runtime._process_ctx.finalized)
+            # if the user already did runtime.init() directly BEFORE any
+            # session, they own the Context's lifetime — sessions then never
+            # tear it down (instance.c's retain/release: the implicit init
+            # holds a ref). But every session opened while the Context is
+            # session-created takes its own reference, so the Context
+            # survives until the LAST session releases it (instance.c:359
+            # ompi_mpi_instance_retain).
             with _lock:
+                preexisting = (runtime._process_ctx is not None
+                               and not runtime._process_ctx.finalized)
                 self.ctx = runtime.init()
-                self._owns_runtime = not preexisting
+                if not preexisting:
+                    _session_owned = True
+                self._owns_runtime = _session_owned
                 if self._owns_runtime:
                     _refs += 1
         self.info = info or Info()
         self._finalized = False
-        self._issued: dict = {}   # cid-signature → issue count
 
     # -- process sets -------------------------------------------------------
 
@@ -82,9 +88,17 @@ class Session:
         agreement directly over the group, comm_cid.c). Repeated calls with
         the same (group, tag) are collective on every member, so a per-call
         sequence keeps each returned communicator's CID distinct."""
+        # issue counts live on the rank's Context, not the Session: two
+        # Sessions over the same rank must yield DISTINCT cids for the same
+        # (group, tag), while every rank (including threaded test ranks with
+        # their own Contexts) must compute the SAME sequence
         sig = ",".join(map(str, group.world_ranks)) + "|" + tag
-        n = self._issued.get(sig, 0)
-        self._issued[sig] = n + 1
+        issued = getattr(self.ctx, "_session_issued", None)
+        if issued is None:
+            issued = self.ctx._session_issued = {}
+        with _lock:
+            n = issued.get(sig, 0)
+            issued[sig] = n + 1
         cid = (1 << 40) | zlib.crc32(f"{sig}#{n}".encode())
         return Communicator(self.ctx, group, cid, name)
 
@@ -97,7 +111,7 @@ class Session:
     def finalize(self) -> None:
         from . import runtime
 
-        global _refs
+        global _refs, _session_owned
         if self._finalized:
             return
         self._finalized = True
@@ -106,6 +120,8 @@ class Session:
         with _lock:
             _refs -= 1
             last = _refs <= 0
+            if last:
+                _session_owned = False
         if last:
             runtime.finalize()
 
