@@ -6,12 +6,16 @@ dynamic rules file coll_tuned_dynamic_file.c:58).
 
 Algorithms implemented (reference file:line for the original):
   allreduce: recursive-doubling (coll_base_allreduce.c:133), ring (:344),
+             segmented/pipelined ring (:621),
              Rabenseifner reduce-scatter+allgather (:973)
-  bcast:     binomial tree (coll_base_bcast.c:333), scatter+allgather (:774)
-  reduce:    binomial tree (coll_base_reduce.c:476)
+  bcast:     binomial tree (coll_base_bcast.c:333), pipeline (:277),
+             chain (:305), knomial (:720), scatter+allgather (:774)
+  reduce:    binomial tree (coll_base_reduce.c:476),
+             in-order binary for non-commutative ops (:514)
   allgather: recursive-doubling (coll_base_allgather.c:85), ring (:330),
-             bruck (:767 k=2)
-  reduce_scatter_block: recursive-halving (coll_base_reduce_scatter.c:132)
+             neighbor-exchange (:456), bruck (:767 k=2)
+  reduce_scatter_block: recursive-halving (coll_base_reduce_scatter.c:132),
+             butterfly for any comm size (:691)
   alltoall:  pairwise (coll_base_alltoall.c:180), bruck (:239)
   barrier:   recursive-doubling (coll_base_barrier.c:188), bruck (:269)
   scan/exscan: recursive-doubling prefix (coll_base_scan.c:157)
@@ -210,6 +214,65 @@ def allreduce_rabenseifner(comm, send: np.ndarray, recv: np.ndarray,
             comm.send(flat, rank - 1, T_BCAST)
 
 
+def allreduce_segmented_ring(comm, send: np.ndarray, recv: np.ndarray,
+                             op: Op, segsize: int) -> None:
+    """coll_base_allreduce.c:621 — ring reduce-scatter+allgather where each
+    per-step chunk transfer is pipelined in ``segsize``-byte segments: the
+    next segment's sendrecv is posted (isend+irecv) before the current
+    segment's reduction runs, overlapping wire time with compute. This is
+    the segmented/pipelined discipline the whole coll/base library applies
+    to large messages (segsize parameters throughout, SURVEY.md §5.7)."""
+    size, rank = comm.size, comm.rank
+    recv[...] = send
+    if size == 1:
+        return
+    flat = recv.reshape(-1)
+    seg_items = max(1, segsize // flat.dtype.itemsize)
+    bounds = np.linspace(0, flat.size, size + 1).astype(int)
+    right, left = (rank + 1) % size, (rank - 1) % size
+
+    def spans(chunk):
+        lo, hi = int(bounds[chunk]), int(bounds[chunk + 1])
+        return [(s, min(s + seg_items, hi)) for s in range(lo, hi, seg_items)] \
+            or [(lo, lo)]
+
+    # reduce-scatter phase, depth-2 pipelined per chunk
+    for step in range(size - 1):
+        s_spans = spans((rank - step) % size)
+        r_spans = spans((rank - step - 1) % size)
+        n = max(len(s_spans), len(r_spans))
+        inboxes = [np.empty(b - a, flat.dtype) for a, b in r_spans]
+        sreqs, rreqs = {}, {}
+
+        def post(j):
+            if j < len(r_spans):
+                rreqs[j] = comm.irecv(inboxes[j], left, T_REDUCE)
+            if j < len(s_spans):
+                a, b = s_spans[j]
+                sreqs[j] = comm.isend(flat[a:b], right, T_REDUCE)
+
+        post(0)
+        for j in range(n):
+            post(j + 1)             # next segment in flight…
+            if j in rreqs:
+                rreqs[j].wait()     # …while this one reduces
+                a, b = r_spans[j]
+                seg = flat[a:b]
+                seg[...] = op(inboxes[j], seg)
+            if j in sreqs:
+                sreqs[j].wait()
+    # allgather phase (pure copy — single-segment pipelining gains nothing)
+    for step in range(size - 1):
+        s_lo, s_hi = int(bounds[(rank + 1 - step) % size]), \
+            int(bounds[(rank + 1 - step) % size + 1])
+        r_lo, r_hi = int(bounds[(rank - step) % size]), \
+            int(bounds[(rank - step) % size + 1])
+        inbox = np.empty(r_hi - r_lo, flat.dtype)
+        comm.sendrecv(flat[s_lo:s_hi], right, inbox, left,
+                      T_ALLGATHER, T_ALLGATHER)
+        flat[r_lo:r_hi] = inbox
+
+
 # ---------------------------------------------------------------------------
 # bcast / reduce trees
 # ---------------------------------------------------------------------------
@@ -285,6 +348,126 @@ def bcast_scatter_allgather(comm, buf: np.ndarray, root: int) -> None:
         comm.sendrecv(flat[s_lo:s_hi], right, inbox, left,
                       T_ALLGATHER, T_ALLGATHER)
         flat[r_lo:r_hi] = inbox
+
+
+def _segments(flat: np.ndarray, segsize: int):
+    seg_items = max(1, segsize // flat.dtype.itemsize)
+    return [flat[i:i + seg_items] for i in range(0, flat.size, seg_items)] \
+        or [flat]
+
+
+def bcast_pipeline(comm, buf: np.ndarray, root: int, segsize: int,
+                   chains: int = 1) -> None:
+    """coll_base_bcast.c:277 (pipeline) / :305 (chain): non-root ranks form
+    ``chains`` chains hanging off the root; the message streams down each
+    chain in segsize segments, every rank forwarding segment j to its child
+    while segment j+1 is still arriving (all receives pre-posted). pipeline
+    = chain with chains=1."""
+    size, rank = comm.size, comm.rank
+    if size == 1:
+        return
+    flat = buf.reshape(-1)
+    segs = _segments(flat, segsize)
+    chains = max(1, min(chains, size - 1))
+    clen = -(-(size - 1) // chains)          # ceil chain length
+    if rank == root:
+        heads = [(root + 1 + c * clen) % size
+                 for c in range(chains) if c * clen < size - 1]
+        sreqs = []
+        for s in segs:
+            for h in heads:
+                sreqs.append(comm.isend(s, h, T_BCAST))
+        wait_all(sreqs)
+        return
+    idx = (rank - root) % size - 1           # position among non-root ranks
+    pos = idx % clen
+    parent = root if pos == 0 else (rank - 1 + size) % size
+    nxt = idx + 1
+    child = None
+    if pos + 1 < clen and nxt < size - 1:
+        child = (rank + 1) % size
+    rreqs = [comm.irecv(s, parent, T_BCAST) for s in segs]
+    sreqs = []
+    for j, s in enumerate(segs):
+        rreqs[j].wait()
+        if child is not None:
+            sreqs.append(comm.isend(s, child, T_BCAST))
+    wait_all(sreqs)
+
+
+def _knomial_tree(rank: int, size: int, root: int, radix: int):
+    """K-nomial tree (≙ coll_base_topo.c:479 kmtree): a vrank's parent
+    clears its least-significant nonzero base-radix digit; its children add
+    d*mask for every level below that digit."""
+    vrank = (rank - root) % size
+    children = []
+    mask = 1
+    parent = None
+    while mask < size:
+        digit = (vrank // mask) % radix
+        if digit:
+            parent = ((vrank - digit * mask) + root) % size
+            break
+        for d in range(1, radix):
+            child = vrank + d * mask
+            if child < size:
+                children.append((child + root) % size)
+        mask *= radix
+    return parent, children
+
+
+def bcast_knomial(comm, buf: np.ndarray, root: int, radix: int) -> None:
+    """coll_base_bcast.c:720 — radix-k binomial tree: shallower than
+    binomial (log_k p rounds) at the cost of k-1 sends per internal node;
+    wins for small messages where latency dominates."""
+    parent, children = _knomial_tree(comm.rank, comm.size, root,
+                                     max(2, radix))
+    if parent is not None:
+        comm.recv(buf, parent, T_BCAST)
+    # farthest (largest-subtree) children first, like the reference
+    reqs = [comm.isend(buf, c, T_BCAST) for c in reversed(children)]
+    wait_all(reqs)
+
+
+def reduce_inorder_binary(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                          op: Op, root: int) -> Optional[np.ndarray]:
+    """coll_base_reduce.c:514 — in-order binary tree for NON-commutative
+    ops: the reduction combines rank ranges strictly as
+    op(ranks lo..mid-1, ranks mid..hi), so the result equals the canonical
+    left-to-right fold regardless of tree shape."""
+    rank = comm.rank
+
+    def reduce_range(lo: int, hi: int):
+        """Value of fold(lo..hi), landing on rank lo; None elsewhere."""
+        if lo == hi:
+            return send.copy() if rank == lo else None
+        mid = (lo + hi + 1) // 2
+        if rank < mid:
+            v = reduce_range(lo, mid - 1)
+            if rank == lo:
+                tmp = np.empty_like(send)
+                comm.recv(tmp, mid, T_REDUCE)
+                return op(v, tmp)        # left range before right range
+            return None
+        v = reduce_range(mid, hi)
+        if rank == mid:
+            comm.send(v, lo, T_REDUCE)
+        return None
+
+    acc = reduce_range(0, comm.size - 1)
+    if root != 0:                        # relocate the fold to the root
+        if rank == 0:
+            comm.send(acc, root, T_REDUCE)
+            return None
+        if rank == root:
+            acc = np.empty_like(send)
+            comm.recv(acc, 0, T_REDUCE)
+    if rank != root:
+        return None
+    if recv is None:
+        recv = np.empty_like(send)
+    recv[...] = acc
+    return recv
 
 
 def reduce_binomial(comm, send: np.ndarray, recv: Optional[np.ndarray],
@@ -433,6 +616,107 @@ def reduce_scatter_block_recursive_halving(comm, send: np.ndarray,
     recv.reshape(-1)[:] = flat[rank * blk:(rank + 1) * blk]
 
 
+def allgather_neighbor_exchange(comm, send: np.ndarray,
+                                recv: np.ndarray) -> None:
+    """coll_base_allgather.c:456 — even comm sizes: p/2 rounds alternating
+    between the two ring neighbors; each round forwards the pair of blocks
+    learned in the previous round. Half the rounds of ring for the same
+    per-round payload shape."""
+    size, rank = comm.size, comm.rank
+    parts = recv.reshape(size, -1)
+    parts[rank] = send.reshape(-1)
+    sched = _neighbor_exchange_schedule(size)[rank]
+    for peer, send_blocks, recv_blocks in sched:
+        outbox = parts[send_blocks].copy()
+        inbox = np.empty((len(recv_blocks), parts.shape[1]), parts.dtype)
+        comm.sendrecv(outbox, peer, inbox, peer, T_ALLGATHER, T_ALLGATHER)
+        parts[recv_blocks] = inbox
+
+
+_NE_SCHED_CACHE: dict = {}
+
+
+def _neighbor_exchange_schedule(size: int):
+    """Per-rank [(peer, send_block_ids, recv_block_ids)] for the
+    neighbor-exchange rounds; deterministic, cached per comm size."""
+    sched = _NE_SCHED_CACHE.get(size)
+    if sched is not None:
+        return sched
+    recent = {r: [r] for r in range(size)}
+    sched = {r: [] for r in range(size)}
+    for step in range(size // 2):
+        peers = {}
+        for r in range(size):
+            if (r % 2 == 0) == (step % 2 == 0):
+                peers[r] = (r + 1) % size
+            else:
+                peers[r] = (r - 1) % size
+        nxt = {}
+        for r in range(size):
+            p = peers[r]
+            sched[r].append((p, list(recent[r]), list(recent[p])))
+            nxt[r] = [r, p] if step == 0 else list(recent[p])
+        recent = nxt
+    _NE_SCHED_CACHE[size] = sched
+    return sched
+
+
+def reduce_scatter_block_butterfly(comm, send: np.ndarray,
+                                   recv: np.ndarray, op: Op) -> None:
+    """coll_base_reduce_scatter.c:691 — butterfly for ANY comm size:
+    non-power-of-two remainders fold their full vector into a partner
+    first, the 2^k survivors run recursive vector halving along original-
+    block boundaries, then folded-out ranks get their block back."""
+    size, rank = comm.size, comm.rank
+    flat = send.reshape(-1).astype(send.dtype, copy=True)
+    blk = flat.size // size
+    pof2 = 1 << (size.bit_length() - 1)
+    rem = size - pof2
+    if rank < 2 * rem:
+        if rank % 2 == 0:           # folds out; receives its block at the end
+            comm.send(flat, rank + 1, T_RSCAT)
+            comm.recv(recv.reshape(-1), rank + 1, T_RSCAT)
+            return
+        tmp = np.empty_like(flat)
+        comm.recv(tmp, rank - 1, T_RSCAT)
+        flat[...] = op(tmp, flat)
+        newrank = rank // 2
+    else:
+        newrank = rank - rem
+
+    def start_block(nr: int) -> int:      # first original block nr represents
+        return 2 * nr if nr < rem else nr + rem
+
+    glo, ghi = 0, pof2
+    mask = pof2 >> 1
+    while mask > 0:
+        peer_new = newrank ^ mask
+        peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+        gmid = glo + mask
+        if newrank & mask:
+            keep = (gmid, ghi)
+            send_rng = (glo, gmid)
+        else:
+            keep = (glo, gmid)
+            send_rng = (gmid, ghi)
+        k_lo, k_hi = start_block(keep[0]) * blk, start_block(keep[1]) * blk
+        s_lo, s_hi = start_block(send_rng[0]) * blk, \
+            start_block(send_rng[1]) * blk
+        inbox = np.empty(k_hi - k_lo, flat.dtype)
+        comm.sendrecv(flat[s_lo:s_hi], peer, inbox, peer, T_RSCAT, T_RSCAT)
+        seg = flat[k_lo:k_hi]
+        seg[...] = op(inbox, seg)
+        glo, ghi = keep
+        mask >>= 1
+    # newrank now holds the reduced segment for its original block(s)
+    b0 = start_block(newrank)
+    if newrank < rem:                     # deliver the even partner's block
+        comm.send(flat[b0 * blk:(b0 + 1) * blk], rank - 1, T_RSCAT)
+        recv.reshape(-1)[:] = flat[(b0 + 1) * blk:(b0 + 2) * blk]
+    else:
+        recv.reshape(-1)[:] = flat[b0 * blk:(b0 + 1) * blk]
+
+
 def barrier_recursive_doubling(comm) -> None:
     """coll_base_barrier.c:188; bruck (:269) handles non-pof2 the same way
     here because sendrecv pairs are symmetric per round."""
@@ -488,14 +772,27 @@ _var.register("coll", "tuned", "dynamic_rules", "", type=str, level=4,
                    "'<coll> <min_comm_size> <min_bytes> <algorithm>'.")
 
 for _coll, _algs in {
-    "allreduce": "recursive_doubling|ring|rabenseifner",
-    "bcast": "binomial|scatter_allgather",
-    "allgather": "recursive_doubling|ring|bruck",
+    "allreduce": "recursive_doubling|ring|segmented_ring|rabenseifner",
+    "bcast": "binomial|knomial|pipeline|chain|scatter_allgather",
+    "reduce": "binomial|inorder_binary",
+    "allgather": "recursive_doubling|ring|neighbor_exchange|bruck",
     "alltoall": "pairwise|bruck",
-    "reduce_scatter_block": "recursive_halving",
+    "reduce_scatter_block": "recursive_halving|butterfly",
 }.items():
     _var.register("coll", "tuned", f"{_coll}_algorithm", "", type=str, level=3,
                   help=f"Force the {_coll} algorithm ({_algs}; empty = auto).")
+
+# segmentation / tree-shape knobs (≙ coll_tuned_*_segment_size / radix /
+# chains MCA vars). Defaults below come from the recorded host sweep in
+# TUNE_SWEEP.json (tools/coll_tune.py), not guesses.
+_var.register("coll", "tuned", "allreduce_segsize", 256 << 10, type=int,
+              level=4, help="Segment bytes for segmented-ring allreduce.")
+_var.register("coll", "tuned", "bcast_segsize", 128 << 10, type=int,
+              level=4, help="Segment bytes for pipeline/chain bcast.")
+_var.register("coll", "tuned", "bcast_chains", 4, type=int, level=4,
+              help="Number of chains for chain bcast.")
+_var.register("coll", "tuned", "bcast_knomial_radix", 4, type=int, level=4,
+              help="Radix for knomial bcast.")
 
 
 def _load_dynamic_rules():
@@ -545,13 +842,22 @@ class TunedModule(CollModule):
         if not op.commutative:
             return self.basic.allreduce(comm, send, recvbuf, op)
         nbytes = send.nbytes
-        default = ("recursive_doubling" if nbytes <= 4096 else
-                   ("ring" if nbytes <= (1 << 21) else "rabenseifner"))
+        # thresholds from the recorded sweep (TUNE_SWEEP.json, 4 ranks):
+        # rd wins ≤16K (1268µs vs ring 2122µs @16K), ring the mid band
+        # (4291µs vs rd 7360µs @256K), segmented ring the largest sizes
+        # (19.7ms vs ring 30.7ms @2M); rabenseifner never won on this host
+        # but stays selectable for multi-core deployments
+        default = ("recursive_doubling" if nbytes <= (1 << 16) else
+                   ("ring" if nbytes <= (1 << 20) else "segmented_ring"))
         alg = self._pick("allreduce", comm, nbytes, default)
         if send.size < comm.size:   # tiny vectors can't be scattered
             alg = "recursive_doubling"
         if alg == "ring":
             allreduce_ring(comm, send, recvbuf, op)
+        elif alg == "segmented_ring":
+            allreduce_segmented_ring(
+                comm, send, recvbuf, op,
+                int(_var.get("coll_tuned_allreduce_segsize", 256 << 10)))
         elif alg == "rabenseifner":
             allreduce_rabenseifner(comm, send, recvbuf, op)
         else:
@@ -563,11 +869,23 @@ class TunedModule(CollModule):
         if comm.size == 1:
             return buf
         nbytes = buf.nbytes
-        default = "binomial" if nbytes <= (1 << 16) or buf.size < comm.size \
-            else "scatter_allgather"
+        # sweep-driven (TUNE_SWEEP.json, 4 ranks): chain wins the latency
+        # regime (405µs vs binomial 715µs @64B), pipeline the bandwidth
+        # regime (12.0ms vs binomial 14.0ms @2M); scatter_allgather and
+        # binomial never won but remain selectable
+        default = "chain" if nbytes <= (1 << 13) else "pipeline"
         alg = self._pick("bcast", comm, nbytes, default)
         if alg == "scatter_allgather" and buf.size >= comm.size:
             bcast_scatter_allgather(comm, buf, root)
+        elif alg in ("pipeline", "chain"):
+            bcast_pipeline(
+                comm, buf, root,
+                int(_var.get("coll_tuned_bcast_segsize", 128 << 10)),
+                chains=1 if alg == "pipeline"
+                else int(_var.get("coll_tuned_bcast_chains", 4)))
+        elif alg == "knomial":
+            bcast_knomial(comm, buf, root,
+                          int(_var.get("coll_tuned_bcast_knomial_radix", 4)))
         else:
             bcast_binomial(comm, buf, root)
         return buf
@@ -581,7 +899,12 @@ class TunedModule(CollModule):
             recvbuf[...] = send
             return recvbuf
         if not op.commutative:
-            return self.basic.reduce(comm, send, recvbuf, op, root)
+            # in-order binary tree keeps the canonical fold order at
+            # log(p) depth (vs the linear gather fallback)
+            return reduce_inorder_binary(comm, send, recvbuf, op, root)
+        alg = self._pick("reduce", comm, send.nbytes, "binomial")
+        if alg == "inorder_binary":
+            return reduce_inorder_binary(comm, send, recvbuf, op, root)
         return reduce_binomial(comm, send, recvbuf, op, root)
 
     def allgather(self, comm, sendbuf, recvbuf=None):
@@ -593,13 +916,17 @@ class TunedModule(CollModule):
             return recvbuf
         nbytes = sendbuf.nbytes
         pof2 = (comm.size & (comm.size - 1)) == 0
+        even = comm.size % 2 == 0
         default = ("recursive_doubling" if pof2 and nbytes <= (1 << 16)
-                   else ("bruck" if nbytes <= 4096 else "ring"))
+                   else ("bruck" if nbytes <= 4096
+                         else ("neighbor_exchange" if even else "ring")))
         alg = self._pick("allgather", comm, nbytes, default)
         if alg == "recursive_doubling" and pof2:
             allgather_recursive_doubling(comm, sendbuf, recvbuf)
         elif alg == "bruck":
             allgather_bruck(comm, sendbuf, recvbuf)
+        elif alg == "neighbor_exchange" and even:
+            allgather_neighbor_exchange(comm, sendbuf, recvbuf)
         else:
             allgather_ring(comm, sendbuf, recvbuf)
         return recvbuf
@@ -629,10 +956,14 @@ class TunedModule(CollModule):
         if comm.size == 1:
             recvbuf.reshape(-1)[:] = sendbuf.reshape(-1)
             return recvbuf
-        if not op.commutative or not pof2 or \
-           sendbuf.size % comm.size != 0:
+        if not op.commutative or sendbuf.size % comm.size != 0:
             return self.basic.reduce_scatter_block(comm, sendbuf, recvbuf, op)
-        reduce_scatter_block_recursive_halving(comm, sendbuf, recvbuf, op)
+        alg = self._pick("reduce_scatter_block", comm, sendbuf.nbytes,
+                         "recursive_halving" if pof2 else "butterfly")
+        if alg == "butterfly" or not pof2:
+            reduce_scatter_block_butterfly(comm, sendbuf, recvbuf, op)
+        else:
+            reduce_scatter_block_recursive_halving(comm, sendbuf, recvbuf, op)
         return recvbuf
 
     def barrier(self, comm):

@@ -109,5 +109,19 @@ def set_process_engine(engine: ProgressEngine) -> None:
     _process_default = engine
 
 
+def adopt_engine(engine: ProgressEngine) -> None:
+    """Bind `engine` to the calling thread, and make it the process fallback
+    if only the pristine placeholder was installed so far. Called from
+    Context.__init__: a Context constructed directly (without runtime.init)
+    must still drive ITS engine from blocking waits — the placeholder has no
+    transport callbacks registered, so waiting on it deadlocks on the first
+    rendezvous (the reference never has this problem because opal_progress
+    is a process-wide singleton, opal_progress.c:216)."""
+    global _process_default
+    _tls.engine = engine
+    if _process_default is progress_engine:
+        _process_default = engine
+
+
 def progress() -> int:
     return get_engine().progress()

@@ -261,6 +261,14 @@ class P2P:
 
     def recv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, cid: int = 0,
              datatype: Optional[Datatype] = None, count: Optional[int] = None):
+        if _accel.check_addr(buf) is not None and \
+                not isinstance(buf, _accel.DeviceBuffer):
+            # a raw jax array can't be written through (immutable) and
+            # blocking recv discards the request that carries the result
+            raise TypeError(
+                "recv into a device array requires accelerator.DeviceBuffer "
+                "(jax arrays are immutable); or use irecv and read "
+                "request.result")
         return self.irecv(buf, src, tag, cid, datatype, count).wait()
 
     def sendrecv(self, sendbuf, dst: int, recvbuf, src: int,

@@ -60,6 +60,10 @@ class Context:
         self.p2p = P2P(self.bootstrap, self.layer, self.engine, spc=self.spc)
         self._comm_world = None
         self.finalized = False
+        # blocking waits on this thread must pump THIS context's engine even
+        # when the user constructs Context directly instead of runtime.init()
+        from .core.progress import adopt_engine
+        adopt_engine(self.engine)
 
     def _install_idle_hook(self, mods) -> None:
         """Wire the engine's blocking idle hook: block on the shm doorbell

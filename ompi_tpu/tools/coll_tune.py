@@ -1,0 +1,125 @@
+"""Host-collective algorithm microbench → decision-table evidence.
+
+≙ the role of OSU microbenchmarks + coll_tuned's decision tables
+(coll_tuned_decision_fixed.c:55-104): run every selectable algorithm of each
+tuned collective across a size sweep on threaded ranks, record µs per
+(collective, algorithm, bytes), and emit the winning algorithm per size so
+the fixed decision defaults in coll/tuned.py are driven by a recorded sweep
+(TUNE_SWEEP.json at the repo root), not guesses.
+
+Usage:  python -m ompi_tpu.tools.coll_tune [--ranks 4] [--iters 5]
+                                           [--out TUNE_SWEEP.json]
+
+Caveat recorded into the output: this box exposes one CPU core, so absolute
+µs include scheduler noise; the *ranking* between algorithms at a size is
+the signal (identical conditions per candidate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+ALGS = {
+    "allreduce": ["recursive_doubling", "ring", "segmented_ring",
+                  "rabenseifner"],
+    "bcast": ["binomial", "knomial", "pipeline", "chain",
+              "scatter_allgather"],
+    "allgather": ["recursive_doubling", "ring", "neighbor_exchange", "bruck"],
+    "reduce_scatter_block": ["recursive_halving", "butterfly"],
+}
+
+SIZES = [64, 1024, 16 << 10, 256 << 10, 2 << 20]
+
+
+def _run_case(coll: str, alg: str, nbytes: int, ranks: int, iters: int
+              ) -> float:
+    from ompi_tpu import runtime
+    from ompi_tpu.core import var
+
+    var.registry.set_cli(f"coll_tuned_{coll}_algorithm", alg)
+    var.registry.reset_cache()
+    count = max(ranks, nbytes // 8)
+
+    def fn(ctx):
+        c = ctx.comm_world
+        send = np.arange(count, dtype=np.float64) + c.rank
+        if coll == "bcast":
+            args = lambda: (c, send.copy() if c.rank == 0  # noqa: E731
+                            else np.zeros(count, np.float64))
+            call = lambda a: c.coll.bcast(*a)              # noqa: E731
+        elif coll == "allgather":
+            call = lambda a: c.coll.allgather(c, send)     # noqa: E731
+            args = lambda: None                            # noqa: E731
+        elif coll == "reduce_scatter_block":
+            buf = np.arange(count - count % ranks, dtype=np.float64)
+            call = lambda a: c.coll.reduce_scatter_block(c, buf)  # noqa: E731
+            args = lambda: None                            # noqa: E731
+        else:
+            call = lambda a: c.coll.allreduce(c, send)     # noqa: E731
+            args = lambda: None                            # noqa: E731
+        call(args())                      # warm transports/matching
+        c.coll.barrier(c)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            call(args())
+        c.coll.barrier(c)
+        return (time.perf_counter() - t0) / iters
+
+    try:
+        res = runtime.run_ranks(ranks, fn, timeout=120)
+        return float(np.max(res)) * 1e6
+    finally:
+        var.registry.set_cli(f"coll_tuned_{coll}_algorithm", "")
+        var.registry.reset_cache()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ranks", type=int, default=4)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--out", default="TUNE_SWEEP.json")
+    args = ap.parse_args(argv)
+
+    rows = []
+    winners: dict = {}
+    for coll, algs in ALGS.items():
+        for nbytes in SIZES:
+            best = (None, float("inf"))
+            for alg in algs:
+                if alg == "recursive_doubling" and coll == "allgather" \
+                        and args.ranks & (args.ranks - 1):
+                    continue
+                if alg == "neighbor_exchange" and args.ranks % 2:
+                    continue
+                try:
+                    us = _run_case(coll, alg, nbytes, args.ranks, args.iters)
+                except Exception as exc:   # record, keep sweeping
+                    rows.append({"coll": coll, "alg": alg, "bytes": nbytes,
+                                 "error": repr(exc)})
+                    continue
+                rows.append({"coll": coll, "alg": alg, "bytes": nbytes,
+                             "us": round(us, 1)})
+                print(f"{coll:22s} {alg:20s} {nbytes:>9d}B  {us:10.1f}us",
+                      flush=True)
+                if us < best[1]:
+                    best = (alg, us)
+            winners.setdefault(coll, {})[str(nbytes)] = best[0]
+    out = {
+        "ranks": args.ranks,
+        "iters": args.iters,
+        "note": "single-core host: rankings are the signal, not abs us",
+        "winners": winners,
+        "results": rows,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

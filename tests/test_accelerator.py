@@ -121,17 +121,20 @@ class TestDeviceP2P:
         # > eager limit → rendezvous FRAG path; small stage chunk → many D2H
         n = 300_000
         var.registry.set_override("accelerator_jax_stage_chunk", 64 << 10)
+        try:
+            def fn(ctx):
+                if ctx.rank == 0:
+                    ctx.p2p.send(jnp.arange(n, dtype=jnp.float32), dst=1)
+                    return None
+                dst = DeviceBuffer(jnp.zeros(n, dtype=jnp.float32))
+                ctx.p2p.recv(dst, src=0)
+                return np.asarray(dst.array)
 
-        def fn(ctx):
-            if ctx.rank == 0:
-                ctx.p2p.send(jnp.arange(n, dtype=jnp.float32), dst=1)
-                return None
-            dst = DeviceBuffer(jnp.zeros(n, dtype=jnp.float32))
-            ctx.p2p.recv(dst, src=0)
-            return np.asarray(dst.array)
-
-        res = runtime.run_ranks(2, fn, timeout=120)
-        np.testing.assert_array_equal(res[1], np.arange(n, dtype=np.float32))
+            res = runtime.run_ranks(2, fn, timeout=120)
+            np.testing.assert_array_equal(res[1],
+                                          np.arange(n, dtype=np.float32))
+        finally:
+            var.registry.set_override("accelerator_jax_stage_chunk", 4 << 20)
 
     def test_device_send_with_vector_datatype(self):
         dt = Datatype.vector(8, 2, 4, FLOAT32).commit()

@@ -368,3 +368,121 @@ def test_size_one_world_uses_self_component():
 
     res = runtime.run_ranks(1, fn)
     assert res[0] == ("self", 5.0)
+
+
+# ---------------------------------------------------------------------------
+# segmented / pipelined / tree-shape algorithms (round-2 additions;
+# ≙ coll_base_allreduce.c:621, coll_base_bcast.c:277/305/720,
+# coll_base_reduce.c:514, coll_base_allgather.c:456,
+# coll_base_reduce_scatter.c:691)
+# ---------------------------------------------------------------------------
+
+def _force(name, value):
+    var.registry.set_cli(name, value)
+    var.registry.reset_cache()
+
+
+@pytest.mark.parametrize("size", [3, 4])
+@pytest.mark.parametrize("count", [10_000, 37])
+def test_allreduce_segmented_ring(size, count):
+    _force("coll_tuned_allreduce_algorithm", "segmented_ring")
+    _force("coll_tuned_allreduce_segsize", "4096")   # force many segments
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(count, dtype=np.float64) * (c.rank + 1)
+            return c.coll.allreduce(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        expect = sum(np.arange(count, dtype=np.float64) * (r + 1)
+                     for r in range(size))
+        for r in res:
+            np.testing.assert_allclose(r, expect)
+    finally:
+        _force("coll_tuned_allreduce_algorithm", "")
+        _force("coll_tuned_allreduce_segsize", str(256 << 10))
+
+
+@pytest.mark.parametrize("alg", ["pipeline", "chain", "knomial"])
+@pytest.mark.parametrize("size,root", [(2, 0), (4, 1), (5, 3)])
+def test_bcast_segmented_and_knomial(alg, size, root):
+    _force("coll_tuned_bcast_algorithm", alg)
+    _force("coll_tuned_bcast_segsize", "512")        # force many segments
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            buf = (np.arange(500, dtype=np.int64) if c.rank == root
+                   else np.zeros(500, np.int64))
+            c.coll.bcast(c, buf, root=root)
+            return buf
+
+        res = runtime.run_ranks(size, fn)
+        for r in res:
+            np.testing.assert_array_equal(r, np.arange(500, dtype=np.int64))
+    finally:
+        _force("coll_tuned_bcast_algorithm", "")
+        _force("coll_tuned_bcast_segsize", str(128 << 10))
+
+
+@pytest.mark.parametrize("size", [4, 6])
+def test_allgather_neighbor_exchange(size):
+    _force("coll_tuned_allgather_algorithm", "neighbor_exchange")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.full(5, float(c.rank), np.float64)
+            return c.coll.allgather(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        expect = np.stack([np.full(5, float(r)) for r in range(size)])
+        for r in res:
+            np.testing.assert_array_equal(np.asarray(r).reshape(size, 5),
+                                          expect)
+    finally:
+        _force("coll_tuned_allgather_algorithm", "")
+
+
+@pytest.mark.parametrize("size", [3, 4, 6])
+def test_reduce_scatter_block_butterfly(size):
+    _force("coll_tuned_reduce_scatter_block_algorithm", "butterfly")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(size * 4, dtype=np.float64) * (c.rank + 1)
+            return c.coll.reduce_scatter_block(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        total = sum(np.arange(size * 4, dtype=np.float64) * (r + 1)
+                    for r in range(size))
+        for i, r in enumerate(res):
+            np.testing.assert_allclose(r, total[i * 4:(i + 1) * 4])
+    finally:
+        _force("coll_tuned_reduce_scatter_block_algorithm", "")
+
+
+@pytest.mark.parametrize("size", [2, 3, 5])
+@pytest.mark.parametrize("root", [0, 1])
+def test_reduce_inorder_binary_noncommutative(size, root):
+    """In-order binary tree must equal the canonical left-to-right fold
+    for a non-commutative op (coll_base_reduce.c:514)."""
+    matmul = ops.Op.create(
+        lambda a, b: (a.reshape(2, 2) @ b.reshape(2, 2)).reshape(-1),
+        commutative=False, name="matmul")
+
+    def fn(ctx):
+        c = world(ctx)
+        m = np.array([[1, 2 * c.rank + 1], [c.rank + 1, 1]],
+                     np.float64).reshape(-1)
+        out = np.zeros(4) if c.rank == root else None
+        return c.coll.reduce(c, m, out, op=matmul, root=root)
+
+    res = runtime.run_ranks(size, fn)
+    mats = [np.array([[1, 2 * r + 1], [r + 1, 1]], np.float64)
+            for r in range(size)]
+    expect = mats[0]
+    for m in mats[1:]:
+        expect = expect @ m
+    np.testing.assert_allclose(res[root], expect.reshape(-1))
+    for i, r in enumerate(res):
+        if i != root:
+            assert r is None

@@ -242,3 +242,44 @@ def test_truncated_rendezvous_releases_sender():
         return True
 
     assert runtime.run_ranks(2, fn, timeout=60) == [True, True]
+
+
+def test_context_usable_without_runtime_init():
+    """Context() constructed directly (no runtime.init) must bind its
+    progress engine so blocking waits pump the transports — regression for
+    a deadlock where the pristine placeholder engine was pumped instead."""
+    import threading
+
+    import numpy as np
+
+    from ompi_tpu.control.bootstrap import LocalBootstrap
+    from ompi_tpu.core.progress import set_engine
+    from ompi_tpu.runtime import Context
+
+    boots = LocalBootstrap.create_job(2, job_id="direct-ctx")
+    results = {}
+    errors = []
+
+    def body(r):
+        try:
+            ctx = Context(boots[r])
+            c = ctx.comm_world
+            buf = (np.arange(5000, dtype=np.int64) if r == 0
+                   else np.zeros(5000, np.int64))
+            c.coll.bcast(c, buf, root=0)
+            results[r] = buf.copy()
+            ctx.finalize()
+        except BaseException as exc:  # noqa: BLE001
+            errors.append((r, exc))
+        finally:
+            set_engine(None)
+
+    ts = [threading.Thread(target=body, args=(r,), daemon=True)
+          for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+        assert not t.is_alive(), "direct-Context bcast deadlocked"
+    assert not errors, errors
+    np.testing.assert_array_equal(results[1], np.arange(5000, dtype=np.int64))
