@@ -96,6 +96,31 @@ def allreduce_recursive_doubling(comm, send: np.ndarray, recv: np.ndarray,
             comm.send(recv, rank - 1, T_REDUCE)
 
 
+def _ring_bounds(n: int, size: int) -> np.ndarray:
+    """Chunk boundaries of the ring schedule (np.array_split convention:
+    the first n%size chunks get the extra element) — the ONE partitioning
+    both ring allreduce variants and their allgather phases share."""
+    base, extra = divmod(n, size)
+    sizes = np.full(size, base, np.int64)
+    sizes[:extra] += 1
+    return np.concatenate([[0], np.cumsum(sizes)])
+
+
+def _ring_allgather_phase(comm, flat: np.ndarray, bounds: np.ndarray,
+                          tag: int) -> None:
+    """The p-1 allgather rounds shared by ring and segmented-ring
+    allreduce: each step forwards the chunk received last step."""
+    size, rank = comm.size, comm.rank
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        s = (rank + 1 - step) % size
+        r = (rank - step) % size
+        inbox = np.empty(int(bounds[r + 1] - bounds[r]), flat.dtype)
+        comm.sendrecv(flat[bounds[s]:bounds[s + 1]], right, inbox, left,
+                      tag, tag)
+        flat[bounds[r]:bounds[r + 1]] = inbox
+
+
 def allreduce_ring(comm, send: np.ndarray, recv: np.ndarray, op: Op) -> None:
     """coll_base_allreduce.c:344 — reduce-scatter ring then allgather ring;
     bandwidth-optimal 2(p-1)/p·n bytes per rank. The identical neighbor-
@@ -105,30 +130,18 @@ def allreduce_ring(comm, send: np.ndarray, recv: np.ndarray, op: Op) -> None:
     if size == 1:
         return
     flat = recv.reshape(-1)
-    chunks = np.array_split(np.arange(flat.size), size)
-    right = (rank + 1) % size
-    left = (rank - 1) % size
+    bounds = _ring_bounds(flat.size, size)
+    right, left = (rank + 1) % size, (rank - 1) % size
     # reduce-scatter phase
     for step in range(size - 1):
-        send_idx = chunks[(rank - step) % size]
-        recv_idx = chunks[(rank - step - 1) % size]
-        inbox = np.empty(recv_idx.size, flat.dtype)
-        comm.sendrecv(flat[send_idx[0]:send_idx[0] + send_idx.size]
-                      if send_idx.size else flat[:0],
-                      right, inbox, left, T_REDUCE, T_REDUCE)
-        if recv_idx.size:
-            seg = flat[recv_idx[0]:recv_idx[0] + recv_idx.size]
-            seg[...] = op(inbox, seg)
-    # allgather phase
-    for step in range(size - 1):
-        send_idx = chunks[(rank + 1 - step) % size]
-        recv_idx = chunks[(rank - step) % size]
-        inbox = np.empty(recv_idx.size, flat.dtype)
-        comm.sendrecv(flat[send_idx[0]:send_idx[0] + send_idx.size]
-                      if send_idx.size else flat[:0],
-                      right, inbox, left, T_ALLGATHER, T_ALLGATHER)
-        if recv_idx.size:
-            flat[recv_idx[0]:recv_idx[0] + recv_idx.size] = inbox
+        s = (rank - step) % size
+        r = (rank - step - 1) % size
+        inbox = np.empty(int(bounds[r + 1] - bounds[r]), flat.dtype)
+        comm.sendrecv(flat[bounds[s]:bounds[s + 1]], right, inbox, left,
+                      T_REDUCE, T_REDUCE)
+        seg = flat[bounds[r]:bounds[r + 1]]
+        seg[...] = op(inbox, seg)
+    _ring_allgather_phase(comm, flat, bounds, T_ALLGATHER)
 
 
 def allreduce_rabenseifner(comm, send: np.ndarray, recv: np.ndarray,
@@ -228,7 +241,7 @@ def allreduce_segmented_ring(comm, send: np.ndarray, recv: np.ndarray,
         return
     flat = recv.reshape(-1)
     seg_items = max(1, segsize // flat.dtype.itemsize)
-    bounds = np.linspace(0, flat.size, size + 1).astype(int)
+    bounds = _ring_bounds(flat.size, size)
     right, left = (rank + 1) % size, (rank - 1) % size
 
     def spans(chunk):
@@ -261,16 +274,8 @@ def allreduce_segmented_ring(comm, send: np.ndarray, recv: np.ndarray,
                 seg[...] = op(inboxes[j], seg)
             if j in sreqs:
                 sreqs[j].wait()
-    # allgather phase (pure copy — single-segment pipelining gains nothing)
-    for step in range(size - 1):
-        s_lo, s_hi = int(bounds[(rank + 1 - step) % size]), \
-            int(bounds[(rank + 1 - step) % size + 1])
-        r_lo, r_hi = int(bounds[(rank - step) % size]), \
-            int(bounds[(rank - step) % size + 1])
-        inbox = np.empty(r_hi - r_lo, flat.dtype)
-        comm.sendrecv(flat[s_lo:s_hi], right, inbox, left,
-                      T_ALLGATHER, T_ALLGATHER)
-        flat[r_lo:r_hi] = inbox
+    # allgather phase: pure copy — single-segment pipelining gains nothing
+    _ring_allgather_phase(comm, flat, bounds, T_ALLGATHER)
 
 
 # ---------------------------------------------------------------------------

@@ -162,6 +162,19 @@ class Communicator:
         wsrc = src if src == ANY_SOURCE else self._world_dst(src)
         self._ft_check(tag, None if src == ANY_SOURCE else wsrc)
         req = self.ctx.p2p.irecv(buf, wsrc, tag, self.cid, **kw)
+        if src == ANY_SOURCE and (tag >= 0 or tag == ANY_TAG) \
+                and getattr(self.ctx, "failed", None):
+            # ULFM: an ANY_SOURCE recv posted while the comm has UN-ACKED
+            # failed members reports PROC_FAILED_PENDING immediately (not
+            # only recvs pending at detection time) — it stays posted and
+            # completes from survivors after failure_ack. The `failed`
+            # guard keeps the no-failure fast path free of set building.
+            unacked = (set(self.ctx.failed)
+                       & set(self._peer_group().world_ranks)
+                       ) - getattr(self, "_ft_acked", set())
+            if unacked:
+                from .ft.ulfm import ProcFailedPendingError
+                req.set_pending(ProcFailedPendingError(min(unacked)))
 
         def fix_source(r):
             if r.status.source >= 0:
@@ -174,13 +187,31 @@ class Communicator:
         self.isend(buf, dst, tag, **kw).wait()
 
     def recv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, **kw):
-        return self.irecv(buf, src, tag, **kw).wait()
+        req = self.irecv(buf, src, tag, **kw)
+        try:
+            return req.wait()
+        except Exception as exc:
+            from .ft.ulfm import ProcFailedError, ProcFailedPendingError
+            if isinstance(exc, ProcFailedPendingError):
+                # blocking recv has no request handle to resume — withdraw
+                # the post (no zombie matching a later message) and
+                # fail-stop, like the reference's blocking ANY_SOURCE path
+                self.ctx.p2p.cancel_recv(req)
+                raise ProcFailedError(exc.rank) from None
+            raise
 
     def sendrecv(self, sendbuf, dst: int, recvbuf, src: int,
                  sendtag: int = 0, recvtag: int = ANY_TAG):
         rreq = self.irecv(recvbuf, src, recvtag)
         sreq = self.isend(sendbuf, dst, sendtag)
-        st = rreq.wait()
+        try:
+            st = rreq.wait()
+        except Exception as exc:
+            from .ft.ulfm import ProcFailedError, ProcFailedPendingError
+            if isinstance(exc, ProcFailedPendingError):
+                self.ctx.p2p.cancel_recv(rreq)   # blocking: no handle kept
+                raise ProcFailedError(exc.rank) from None
+            raise
         sreq.wait()
         return st
 

@@ -82,6 +82,11 @@ def main(argv: List[str] | None = None) -> int:
     ap.add_argument("--device-plane", choices=["none", "cpu"], default="none",
                     help="'cpu' gives each rank one virtual CPU device "
                          "(multi-process test fabric)")
+    ap.add_argument("--enable-recovery", action="store_true",
+                    help="ULFM mode (≙ prte --enable-recovery): a failed "
+                         "rank does NOT take the job down; survivors run "
+                         "detector/revoke/shrink recovery. Job exit code is "
+                         "0 if any rank exits 0.")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and args (a python script or executable)")
     args = ap.parse_args(argv)
@@ -119,6 +124,7 @@ def main(argv: List[str] | None = None) -> int:
                     pass
 
     exit_code = 0
+    timed_out = False
     try:
         remaining = list(procs)
         import time
@@ -132,9 +138,10 @@ def main(argv: List[str] | None = None) -> int:
                 remaining.remove(p)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
-                    # a failed rank takes the job down, like mpirun
-                    kill_all()
-                    term_at = time.monotonic()
+                    if not args.enable_recovery:
+                        # a failed rank takes the job down, like mpirun
+                        kill_all()
+                        term_at = time.monotonic()
             if term_at is not None and time.monotonic() - term_at > 5.0:
                 # a rank ignored SIGTERM (e.g. wedged in a native collective
                 # init) — escalate so the job always terminates
@@ -143,6 +150,7 @@ def main(argv: List[str] | None = None) -> int:
             if deadline is not None and time.monotonic() > deadline:
                 print("tpurun: timeout — killing job", file=sys.stderr)
                 kill_all(signal.SIGKILL)
+                timed_out = True
                 exit_code = exit_code or 124
                 break
             time.sleep(0.02)
@@ -151,6 +159,9 @@ def main(argv: List[str] | None = None) -> int:
         exit_code = 130
     finally:
         coord.close()
+    if args.enable_recovery and not timed_out and exit_code != 130 \
+            and any(p.returncode == 0 for p in procs):
+        exit_code = 0          # survivors recovered; that IS success
     return exit_code
 
 

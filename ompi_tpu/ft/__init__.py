@@ -17,10 +17,13 @@ revoke → shrink → rebuild mesh from survivors → restore.
 
 from .ulfm import (  # noqa: F401
     ProcFailedError,
+    ProcFailedPendingError,
     RevokedError,
     agree,
     enable,
     failed_ranks,
+    failure_ack,
+    failure_get_acked,
     revoke,
     shrink,
     simulate_failure,
@@ -28,7 +31,7 @@ from .ulfm import (  # noqa: F401
 from .detector import FailureDetector  # noqa: F401
 
 __all__ = [
-    "ProcFailedError", "RevokedError", "FailureDetector",
-    "enable", "revoke", "shrink", "agree", "failed_ranks",
-    "simulate_failure",
+    "ProcFailedError", "ProcFailedPendingError", "RevokedError",
+    "FailureDetector", "enable", "revoke", "shrink", "agree", "failed_ranks",
+    "failure_ack", "failure_get_acked", "simulate_failure",
 ]

@@ -24,6 +24,18 @@ class ProcFailedError(RuntimeError):
         self.rank = rank
 
 
+class ProcFailedPendingError(RuntimeError):
+    """≙ MPIX_ERR_PROC_FAILED_PENDING: an ANY_SOURCE receive was interrupted
+    by a peer failure but REMAINS active — after ``failure_ack`` it can
+    still complete from a surviving sender (docs/features/ulfm.rst:20-60)."""
+
+    def __init__(self, rank: int) -> None:
+        super().__init__(
+            f"ANY_SOURCE receive interrupted: rank {rank} failed "
+            f"(request still active; failure_ack() to resume)")
+        self.rank = rank
+
+
 class RevokedError(RuntimeError):
     """≙ MPIX_ERR_REVOKED."""
 
@@ -92,16 +104,36 @@ def revoke(comm) -> None:
 # -- failure interaction with pending communication -------------------------
 
 def _fail_pending_recvs(ctx, failed_rank: int) -> None:
-    """Complete posted receives naming the failed rank — and ANY_SOURCE
-    receives on every communicator containing it — with ProcFailedError
-    (ULFM: ops involving a failed process must not hang; the reference
-    reports ANY_SOURCE as MPIX_ERR_PROC_FAILED_PENDING and lets the recv
-    stay posted — here it fail-stops, documented simplification)."""
+    """Complete posted receives naming the failed rank with ProcFailedError
+    (ULFM: ops involving a failed process must not hang). ANY_SOURCE
+    receives on communicators containing the failed rank get
+    MPIX_ERR_PROC_FAILED_PENDING semantics instead: the wait raises
+    ProcFailedPendingError once but the receive stays posted, and after
+    ``failure_ack`` it completes normally from surviving senders — matching
+    the reference (docs/features/ulfm.rst:20-60). Already-acked failures
+    don't re-interrupt."""
     comms = getattr(ctx, "_ft_comms", {})
-    cids = frozenset(cid for cid, c in comms.items()
-                     if failed_rank in c.group.world_ranks)
-    ctx.p2p.matching.fail_src(failed_rank, ProcFailedError(failed_rank),
-                              any_source_cids=cids)
+    cids = frozenset(
+        cid for cid, c in comms.items()
+        if failed_rank in c.group.world_ranks
+        and failed_rank not in getattr(c, "_ft_acked", set()))
+    ctx.p2p.matching.fail_src(
+        failed_rank, ProcFailedError(failed_rank), any_source_cids=cids,
+        pending_err=ProcFailedPendingError(failed_rank))
+
+
+def failure_ack(comm) -> None:
+    """MPIX_Comm_failure_ack: acknowledge all currently-known failures on
+    this communicator; ANY_SOURCE receives are no longer interrupted by
+    (and won't re-report) the acknowledged failures."""
+    comm._ft_acked = set(failed_ranks(comm.ctx))
+
+
+def failure_get_acked(comm):
+    """MPIX_Comm_failure_get_acked: the group of acknowledged failed
+    ranks."""
+    from ..comm import Group
+    return Group(sorted(getattr(comm, "_ft_acked", set())))
 
 
 def check_peer(ctx, world_rank: int) -> None:

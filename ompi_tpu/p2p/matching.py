@@ -80,19 +80,25 @@ class MatchingEngine:
         return p
 
     def fail_src(self, src: int, err: Exception,
-                 any_source_cids=frozenset()) -> None:
+                 any_source_cids=frozenset(),
+                 pending_err: Exception | None = None) -> None:
         """Complete every posted receive naming ``src`` with ``err`` (ULFM:
-        operations on a failed peer must not hang). ANY_SOURCE receives are
-        failed too, on the communicators listed in ``any_source_cids`` (those
-        whose group contains the failed rank — computed by the caller, which
-        knows the cid→comm map)."""
+        operations on a failed peer must not hang). ANY_SOURCE receives on
+        the communicators in ``any_source_cids`` (those whose group contains
+        the failed rank, minus already-acked failures — computed by the
+        caller, which knows the cid→comm map) are NOT completed: they get
+        ``pending_err`` as a one-shot MPIX_ERR_PROC_FAILED_PENDING and stay
+        posted, still able to match survivors' messages after
+        failure_ack."""
         for cid, lst in self._posted.items():
-            hit = [p for p in lst if p.src == src
-                   or (p.src == ANY_SOURCE and cid in any_source_cids)]
-            for p in hit:
+            for p in [p for p in lst if p.src == src]:
                 lst.remove(p)
                 if p.req is not None:
                     p.req.complete(err)
+            if cid in any_source_cids:
+                for p in lst:
+                    if p.src == ANY_SOURCE and p.req is not None:
+                        p.req.set_pending(pending_err or err)
 
     def cancel(self, cid: int, posted: Posted) -> bool:
         lst = self._posted.get(cid, [])

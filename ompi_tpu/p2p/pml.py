@@ -255,9 +255,25 @@ class P2P:
                                 {"k": "ack", "sreq": u.header["sreq"],
                                  "rreq": rreq}, b"")
 
-        if self.matching.post_recv(cid, src, tag, on_match, req=req) is None:
+        posted = self.matching.post_recv(cid, src, tag, on_match, req=req)
+        if posted is None:
             self.spc.inc("matches_unexpected")
+        else:
+            req._posted_ref = (self.matching, cid, posted)
         return req
+
+    def cancel_recv(self, req: Request) -> bool:
+        """Withdraw a still-posted receive (MPI_Cancel for recvs; used by
+        blocking ANY_SOURCE recv to avoid leaking a zombie post when it
+        converts PROC_FAILED_PENDING to fail-stop)."""
+        ref = req._posted_ref
+        if ref is None or req.done:
+            return False
+        matching, cid, posted = ref
+        ok = matching.cancel(cid, posted)
+        if ok:
+            req.status.cancelled = True
+        return ok
 
     def recv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG, cid: int = 0,
              datatype: Optional[Datatype] = None, count: Optional[int] = None):

@@ -24,7 +24,8 @@ class Status:
 class Request:
     """A pending communication. Completion is driven by the progress engine."""
 
-    __slots__ = ("done", "status", "error", "result", "_on_complete", "_ctx")
+    __slots__ = ("done", "status", "error", "result", "_on_complete", "_ctx",
+                 "pending_error", "_posted_ref")
 
     def __init__(self) -> None:
         self.done = False
@@ -33,6 +34,15 @@ class Request:
         self.result: Any = None       # collective/value-carrying completions
         self._on_complete: List[Callable[["Request"], None]] = []
         self._ctx: Any = None
+        self._posted_ref: Any = None  # (matching, cid, Posted) while queued
+        # ULFM MPIX_ERR_PROC_FAILED_PENDING: raised once by wait/test while
+        # the request STAYS active (an ANY_SOURCE recv interrupted by a peer
+        # failure can still complete from survivors after failure_ack)
+        self.pending_error: Optional[Exception] = None
+
+    def set_pending(self, err: Exception) -> None:
+        if not self.done:
+            self.pending_error = err
 
     def add_completion_callback(self, cb: Callable[["Request"], None]) -> None:
         if self.done:
@@ -52,10 +62,20 @@ class Request:
     def test(self) -> bool:
         if not self.done:
             get_engine().progress()
+        if not self.done and self.pending_error is not None:
+            err, self.pending_error = self.pending_error, None
+            raise err
         return self.done
 
     def wait(self, timeout: Optional[float] = None) -> Status:
-        get_engine().wait_until(lambda: self.done, timeout=timeout)
+        get_engine().wait_until(
+            lambda: self.done or self.pending_error is not None,
+            timeout=timeout)
+        if not self.done and self.pending_error is not None:
+            # request remains active; the caller acks the failure and may
+            # wait again (ULFM PROC_FAILED_PENDING discipline)
+            err, self.pending_error = self.pending_error, None
+            raise err
         if not self.done:
             raise TimeoutError("request did not complete")
         if self.error is not None:
@@ -71,10 +91,20 @@ class CompletedRequest(Request):
         self.result = result
 
 
+def _settled(r: Request) -> bool:
+    return r.done or r.pending_error is not None
+
+
 def wait_all(requests: List[Request], timeout: Optional[float] = None) -> List[Status]:
-    get_engine().wait_until(lambda: all(r.done for r in requests), timeout=timeout)
+    get_engine().wait_until(lambda: all(_settled(r) for r in requests),
+                            timeout=timeout)
     out = []
     for r in requests:
+        if not r.done and r.pending_error is not None:
+            # PROC_FAILED_PENDING must surface here too — an ANY_SOURCE recv
+            # interrupted by a peer failure would otherwise hang waitall
+            err, r.pending_error = r.pending_error, None
+            raise err
         if not r.done:
             raise TimeoutError("waitall: request did not complete")
         if r.error is not None:
@@ -84,10 +114,15 @@ def wait_all(requests: List[Request], timeout: Optional[float] = None) -> List[S
 
 
 def wait_any(requests: List[Request], timeout: Optional[float] = None) -> int:
-    get_engine().wait_until(lambda: any(r.done for r in requests), timeout=timeout)
+    get_engine().wait_until(lambda: any(_settled(r) for r in requests),
+                            timeout=timeout)
     for i, r in enumerate(requests):
         if r.done:
             if r.error is not None:
                 raise r.error
             return i
+    for r in requests:
+        if r.pending_error is not None:
+            err, r.pending_error = r.pending_error, None
+            raise err
     raise TimeoutError("waitany: no request completed")

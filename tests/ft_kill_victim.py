@@ -1,0 +1,78 @@
+"""Real-process ULFM recovery workload, launched by test_ft_real_kill via
+``tpurun --enable-recovery``: rank VICTIM SIGKILLs itself mid-job (a real
+dead process: closed sockets, stale shm rings — not a simulate_failure
+monkeypatch); survivors must detect, see PROC_FAILED_PENDING on an
+ANY_SOURCE recv, ack, shrink, and run a collective on the shrunken
+communicator (≙ the reference's ULFM example recipe,
+docs/features/ulfm.rst:20-60)."""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+from ompi_tpu import ft, runtime
+
+VICTIM = 2
+
+
+def main() -> int:
+    ctx = runtime.init()
+    ft.enable(ctx)
+    comm = ctx.comm_world
+    comm.barrier()
+    if ctx.rank == VICTIM:
+        os.kill(os.getpid(), signal.SIGKILL)    # a REAL dead process
+
+    # survivors: detector must flood the failure
+    deadline = time.monotonic() + 30
+    while VICTIM not in ft.failed_ranks(ctx):
+        ctx.engine.progress()
+        if time.monotonic() > deadline:
+            print(f"rank {ctx.rank}: DETECT-TIMEOUT", flush=True)
+            return 2
+    print(f"rank {ctx.rank}: detected", flush=True)
+
+    # pending-recv semantics against the real corpse
+    if ctx.rank == 0:
+        from ompi_tpu.p2p import ANY_SOURCE
+        buf = np.zeros(4)
+        req = comm.irecv(buf, src=ANY_SOURCE, tag=5)
+        try:
+            req.wait(timeout=15)
+            print("rank 0: NO-PENDING-ERROR", flush=True)
+            return 3
+        except ft.ProcFailedPendingError:
+            pass
+        ft.failure_ack(comm)
+        try:
+            # named recv from the corpse fail-stops (at post or completion)
+            comm.irecv(np.zeros(1), src=VICTIM, tag=6).wait(timeout=15)
+            print("rank 0: DEAD-RECV-COMPLETED", flush=True)
+            return 4
+        except ft.ProcFailedError:
+            pass
+        st = req.wait(timeout=30)   # survivor completes the pending recv
+        assert st.source == 1, st.source
+        assert (buf == 5.0).all()
+    elif ctx.rank == 1:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 2.0:
+            ctx.engine.progress()
+        comm.send(np.full(4, 5.0), 0, 5)
+
+    # uniform recovery: shrink + collective over survivors
+    shrunk = ft.shrink(comm)
+    assert VICTIM not in shrunk.group.world_ranks
+    out = shrunk.coll.allreduce(shrunk, np.ones(2))
+    assert out[0] == shrunk.size == 3, (out, shrunk.size)
+    print(f"rank {ctx.rank}: SHRINK-OK size={shrunk.size}", flush=True)
+    # no finalize: the world fence would wait on the corpse; exiting after
+    # successful shrunken-communicator work is the ULFM recipe's endpoint
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

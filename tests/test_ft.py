@@ -145,9 +145,10 @@ def test_shrink_and_continue():
     assert [r for r in res if r is not None] == [expect] * 3
 
 
-def test_any_source_recv_fails_on_peer_death():
-    """ULFM: an ANY_SOURCE receive must not hang when a member of the
-    communicator dies (simplified here to fail-stop completion)."""
+def test_any_source_recv_pending_then_completes_after_ack():
+    """ULFM PROC_FAILED_PENDING: an ANY_SOURCE receive interrupted by a
+    peer failure raises once, STAYS posted, and after failure_ack it still
+    completes from a surviving sender (docs/features/ulfm.rst:20-60)."""
     def body(ctx):
         ft.enable(ctx)
         comm = ctx.comm_world
@@ -157,11 +158,26 @@ def test_any_source_recv_fails_on_peer_death():
             time.sleep(1.5)
             return True
         from ompi_tpu.p2p import ANY_SOURCE
-        req = comm.irecv(np.zeros(4), src=ANY_SOURCE, tag=9)
-        with pytest.raises(ft.ProcFailedError):
-            req.wait(timeout=10)
+        if ctx.rank == 0:
+            buf = np.zeros(4)
+            req = comm.irecv(buf, src=ANY_SOURCE, tag=9)
+            with pytest.raises(ft.ProcFailedPendingError):
+                req.wait(timeout=10)
+            assert not req.done          # still active
+            ft.failure_ack(comm)
+            assert 1 in ft.failure_get_acked(comm).world_ranks
+            st = req.wait(timeout=20)    # survivor's message completes it
+            assert st.source == 2
+            np.testing.assert_array_equal(buf, np.full(4, 7.0))
+        if ctx.rank == 2:
+            # keep progressing (heartbeats!) until well after rank 0 saw the
+            # pending error, then send the completing message
+            deadline = time.monotonic() + 3.0
+            while time.monotonic() < deadline:
+                ctx.engine.progress()
+            comm.send(np.full(4, 7.0), 0, 9)
         return True
-    assert all(runtime.run_ranks(2, body, timeout=60))
+    assert all(runtime.run_ranks(3, body, timeout=60))
 
 
 def test_agree_uniform_with_mid_operation_failure():
@@ -183,3 +199,30 @@ def test_agree_uniform_with_mid_operation_failure():
     res = runtime.run_ranks(4, body, timeout=90)
     vals = [r for r in res if r is not None]
     assert len(set(vals)) == 1, f"non-uniform agreement: {res}"
+
+
+def test_ft_real_kill_under_tpurun():
+    """Kill a REAL process (SIGKILL, not simulate_failure) under
+    ``tpurun --enable-recovery``: survivors must detect the corpse, get
+    PROC_FAILED_PENDING on ANY_SOURCE, fail-stop named recvs from it,
+    shrink, and complete a collective on the survivor communicator
+    (≙ the reference's mpirun-level ULFM testing; comm_ft_detector.c)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["OMPI_TPU_ft_detector_period"] = "0.1"
+    # generous timeout: 4 procs share ONE core here; scheduling gaps beyond
+    # a tight timeout would falsely accuse busy survivors
+    env["OMPI_TPU_ft_detector_timeout"] = "3.0"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
+         "--enable-recovery", "--timeout", "120",
+         os.path.join(repo, "tests", "ft_kill_victim.py")],
+        capture_output=True, text=True, env=env, timeout=180)
+    out = proc.stdout + proc.stderr
+    assert out.count("SHRINK-OK size=3") == 3, out
+    assert proc.returncode == 0, (proc.returncode, out)
