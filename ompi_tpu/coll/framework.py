@@ -71,6 +71,13 @@ class CollTable:
                     spc.inc("collectives")
                     if name == "barrier":
                         spc.inc("barriers")
+                from .. import monitoring
+                if getattr(comm.ctx, "_monitor", None) is not None \
+                        or monitoring._hooks:
+                    # coll interposition (≙ coll/monitoring component);
+                    # PMPI-analog hooks fire even without an installed
+                    # Monitor, matching the osc events' gating
+                    monitoring.coll_event(comm, name, a[0] if a else None)
                 return fn(comm, *a, **kw)
 
             return counted

@@ -1,0 +1,238 @@
+"""Monitoring interposition + PMPI-style profiling hooks.
+
+≙ two reference subsystems:
+  * the monitoring components (pml/coll/osc ``monitoring`` wrapping the real
+    module, recording per-peer message counts/sizes split by traffic class,
+    with a communication-matrix dump — ompi/mca/common/monitoring/
+    common_monitoring.h:57,105 and profile2mat.pl);
+  * the PMPI profiling layer (every MPI binding weak-symbol shadowed so a
+    tool can interpose — docs/features/profiling.rst). Pythonically that is
+    a hook registry: a tool registers a callable and receives one event dict
+    per intercepted call (pre/post with wall time), no subclassing needed.
+
+Interposition is dynamic, like the reference's component stacking: to
+``install(ctx)`` we wrap the live pml entry points (bound-method
+interposition — the Python analog of pml/monitoring sitting above ob1);
+coll and osc entry points report through ``ctx._monitor`` from their
+dispatch layers. ``uninstall`` restores the original methods.
+
+Usage:
+    mon = monitoring.install(ctx)
+    ... run ...
+    print(mon.dump(ctx.rank))              # per-rank class matrices
+    mat = monitoring.gather_matrix(comm)   # full p x p bytes matrix
+    monitoring.profile_register(tool_fn)   # PMPI-analog interposition
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .core import var as _var
+
+_var.register("monitoring", "", "output", "", type=str, level=3,
+              help="Path prefix: at finalize each rank writes its monitoring "
+                   "matrices to <prefix>.<rank>.json (≙ the monitoring "
+                   "component's dump + profile2mat input).")
+
+# -- PMPI-analog profiling hooks (process-wide, tool-facing) ----------------
+
+_hooks: List[Callable[[dict], None]] = []
+
+
+def profile_register(fn: Callable[[dict], None]) -> None:
+    """Register a tool callback; it receives {'api','phase','peer','bytes',
+    'comm','t'} events for every intercepted call (PMPI interposition
+    analog)."""
+    if fn not in _hooks:
+        _hooks.append(fn)
+
+
+def profile_unregister(fn: Callable[[dict], None]) -> None:
+    if fn in _hooks:
+        _hooks.remove(fn)
+
+
+def _emit(event: dict) -> None:
+    for fn in _hooks:
+        try:
+            fn(event)
+        except Exception:
+            pass                       # a broken tool must not break MPI
+
+
+# -- the per-context monitor ------------------------------------------------
+
+CLASSES = ("pt2pt_tx", "pt2pt_rx", "coll", "osc")
+
+
+class Monitor:
+    """Per-rank traffic recorder split by class (common_monitoring.h:105
+    keeps distinct pml/coll/osc counts for the same peer). Point-to-point
+    accounting is NOT duplicated here: it reuses the spc peer matrix
+    (spc.peer_traffic already counts every isend/irecv by direction); this
+    class adds the coll/osc classes and the dump formats on top."""
+
+    def __init__(self, spc) -> None:
+        self._spc = spc
+        # class -> peer -> [msgs, bytes]   (coll/osc only; pt2pt from spc)
+        self.extra: Dict[str, Dict[int, List[int]]] = {
+            c: defaultdict(lambda: [0, 0]) for c in ("coll", "osc")}
+        self.coll_ops: Dict[str, int] = defaultdict(int)
+
+    @property
+    def peers(self) -> Dict[str, Dict[int, List[int]]]:
+        """All four class matrices; pt2pt_tx/rx come from spc (row=sender
+        semantics: tx is what THIS rank sent)."""
+        spc_m = self._spc.matrix()
+        out = {"pt2pt_tx": {p: [m, b] for p, (m, b) in spc_m["tx"].items()},
+               "pt2pt_rx": {p: [m, b] for p, (m, b) in spc_m["rx"].items()}}
+        out.update(self.extra)
+        return out
+
+    def record(self, cls: str, peer: int, nbytes: int) -> None:
+        cell = self.extra[cls][int(peer)]
+        cell[0] += 1
+        cell[1] += int(nbytes)
+
+    def record_coll(self, name: str, comm, nbytes: int) -> None:
+        self.coll_ops[name] += 1
+        # collective traffic is attributed to every peer in the comm, the
+        # monitoring component's convention for matrix purposes
+        for w in comm.group.world_ranks:
+            if w != comm.ctx.rank:
+                self.record("coll", w, nbytes)
+
+    # -- output -------------------------------------------------------------
+
+    def as_dict(self) -> dict:
+        return {
+            "classes": {c: {str(p): list(v) for p, v in m.items()}
+                        for c, m in self.peers.items()},
+            "coll_ops": dict(self.coll_ops),
+        }
+
+    def dump(self, rank: int) -> str:
+        lines = [f"monitoring (rank {rank}): class peer msgs bytes"]
+        for c in CLASSES:
+            for p, (m, b) in sorted(self.peers[c].items()):
+                lines.append(f"  {c:8s} {p:4d} {m:8d} {b:12d}")
+        if self.coll_ops:
+            lines.append("  collectives: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(self.coll_ops.items())))
+        return "\n".join(lines)
+
+    def save(self, prefix: str, rank: int) -> str:
+        path = f"{prefix}.{rank}.json"
+        with open(path, "w") as fh:
+            json.dump(self.as_dict(), fh, indent=1)
+        return path
+
+
+def install(ctx) -> Monitor:
+    """Interpose on the context's pml (and make coll/osc report): the
+    dynamic analog of loading the monitoring components. Idempotent.
+    pt2pt counting flows through the existing spc peer matrix (switched on
+    here); the bound-method wrappers exist only to feed PMPI-analog hook
+    events, passing every argument through untouched."""
+    mon = getattr(ctx, "_monitor", None)
+    if mon is not None:
+        return mon
+    ctx.spc.monitoring = True              # spc records the peer matrix
+    mon = Monitor(ctx.spc)
+    ctx._monitor = mon
+    p2p = ctx.p2p
+    orig_isend, orig_irecv = p2p.isend, p2p.irecv
+    ctx._monitor_orig = (orig_isend, orig_irecv)
+
+    def isend(buf, dst, *a, **kw):
+        if _hooks:
+            _emit({"api": "isend", "phase": "pre", "peer": dst,
+                   "bytes": int(getattr(buf, "nbytes", 0) or 0),
+                   "comm": a[1] if len(a) > 1 else kw.get("cid", 0),
+                   "t": time.monotonic()})
+        req = orig_isend(buf, dst, *a, **kw)
+        if _hooks:
+            _emit({"api": "isend", "phase": "post", "peer": dst,
+                   "bytes": req.status.count,
+                   "comm": a[1] if len(a) > 1 else kw.get("cid", 0),
+                   "t": time.monotonic()})
+        return req
+
+    def irecv(buf, src=-1, *a, **kw):
+        if not _hooks:
+            return orig_irecv(buf, src, *a, **kw)
+        cid = a[1] if len(a) > 1 else kw.get("cid", 0)
+        _emit({"api": "irecv", "phase": "pre", "peer": src, "bytes": 0,
+               "comm": cid, "t": time.monotonic()})
+        req = orig_irecv(buf, src, *a, **kw)
+
+        def done(r):
+            _emit({"api": "irecv", "phase": "post",
+                   "peer": r.status.source, "bytes": r.status.count,
+                   "comm": cid, "t": time.monotonic()})
+        req.add_completion_callback(done)
+        return req
+
+    p2p.isend, p2p.irecv = isend, irecv
+    return mon
+
+
+def uninstall(ctx) -> None:
+    orig = getattr(ctx, "_monitor_orig", None)
+    if orig is not None:
+        ctx.p2p.isend, ctx.p2p.irecv = orig
+        del ctx._monitor_orig
+    if getattr(ctx, "_monitor", None) is not None:
+        del ctx._monitor
+
+
+def coll_event(comm, name: str, sendbuf) -> None:
+    """Called from the coll dispatch layer for every collective start."""
+    mon = getattr(comm.ctx, "_monitor", None)
+    nbytes = int(getattr(sendbuf, "nbytes", 0) or 0)
+    if mon is not None:
+        mon.record_coll(name, comm, nbytes)
+    if _hooks:
+        _emit({"api": name, "phase": "pre", "peer": -1, "bytes": nbytes,
+               "comm": comm.cid, "t": time.monotonic()})
+
+
+def osc_event(ctx, op: str, target: int, nbytes: int) -> None:
+    """Called from the osc layer for put/get/accumulate."""
+    mon = getattr(ctx, "_monitor", None)
+    if mon is not None:
+        mon.record("osc", target, nbytes)
+    if _hooks:
+        _emit({"api": op, "phase": "pre", "peer": target, "bytes": nbytes,
+               "comm": -1, "t": time.monotonic()})
+
+
+def gather_matrix(comm, cls: str = "pt2pt_tx") -> Optional[np.ndarray]:
+    """Collective: assemble the full size x size bytes matrix of ``cls``
+    traffic (row = sender, so the per-rank contribution is its OWN tx/osc
+    row) on every rank — the profile2mat.pl output, computed in-band."""
+    mon = getattr(comm.ctx, "_monitor", None)
+    if mon is None:
+        return None
+    row = np.zeros(comm.size, np.int64)
+    g = comm.group
+    for peer, (_m, b) in mon.peers[cls].items():
+        r = g.rank_of_world(peer)
+        if r >= 0:
+            row[r] = b
+    return np.asarray(comm.coll.allgather(comm, row)).reshape(
+        comm.size, comm.size)
+
+
+def finalize_dump(ctx) -> None:
+    """Write matrices at finalize when monitoring_output is set."""
+    mon = getattr(ctx, "_monitor", None)
+    prefix = _var.get("monitoring_output", "")
+    if mon is not None and prefix:
+        mon.save(prefix, ctx.rank)

@@ -153,6 +153,9 @@ class Window:
         oreq = self.eng.next_oreq(req)
         h = {"k": "put", "win": self.win_id, "disp": int(target_disp),
              "dt": a.dtype.str, "shape": list(a.shape), "oreq": oreq}
+        from .. import monitoring
+        monitoring.osc_event(self.comm.ctx, "put",
+                             self._target_world(target_rank), a.nbytes)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
                                  h, a.tobytes())
         return self._track(target_rank, req)
@@ -167,6 +170,9 @@ class Window:
         oreq = self.eng.next_oreq(req, sink=land)
         h = {"k": "get", "win": self.win_id, "disp": int(target_disp),
              "dt": origin.dtype.str, "count": int(origin.size), "oreq": oreq}
+        from .. import monitoring
+        monitoring.osc_event(self.comm.ctx, "get",
+                             self._target_world(target_rank), origin.nbytes)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
                                  h, b"")
         return self._track(target_rank, req)
@@ -179,6 +185,9 @@ class Window:
         h = {"k": "acc", "win": self.win_id, "disp": int(target_disp),
              "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
              "oreq": oreq}
+        from .. import monitoring
+        monitoring.osc_event(self.comm.ctx, "accumulate",
+                             self._target_world(target_rank), a.nbytes)
         if op.name not in _OPS:
             register_op(op)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,

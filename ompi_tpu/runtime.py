@@ -100,6 +100,9 @@ class Context:
         self.spc._v["time_in_wait"] = self.engine.time_waiting
         if _var.get("spc_dump_enabled", False):
             self.spc.dump(self.rank)
+        if getattr(self, "_monitor", None) is not None:
+            from . import monitoring
+            monitoring.finalize_dump(self)
         # Drain transports before fencing: frames parked when a ring/socket
         # was full (e.g. shm's _pending queue) must reach the wire, or a
         # peer still blocked in recv never completes. The reference runs
