@@ -1,0 +1,290 @@
+"""OSHMEM-lite: an OpenSHMEM-style PGAS facade over the runtime + osc.
+
+≙ the reference's OSHMEM project (oshmem/, SURVEY.md §2.5): the API layer
+(oshmem/shmem/, 172 C files) reduced to its families — init lifecycle,
+symmetric heap, put/get RMA, atomics, ordering (fence/quiet/barrier), p2p
+synchronization (wait_until), and SHMEM collectives — mapped onto this
+stack the same way OSHMEM maps onto OMPI:
+
+  * ``init`` reuses the MPI-side runtime exactly as ``shmem_init`` calls
+    ``ompi_mpi_init(reinit_ok=true)`` (oshmem/runtime/oshmem_shmem_init.c:134);
+  * the symmetric heap (≙ memheap framework) is a collective allocator:
+    every PE calls ``smalloc`` in the same order, so allocation i refers to
+    the same window on every PE — backing each allocation with an osc
+    Window gives put/get/atomics the AM-RDMA path (≙ spml over ucx);
+  * SHMEM collectives (≙ scoll framework) delegate to the coll framework,
+    the same trick as scoll/mpi;
+  * ``quiet`` flushes outstanding RMA (≙ spml quiet), ``fence`` is ordering
+    only (our transports deliver in order per peer, so it is quiet-lite);
+  * ``wait_until`` polls local symmetric memory under the progress engine.
+
+TPU-first note: symmetric arrays are host mirrors; device-resident data
+moves through the accelerator framework / device plane as usual — the PGAS
+facade is the control-scale API, like everything host-side here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.progress import get_engine
+from ..op import MAX, MIN, PROD, SUM, Op
+from ..osc.window import Window
+from ..p2p.request import Request
+
+_tls = threading.local()
+
+
+class _PEState:
+    def __init__(self, ctx) -> None:
+        self.ctx = ctx
+        self.comm = ctx.comm_world
+        self.heap: List["SymmetricArray"] = []     # allocation order = id
+        self.pending: List[Request] = []           # outstanding RMA (quiet)
+
+
+def _state() -> _PEState:
+    st = getattr(_tls, "shmem", None)
+    if st is None or st.ctx.finalized:
+        raise RuntimeError("shmem not initialized — call shmem.init()")
+    return st
+
+
+# -- lifecycle (≙ oshmem/runtime) -------------------------------------------
+
+def init(ctx=None) -> None:
+    """shmem_init: bring up (or reuse) the runtime, exactly the reference's
+    reinit-ok path (ompi_mpi_init.c:330-340)."""
+    from .. import runtime
+    if ctx is None:
+        ctx = runtime.init()
+    _tls.shmem = _PEState(ctx)
+
+
+def finalize() -> None:
+    st = getattr(_tls, "shmem", None)
+    if st is None:
+        return
+    quiet()
+    barrier_all()
+    for arr in st.heap:
+        if arr is not None and arr._win is not None:   # sfree leaves Nones
+            arr._win.free()
+            arr._win = None
+    _tls.shmem = None
+
+
+def my_pe() -> int:
+    return _state().comm.rank
+
+
+def n_pes() -> int:
+    return _state().comm.size
+
+
+def pe_accessible(pe: int) -> bool:
+    st = _state()
+    return 0 <= pe < st.comm.size and \
+        pe not in getattr(st.ctx, "failed", set())
+
+
+# -- symmetric heap (≙ oshmem/mca/memheap) ----------------------------------
+
+class SymmetricArray:
+    """One symmetric allocation: same shape/dtype on every PE, remotely
+    addressable. ``.local`` is this PE's backing numpy array."""
+
+    def __init__(self, win: Window, shape, dtype) -> None:
+        self._win = win
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+
+    @property
+    def local(self) -> np.ndarray:
+        return self._win.local.reshape(self.shape)
+
+    def __array__(self, dtype=None):
+        a = self.local
+        return a.astype(dtype) if dtype is not None else a
+
+
+def smalloc(shape, dtype=np.float64) -> SymmetricArray:
+    """shmem_malloc: COLLECTIVE over all PEs (the symmetric-heap contract:
+    every PE allocates in the same order)."""
+    st = _state()
+    shape = (shape,) if np.isscalar(shape) else tuple(shape)
+    count = int(np.prod(shape)) if shape else 1
+    win = Window(st.comm, np.zeros(count, np.dtype(dtype)),
+                 name=f"shmem#{len(st.heap)}")
+    arr = SymmetricArray(win, shape, dtype)
+    st.heap.append(arr)
+    barrier_all()              # allocation is usable on return, everywhere
+    return arr
+
+
+def sfree(arr: SymmetricArray) -> None:
+    st = _state()
+    barrier_all()
+    if arr._win is not None:
+        arr._win.free()
+        arr._win = None
+    if arr in st.heap:
+        st.heap[st.heap.index(arr)] = None  # keep ids stable
+
+
+# -- RMA (≙ oshmem/mca/spml) -------------------------------------------------
+
+def put(dest: SymmetricArray, value, pe: int, offset: int = 0) -> None:
+    """shmem_put: blocking remote store (returns when applied — stronger
+    than the standard's local-completion minimum). Already complete on
+    return, so it never enters the quiet() pending list."""
+    a = np.ascontiguousarray(np.asarray(value, dest.dtype))
+    dest._win.put(a, pe, offset).wait()
+
+
+def _track(st: _PEState, req: Request) -> Request:
+    # bound the pending list: a long nbi streak without quiet() must not
+    # accumulate completed requests
+    if len(st.pending) > 64:
+        st.pending = [r for r in st.pending if not r.done]
+    st.pending.append(req)
+    return req
+
+
+def put_nbi(dest: SymmetricArray, value, pe: int, offset: int = 0) -> Request:
+    st = _state()
+    a = np.ascontiguousarray(np.asarray(value, dest.dtype))
+    return _track(st, dest._win.put(a, pe, offset))
+
+
+def get(src: SymmetricArray, pe: int, count: Optional[int] = None,
+        offset: int = 0) -> np.ndarray:
+    """shmem_get: blocking remote load."""
+    n = int(np.prod(src.shape)) - offset if count is None else int(count)
+    out = np.empty(n, src.dtype)
+    src._win.get(out, pe, offset).wait()
+    return out
+
+
+def get_nbi(src: SymmetricArray, out: np.ndarray, pe: int,
+            offset: int = 0) -> Request:
+    st = _state()
+    return _track(st, src._win.get(out, pe, offset))
+
+
+# -- ordering (≙ spml fence/quiet) ------------------------------------------
+
+def quiet() -> None:
+    """shmem_quiet: all outstanding RMA from this PE is complete."""
+    st = _state()
+    pending, st.pending = st.pending, []
+    for r in pending:
+        r.wait()
+
+
+def fence() -> None:
+    """shmem_fence: ordering of puts per destination. Transports deliver
+    in order per peer and the AM-RDMA target applies in arrival order, so
+    fence needs no wire traffic; quiet() gives the stronger guarantee."""
+    # ordering holds structurally; nothing to flush
+
+
+# -- atomics (≙ oshmem/mca/atomic) ------------------------------------------
+
+def atomic_add(dest: SymmetricArray, value, pe: int, offset: int = 0) -> None:
+    dest._win.accumulate(np.asarray([value], dest.dtype), pe, offset).wait()
+
+
+def atomic_fetch_add(dest: SymmetricArray, value, pe: int,
+                     offset: int = 0):
+    out = np.empty(1, dest.dtype)
+    dest._win.fetch_and_op(np.asarray(value, dest.dtype), out, pe,
+                           offset, SUM).wait()
+    return out[0]
+
+
+def atomic_inc(dest: SymmetricArray, pe: int, offset: int = 0) -> None:
+    atomic_add(dest, 1, pe, offset)
+
+
+def atomic_fetch_inc(dest: SymmetricArray, pe: int, offset: int = 0):
+    return atomic_fetch_add(dest, 1, pe, offset)
+
+
+def atomic_compare_swap(dest: SymmetricArray, cond, value, pe: int,
+                        offset: int = 0):
+    out = np.empty(1, dest.dtype)
+    dest._win.compare_and_swap(np.asarray(cond, dest.dtype),
+                               np.asarray(value, dest.dtype), out, pe,
+                               offset).wait()
+    return out[0]
+
+
+def atomic_swap(dest: SymmetricArray, value, pe: int, offset: int = 0):
+    from ..op import REPLACE
+    out = np.empty(1, dest.dtype)
+    dest._win.fetch_and_op(np.asarray(value, dest.dtype), out, pe,
+                           offset, REPLACE).wait()
+    return out[0]
+
+
+def atomic_fetch(src: SymmetricArray, pe: int, offset: int = 0):
+    return get(src, pe, count=1, offset=offset)[0]
+
+
+# -- p2p synchronization ------------------------------------------------------
+
+_CMPS = {
+    "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+    "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b,
+    "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+}
+
+
+def wait_until(ivar: SymmetricArray, cmp: str, value,
+               offset: int = 0, timeout: float = 60.0) -> None:
+    """shmem_wait_until: spin (under the progress engine, so incoming puts
+    land) until local symmetric memory satisfies the comparison."""
+    fn = _CMPS[cmp]
+    flat = ivar.local.reshape(-1)
+    get_engine().wait_until(lambda: bool(fn(flat[offset], value)),
+                            timeout=timeout)
+
+
+# -- collectives (≙ oshmem/mca/scoll — scoll/mpi trick: reuse coll) ----------
+
+def barrier_all() -> None:
+    st = _state()
+    quiet()
+    st.comm.coll.barrier(st.comm)
+
+
+def broadcast(arr: SymmetricArray, root: int = 0) -> None:
+    st = _state()
+    out = st.comm.coll.bcast(st.comm, arr.local.copy(), root=root)
+    arr.local[...] = np.asarray(out).reshape(arr.shape)
+
+
+def fcollect(src) -> np.ndarray:
+    """shmem_fcollect: concatenation of every PE's contribution."""
+    st = _state()
+    return np.asarray(st.comm.coll.allgather(st.comm, np.asarray(src)))
+
+
+_REDUCE_OPS: Dict[str, Op] = {"sum": SUM, "prod": PROD, "max": MAX,
+                              "min": MIN}
+
+
+def reduce_to_all(src, op: str = "sum") -> np.ndarray:
+    """shmem_<op>_to_all."""
+    st = _state()
+    return np.asarray(
+        st.comm.coll.allreduce(st.comm, np.asarray(src), op=_REDUCE_OPS[op]))
+
+
+def alltoall(src) -> np.ndarray:
+    st = _state()
+    return np.asarray(st.comm.coll.alltoall(st.comm, np.asarray(src)))

@@ -1,0 +1,139 @@
+"""OSHMEM-lite PGAS layer: symmetric heap, put/get, atomics, wait_until,
+SHMEM collectives (≙ oshmem/shmem API families over spml/scoll/memheap)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import runtime, shmem
+
+
+def _pe(fn, n=3, timeout=60):
+    def body(ctx):
+        shmem.init(ctx)
+        try:
+            return fn()
+        finally:
+            shmem.finalize()
+    return runtime.run_ranks(n, body, timeout=timeout)
+
+
+def test_init_pe_identity():
+    def body():
+        assert 0 <= shmem.my_pe() < shmem.n_pes() == 3
+        assert shmem.pe_accessible((shmem.my_pe() + 1) % 3)
+        return shmem.my_pe()
+    assert sorted(_pe(body)) == [0, 1, 2]
+
+
+def test_put_get_roundtrip():
+    def body():
+        me = shmem.my_pe()
+        sym = shmem.smalloc(4, np.float64)
+        sym.local[...] = me * 10.0
+        shmem.barrier_all()
+        right = (me + 1) % shmem.n_pes()
+        got = shmem.get(sym, right)            # read neighbor's heap
+        np.testing.assert_array_equal(got, np.full(4, right * 10.0))
+        shmem.put(sym, np.full(4, 100.0 + me), right)   # write neighbor
+        shmem.barrier_all()
+        left = (me - 1) % shmem.n_pes()
+        np.testing.assert_array_equal(sym.local, np.full(4, 100.0 + left))
+        return True
+    assert all(_pe(body))
+
+
+def test_nbi_and_quiet():
+    def body():
+        me = shmem.my_pe()
+        sym = shmem.smalloc(8, np.int64)
+        shmem.barrier_all()
+        if me == 0:
+            for pe in range(1, shmem.n_pes()):
+                shmem.put_nbi(sym, np.arange(8), pe)
+            shmem.quiet()                       # all puts applied
+        shmem.barrier_all()
+        if me != 0:
+            np.testing.assert_array_equal(sym.local, np.arange(8))
+        return True
+    assert all(_pe(body))
+
+
+def test_atomics():
+    def body():
+        me = shmem.my_pe()
+        ctr = shmem.smalloc(1, np.int64)
+        shmem.barrier_all()
+        old = shmem.atomic_fetch_add(ctr, 1, 0)   # every PE increments PE 0
+        assert 0 <= old < shmem.n_pes()
+        shmem.barrier_all()
+        if me == 0:
+            assert ctr.local[0] == shmem.n_pes()
+            prev = shmem.atomic_swap(ctr, 77, 0)
+            assert prev == shmem.n_pes()
+            swapped = shmem.atomic_compare_swap(ctr, 77, 5, 0)
+            assert swapped == 77 and ctr.local[0] == 5
+        shmem.barrier_all()
+        assert shmem.atomic_fetch(ctr, 0) == 5
+        return True
+    assert all(_pe(body))
+
+
+def test_wait_until_signalling():
+    def body():
+        me = shmem.my_pe()
+        flag = shmem.smalloc(1, np.int64)
+        shmem.barrier_all()
+        if me == 1:
+            shmem.put(flag, np.asarray([42]), 0)
+        if me == 0:
+            shmem.wait_until(flag, "eq", 42, timeout=30)
+            assert flag.local[0] == 42
+        shmem.barrier_all()
+        return True
+    assert all(_pe(body))
+
+
+def test_shmem_collectives():
+    def body():
+        me = shmem.my_pe()
+        got = shmem.fcollect(np.full(2, float(me)))
+        np.testing.assert_array_equal(
+            got.reshape(-1), np.repeat(np.arange(3.0), 2))
+        total = shmem.reduce_to_all(np.full(4, me + 1.0))
+        np.testing.assert_array_equal(total, np.full(4, 6.0))
+        mx = shmem.reduce_to_all(np.asarray([float(me)]), op="max")
+        assert mx[0] == 2.0
+        sym = shmem.smalloc(3, np.float64)
+        if me == 1:
+            sym.local[...] = [7.0, 8.0, 9.0]
+        shmem.broadcast(sym, root=1)
+        np.testing.assert_array_equal(sym.local, [7.0, 8.0, 9.0])
+        return True
+    assert all(_pe(body))
+
+
+def test_symmetric_alloc_is_collective_ordered():
+    def body():
+        a = shmem.smalloc(2, np.int64)
+        b = shmem.smalloc(2, np.int64)
+        a.local[...] = 1
+        b.local[...] = 2
+        shmem.barrier_all()
+        # ids line up: reading "b" remotely must hit the peer's b, not a
+        got = shmem.get(b, (shmem.my_pe() + 1) % shmem.n_pes())
+        np.testing.assert_array_equal(got, [2, 2])
+        return True
+    assert all(_pe(body))
+
+
+def test_sfree_then_finalize():
+    def body():
+        a = shmem.smalloc(2, np.int64)
+        shmem.sfree(a)
+        return True
+    assert all(_pe(body))
+
+
+def test_uninitialized_raises():
+    with pytest.raises(RuntimeError, match="shmem not initialized"):
+        shmem.my_pe()
