@@ -310,6 +310,199 @@ def sched_reduce_scatter_block(comm, send: np.ndarray,
     return Schedule(comm, rounds, result=recv)
 
 
+def _displs(counts: Sequence[int], displs) -> List[int]:
+    if displs is None:
+        displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]]))
+    return list(displs)
+
+
+def sched_gatherv(comm, send: np.ndarray, recv, counts, displs,
+                  root: int) -> Schedule:
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    if rank != root:
+        return Schedule(comm, [[("send", send, root, tag)]])
+    displs = _displs(counts, displs)
+    if recv is None:
+        recv = np.empty(int(np.sum(counts)), send.dtype)
+    flat = recv.reshape(-1)
+    ops: List[Tuple] = [("copy", send.reshape(-1),
+                         flat[displs[root]:displs[root] + counts[root]])]
+    ops += [("recv", flat[displs[s]:displs[s] + counts[s]], s, tag)
+            for s in range(size) if s != root]
+    return Schedule(comm, [ops], result=recv)
+
+
+def sched_scatterv(comm, send, recv: np.ndarray, counts, displs,
+                   root: int) -> Schedule:
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    recv = np.asarray(recv)
+    if rank != root:
+        return Schedule(comm, [[("recv", recv, root, tag)]], result=recv)
+    displs = _displs(counts, displs)
+    flat = np.asarray(send).reshape(-1)
+    ops: List[Tuple] = [("copy", flat[displs[root]:displs[root] + counts[root]],
+                         recv.reshape(-1))]
+    ops += [("send", np.ascontiguousarray(
+        flat[displs[d]:displs[d] + counts[d]]), d, tag)
+        for d in range(size) if d != root]
+    return Schedule(comm, [ops], result=recv)
+
+
+def sched_allgatherv(comm, send: np.ndarray, recv, counts,
+                     displs) -> Schedule:
+    """Linear iallgatherv (libnbc's default shape for the v-variants)."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send).reshape(-1)
+    displs = _displs(counts, displs)
+    if recv is None:
+        recv = np.empty(int(np.sum(counts)), send.dtype)
+    flat = recv.reshape(-1)
+    ops: List[Tuple] = [("copy", send,
+                         flat[displs[rank]:displs[rank] + counts[rank]])]
+    for peer in range(size):
+        if peer != rank:
+            ops.append(("send", send, peer, tag))
+            ops.append(("recv", flat[displs[peer]:displs[peer] + counts[peer]],
+                        peer, tag))
+    return Schedule(comm, [ops], result=recv)
+
+
+def sched_alltoallv(comm, send, recv, sendcounts, recvcounts,
+                    sdispls, rdispls) -> Schedule:
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    sflat = np.asarray(send).reshape(-1)
+    sdispls = _displs(sendcounts, sdispls)
+    rdispls = _displs(recvcounts, rdispls)
+    rflat = recv.reshape(-1)
+    ops: List[Tuple] = [("copy",
+                         sflat[sdispls[rank]:sdispls[rank] + sendcounts[rank]],
+                         rflat[rdispls[rank]:rdispls[rank] + recvcounts[rank]])]
+    for peer in range(size):
+        if peer != rank:
+            ops.append(("send", np.ascontiguousarray(
+                sflat[sdispls[peer]:sdispls[peer] + sendcounts[peer]]),
+                peer, tag))
+            ops.append(("recv",
+                        rflat[rdispls[peer]:rdispls[peer] + recvcounts[peer]],
+                        peer, tag))
+    return Schedule(comm, [ops], result=recv)
+
+
+def sched_alltoallw(comm, sendbufs, recvbufs) -> Schedule:
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    ops: List[Tuple] = [("copy", np.asarray(sendbufs[rank]), recvbufs[rank])]
+    for peer in range(size):
+        if peer != rank:
+            ops.append(("send", np.ascontiguousarray(sendbufs[peer]),
+                        peer, tag))
+            ops.append(("recv", recvbufs[peer], peer, tag))
+    return Schedule(comm, [ops], result=recvbufs)
+
+
+def sched_scan(comm, send: np.ndarray, recv: Optional[np.ndarray],
+               op: Op, exclusive: bool) -> Schedule:
+    """Recursive-doubling iscan/iexscan: the round structure is static
+    (which peers exist per doubling is known at build time), with a copy
+    round snapshotting the running total before each send so in-flight
+    sends never race the total's update."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send)
+    if recv is None:
+        recv = np.empty_like(send)
+    total = send.copy()
+    prefix = np.zeros_like(send)
+    have_prefix = False
+    rounds: List[List[Tuple]] = []
+    mask = 1
+    while mask < size:
+        hi, lo = rank + mask, rank - mask
+        comm_ops: List[Tuple] = []
+        if hi < size:
+            stage = np.empty_like(total)
+            rounds.append([("copy", total, stage)])
+            comm_ops.append(("send", stage, hi, tag))
+        tmp = np.empty_like(total)
+        if lo >= 0:
+            comm_ops.append(("recv", tmp, lo, tag))
+        if comm_ops:
+            rounds.append(comm_ops)
+        if lo >= 0:
+            post: List[Tuple] = []
+            if have_prefix:
+                post.append(("op", op, tmp, prefix))   # prefix=op(tmp,prefix)
+            else:
+                post.append(("copy", tmp, prefix))
+                have_prefix = True
+            post.append(("op", op, tmp, total))        # total=op(tmp,total)
+            rounds.append(post)
+        mask <<= 1
+    final: List[Tuple] = []
+    if exclusive:
+        if have_prefix:
+            final.append(("copy", prefix, recv))
+    else:
+        final.append(("copy", send, recv))
+        if have_prefix:
+            final.append(("op", op, prefix, recv))     # op(prefix, own)
+    rounds.append(final or [])
+    return Schedule(comm, rounds, result=recv)
+
+
+def sched_reduce_scatter(comm, send: np.ndarray, recv: np.ndarray,
+                         counts: Sequence[int], op: Op) -> Schedule:
+    """ireduce_scatter (variable counts): binomial reduce to rank 0 of the
+    full vector, then linear scatterv — the nonoverlapping composition
+    (coll_base_reduce_scatter.c:47) as one schedule."""
+    size, rank = comm.size, comm.rank
+    tag = _nbc_tag(comm)
+    send = np.asarray(send).reshape(-1)
+    acc = send.copy()
+    rounds: List[List[Tuple]] = []
+    mask = 1
+    while mask < size:                     # binomial reduce, root 0
+        if rank & mask:
+            rounds.append([("send", acc, rank & ~mask, tag)])
+            break
+        child = rank | mask
+        if child < size:
+            inbox = np.empty_like(acc)
+            rounds.append([("recv", inbox, child, tag)])
+            rounds.append([("op", op, inbox, acc)])
+        mask <<= 1
+    displs = _displs(counts, None)
+    if rank == 0:
+        # slices of acc are views: by the time this round starts, the
+        # reduce rounds above have completed, so the sends observe the
+        # fully-reduced values
+        ops: List[Tuple] = [("copy", acc[displs[0]:displs[0] + counts[0]],
+                             recv.reshape(-1))]
+        ops += [("send", acc[displs[d]:displs[d] + counts[d]], d, tag)
+                for d in range(1, size)]
+        rounds.append(ops)
+    else:
+        rounds.append([("recv", recv.reshape(-1), 0, tag)])
+    return Schedule(comm, rounds, result=recv)
+
+
+def _sched_neighbor(comm, send_list, recv_list, tag,
+                    result=None) -> Schedule:
+    """One linear round over the topology's in/out edges (≙ nbc ineighbor_*
+    linear schedules)."""
+    ops: List[Tuple] = []
+    for buf, peer in send_list:
+        ops.append(("send", buf, peer, tag))
+    for buf, peer in recv_list:
+        ops.append(("recv", buf, peer, tag))
+    return Schedule(comm, [ops] if ops else [[]], result=result)
+
+
 class NbcModule(CollModule):
     """Registers true-schedule i* entry points; the coll table prefers these
     over the derived eager wrappers."""
@@ -349,6 +542,86 @@ class NbcModule(CollModule):
 
     def ireduce_scatter_block(self, comm, sendbuf, recvbuf=None, op: Op = SUM):
         return sched_reduce_scatter_block(comm, sendbuf, recvbuf, op).start()
+
+    # -- v-variants / scan / reduce_scatter / alltoallw ---------------------
+
+    def igatherv(self, comm, sendbuf, recvbuf=None, counts=None, displs=None,
+                 root: int = 0):
+        return sched_gatherv(comm, sendbuf, recvbuf, counts, displs,
+                             root).start()
+
+    def iscatterv(self, comm, sendbuf, recvbuf=None, counts=None, displs=None,
+                  root: int = 0):
+        if recvbuf is None:
+            raise ValueError("iscatterv needs recvbuf (per-rank count)")
+        return sched_scatterv(comm, sendbuf, recvbuf, counts, displs,
+                              root).start()
+
+    def iallgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
+                    displs=None):
+        return sched_allgatherv(comm, sendbuf, recvbuf, counts,
+                                displs).start()
+
+    def ialltoallv(self, comm, sendbuf, recvbuf, sendcounts, recvcounts,
+                   sdispls=None, rdispls=None):
+        return sched_alltoallv(comm, sendbuf, recvbuf, sendcounts,
+                               recvcounts, sdispls, rdispls).start()
+
+    def ialltoallw(self, comm, sendbufs, recvbufs):
+        return sched_alltoallw(comm, sendbufs, recvbufs).start()
+
+    def iscan(self, comm, sendbuf, recvbuf=None, op: Op = SUM):
+        return sched_scan(comm, sendbuf, recvbuf, op,
+                          exclusive=False).start()
+
+    def iexscan(self, comm, sendbuf, recvbuf=None, op: Op = SUM):
+        return sched_scan(comm, sendbuf, recvbuf, op,
+                          exclusive=True).start()
+
+    def ireduce_scatter(self, comm, sendbuf, recvbuf, counts, op: Op = SUM):
+        return sched_reduce_scatter(comm, sendbuf, recvbuf, counts,
+                                    op).start()
+
+    # -- neighborhood (linear schedules over the attached topology) ---------
+
+    @staticmethod
+    def _edges(comm):
+        topo = getattr(comm, "topo", None)
+        if topo is None:
+            raise RuntimeError(
+                "neighborhood collective on comm without topology")
+        return topo.in_neighbors(comm.rank), topo.out_neighbors(comm.rank)
+
+    def ineighbor_allgather(self, comm, sendbuf, recvbuf=None):
+        ind, outd = self._edges(comm)
+        sendbuf = np.asarray(sendbuf)
+        if recvbuf is None:
+            recvbuf = np.empty((len(ind),) + sendbuf.shape, sendbuf.dtype)
+        tag = _nbc_tag(comm)
+        return _sched_neighbor(
+            comm, [(sendbuf, d) for d in outd],
+            [(recvbuf[i], src) for i, src in enumerate(ind)],
+            tag, result=recvbuf).start()
+
+    def ineighbor_alltoall(self, comm, sendbuf, recvbuf=None):
+        ind, outd = self._edges(comm)
+        sendbuf = np.asarray(sendbuf)
+        # a sink vertex (out-degree 0) sends nothing; reshape((0,-1)) is
+        # ambiguous in numpy, so shape the empty case explicitly
+        parts = (sendbuf.reshape((len(outd), -1)) if outd
+                 else np.zeros((0, 0), sendbuf.dtype))
+        blk = parts.shape[1] if outd else (
+            recvbuf.reshape((len(ind), -1)).shape[1] if recvbuf is not None
+            and len(ind) else 0)
+        if recvbuf is None:
+            recvbuf = np.empty((len(ind), blk), sendbuf.dtype)
+        rparts = recvbuf.reshape((len(ind), -1)) if len(ind) else recvbuf
+        tag = _nbc_tag(comm)
+        return _sched_neighbor(
+            comm,
+            [(np.ascontiguousarray(parts[i]), d) for i, d in enumerate(outd)],
+            [(rparts[i], src) for i, src in enumerate(ind)], tag,
+            result=recvbuf).start()
 
 
 @component("coll", "nbc", priority=40)

@@ -229,6 +229,37 @@ class Communicator:
             st["source"] = self._peer_group().rank_of_world(st["source"])
         return st
 
+    # -- matched probe (MPI_Mprobe family, ≙ ompi/message/) -----------------
+
+    def _fix_msg(self, msg):
+        if msg is not None and msg.status["source"] >= 0:
+            msg.status["source"] = self._peer_group().rank_of_world(
+                msg.status["source"])
+        return msg
+
+    def improbe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG):
+        wsrc = src if src == ANY_SOURCE else self._world_dst(src)
+        return self._fix_msg(self.ctx.p2p.improbe(wsrc, tag, self.cid))
+
+    def mprobe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG,
+               timeout=None):
+        wsrc = src if src == ANY_SOURCE else self._world_dst(src)
+        return self._fix_msg(self.ctx.p2p.mprobe(wsrc, tag, self.cid,
+                                                 timeout=timeout))
+
+    def imrecv(self, msg, buf, **kw) -> Request:
+        req = self.ctx.p2p.imrecv(msg, buf, **kw)
+
+        def fix_source(r):   # world rank → comm rank, like irecv
+            if r.status.source >= 0:
+                r.status.source = self._peer_group().rank_of_world(
+                    r.status.source)
+        req.add_completion_callback(fix_source)
+        return req
+
+    def mrecv(self, msg, buf, **kw):
+        return self.imrecv(msg, buf, **kw).wait()
+
     # -- management: dup / split / create (≙ ompi/communicator/comm.c) ------
 
     def dup(self, name: Optional[str] = None) -> "Communicator":

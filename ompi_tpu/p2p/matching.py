@@ -157,8 +157,14 @@ class MatchingEngine:
 
     # -- probe --------------------------------------------------------------
 
-    def probe(self, cid: int, src: int, tag: int) -> Optional[Unexpected]:
-        """Non-destructive lookup (MPI_Iprobe)."""
+    def probe(self, cid: int, src: int, tag: int,
+              remove: bool = False) -> Optional[Unexpected]:
+        """Non-destructive lookup (MPI_Iprobe); with ``remove`` the matched
+        message is DEQUEUED — the MPI_Mprobe discipline: once matched into a
+        message handle it can no longer match any other receive
+        (≙ ompi/message/message.h matched-message objects)."""
+        if remove:   # one matching walk to maintain: reuse the dequeue path
+            return self._find_unexpected(cid, src, tag)
         buckets = self._unexpected.get(cid)
         if not buckets:
             return None

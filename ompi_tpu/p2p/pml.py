@@ -115,6 +115,25 @@ class _PackedSink:
         return len(payload)
 
 
+class Message:
+    """A matched message handle (≙ ompi/message/message.h: MPI_Message).
+    Holds the dequeued Unexpected until mrecv/imrecv consumes it exactly
+    once."""
+
+    __slots__ = ("status", "_u")
+
+    def __init__(self, u: Unexpected) -> None:
+        self._u = u
+        self.status = {"source": u.src, "tag": u.tag,
+                       "count": u.header["size"]}
+
+    def consume(self) -> Unexpected:
+        if self._u is None:
+            raise RuntimeError("message already received (MPI_MESSAGE_NULL)")
+        u, self._u = self._u, None
+        return u
+
+
 class P2P:
     """One instance per rank process."""
 
@@ -188,6 +207,18 @@ class P2P:
     def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
               cid: int = 0, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
+        req, on_match = self._recv_handler(buf, datatype, count)
+        posted = self.matching.post_recv(cid, src, tag, on_match, req=req)
+        if posted is None:
+            self.spc.inc("matches_unexpected")
+        else:
+            req._posted_ref = (self.matching, cid, posted)
+        return req
+
+    def _recv_handler(self, buf, datatype: Optional[Datatype],
+                      count: Optional[int]):
+        """(request, on_match) pair shared by irecv and imrecv — everything
+        that happens once a message matches this receive."""
         dinfo = _accel.check_addr(buf)
         if dinfo is not None:
             # device destination: stage packed stream on host, upload once
@@ -255,12 +286,48 @@ class P2P:
                                 {"k": "ack", "sreq": u.header["sreq"],
                                  "rreq": rreq}, b"")
 
-        posted = self.matching.post_recv(cid, src, tag, on_match, req=req)
-        if posted is None:
-            self.spc.inc("matches_unexpected")
-        else:
-            req._posted_ref = (self.matching, cid, posted)
+        return req, on_match
+
+    # -- matched probe (≙ MPI_Mprobe/Mrecv, ompi/message/) ------------------
+
+    def improbe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG,
+                cid: int = 0) -> Optional["Message"]:
+        """Match-and-dequeue: the returned Message can no longer match any
+        other receive on this rank (MPI_Improbe)."""
+        self.spc.inc("probes")
+        self.engine.progress()
+        u = self.matching.probe(cid, src, tag, remove=True)
+        return None if u is None else Message(u)
+
+    def mprobe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG,
+               cid: int = 0, timeout: Optional[float] = None) -> "Message":
+        box: list = []
+
+        def check() -> bool:
+            m = self.improbe(src, tag, cid)
+            if m is not None:
+                box.append(m)
+                return True
+            return False
+
+        self.engine.wait_until(check, timeout=timeout)
+        if not box:
+            raise TimeoutError("mprobe: no matching message")
+        return box[0]
+
+    def imrecv(self, msg: "Message", buf,
+               datatype: Optional[Datatype] = None,
+               count: Optional[int] = None) -> Request:
+        """Receive the matched message into ``buf`` (MPI_Imrecv)."""
+        u = msg.consume()
+        req, on_match = self._recv_handler(buf, datatype, count)
+        on_match(u)
         return req
+
+    def mrecv(self, msg: "Message", buf,
+              datatype: Optional[Datatype] = None,
+              count: Optional[int] = None):
+        return self.imrecv(msg, buf, datatype, count).wait()
 
     def cancel_recv(self, req: Request) -> bool:
         """Withdraw a still-posted receive (MPI_Cancel for recvs; used by

@@ -68,13 +68,16 @@ def finalize() -> None:
     st = getattr(_tls, "shmem", None)
     if st is None:
         return
-    quiet()
-    barrier_all()
+    _tls.shmem = None          # idempotent even if cleanup below fails
+    if st.ctx.finalized:
+        return                 # runtime died first: nothing left to flush
+    for r in st.pending:
+        r.wait()
+    st.comm.coll.barrier(st.comm)
     for arr in st.heap:
         if arr is not None and arr._win is not None:   # sfree leaves Nones
             arr._win.free()
             arr._win = None
-    _tls.shmem = None
 
 
 def my_pe() -> int:

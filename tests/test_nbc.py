@@ -187,3 +187,101 @@ def test_size_one_schedules():
         comm.coll.ibarrier(comm).wait()
         return True
     assert all(run(1, body))
+
+
+# -- round-2 breadth: v-variants, scan, reduce_scatter, neighbor ------------
+
+def test_iscan_iexscan_schedules():
+    def fn(ctx):
+        c = ctx.comm_world
+        send = np.arange(4, dtype=np.float64) + c.rank
+        r1 = c.coll.iscan(c, send)
+        r2 = c.coll.iexscan(c, send)
+        s1 = r1.wait()
+        r2.wait()
+        return (np.asarray(r1.result), None if c.rank == 0
+                else np.asarray(r2.result))
+
+    res = runtime.run_ranks(3, fn)
+    base = np.arange(4, dtype=np.float64)
+    for rank, (inc, exc) in enumerate(res):
+        expect_inc = sum(base + r for r in range(rank + 1))
+        np.testing.assert_allclose(inc, expect_inc)
+        if rank > 0:
+            np.testing.assert_allclose(exc, sum(base + r
+                                                for r in range(rank)))
+
+
+def test_igatherv_iscatterv_iallgatherv():
+    def fn(ctx):
+        c = ctx.comm_world
+        me = c.rank
+        counts = [1, 2, 3]
+        mine = np.full(counts[me], float(me))
+        gat = np.zeros(6) if me == 0 else None
+        c.coll.igatherv(c, mine, gat, counts=counts, root=0).wait()
+        if me == 0:
+            np.testing.assert_array_equal(gat, [0, 1, 1, 2, 2, 2])
+        out = np.zeros(counts[me])
+        src = np.array([5.0, 6, 6, 7, 7, 7]) if me == 0 else None
+        c.coll.iscatterv(c, src, out, counts=counts, root=0).wait()
+        np.testing.assert_array_equal(out, np.full(counts[me], 5.0 + me))
+        allg = np.zeros(6)
+        c.coll.iallgatherv(c, mine, allg, counts=counts).wait()
+        np.testing.assert_array_equal(allg, [0, 1, 1, 2, 2, 2])
+        return True
+
+    assert all(runtime.run_ranks(3, fn))
+
+
+def test_ialltoallv_schedule():
+    def fn(ctx):
+        c = ctx.comm_world
+        me, n = c.rank, c.size
+        scounts = [me + 1] * n
+        send = np.concatenate([np.full(me + 1, float(me * 10 + d))
+                               for d in range(n)])
+        rcounts = [s + 1 for s in range(n)]
+        recv = np.zeros(int(np.sum(rcounts)))
+        c.coll.ialltoallv(c, send, recv, scounts, rcounts).wait()
+        expect = np.concatenate([np.full(s + 1, float(s * 10 + me))
+                                 for s in range(n)])
+        np.testing.assert_array_equal(recv, expect)
+        return True
+
+    assert all(runtime.run_ranks(3, fn))
+
+
+def test_ireduce_scatter_varcounts():
+    def fn(ctx):
+        c = ctx.comm_world
+        counts = [1, 2, 3]
+        send = np.arange(6, dtype=np.float64) * (c.rank + 1)
+        recv = np.zeros(counts[c.rank])
+        c.coll.ireduce_scatter(c, send, recv, counts).wait()
+        return recv
+
+    res = runtime.run_ranks(3, fn)
+    total = sum(np.arange(6, dtype=np.float64) * (r + 1) for r in range(3))
+    np.testing.assert_array_equal(res[0], total[:1])
+    np.testing.assert_array_equal(res[1], total[1:3])
+    np.testing.assert_array_equal(res[2], total[3:6])
+
+
+def test_ineighbor_schedules_on_cart():
+    def fn(ctx):
+        from ompi_tpu.topo import cart_create
+        c = cart_create(ctx.comm_world, [3], periods=[True])
+        send = np.full(2, float(c.rank))
+        req = c.coll.ineighbor_allgather(c, send)
+        req.wait()
+        got = np.asarray(req.result)
+        left, right = (c.rank - 1) % 3, (c.rank + 1) % 3
+        assert sorted(got[:, 0].tolist()) == sorted([float(left),
+                                                     float(right)])
+        req2 = c.coll.ineighbor_alltoall(c, np.asarray([[1.0 * c.rank],
+                                                        [10.0 * c.rank]]))
+        req2.wait()
+        return True
+
+    assert all(runtime.run_ranks(3, fn))

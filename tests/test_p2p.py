@@ -283,3 +283,67 @@ def test_context_usable_without_runtime_init():
         assert not t.is_alive(), "direct-Context bcast deadlocked"
     assert not errors, errors
     np.testing.assert_array_equal(results[1], np.arange(5000, dtype=np.int64))
+
+
+class TestMatchedProbe:
+    """MPI_Mprobe/Mrecv (≙ ompi/message/): matched messages are dequeued —
+    they can no longer match other receives — and are received exactly
+    once through the handle."""
+
+    def test_mprobe_dequeues_and_mrecv_delivers(self):
+        import numpy as np
+
+        from ompi_tpu import runtime
+
+        def fn(ctx):
+            c = ctx.comm_world
+            if ctx.rank == 0:
+                c.send(np.arange(5, dtype=np.int64), 1, tag=11)
+                c.send(np.full(5, 9, dtype=np.int64), 1, tag=11)
+                return None
+            msg = c.mprobe(src=0, tag=11, timeout=20)
+            assert msg.status["source"] == 0
+            assert msg.status["count"] == 40
+            # the matched message must NOT satisfy this other recv;
+            # the SECOND send must (same tag — mprobe really dequeued)
+            buf2 = np.zeros(5, np.int64)
+            c.recv(buf2, src=0, tag=11)
+            np.testing.assert_array_equal(buf2, np.full(5, 9))
+            buf1 = np.zeros(5, np.int64)
+            st = c.mrecv(msg, buf1)
+            np.testing.assert_array_equal(buf1, np.arange(5))
+            import pytest
+            with pytest.raises(RuntimeError, match="already received"):
+                c.mrecv(msg, buf1)
+            return True
+
+        res = runtime.run_ranks(2, fn)
+        assert res[1] is True
+
+    def test_improbe_none_when_empty(self):
+        from ompi_tpu import runtime
+
+        def fn(ctx):
+            return ctx.comm_world.improbe(tag=999) is None
+
+        assert all(r for r in runtime.run_ranks(2, fn))
+
+    def test_mrecv_rendezvous_large(self):
+        import numpy as np
+
+        from ompi_tpu import runtime
+
+        def fn(ctx):
+            c = ctx.comm_world
+            n = 200_000   # > eager limit → rendezvous via message handle
+            if ctx.rank == 0:
+                c.send(np.arange(n, dtype=np.float64), 1, tag=4)
+                return None
+            msg = c.mprobe(src=0, tag=4, timeout=30)
+            buf = np.zeros(n, np.float64)
+            c.mrecv(msg, buf)
+            np.testing.assert_array_equal(buf, np.arange(n))
+            return True
+
+        res = runtime.run_ranks(2, fn, timeout=90)
+        assert res[1] is True
