@@ -610,11 +610,14 @@ class NbcModule(CollModule):
         # ambiguous in numpy, so shape the empty case explicitly
         parts = (sendbuf.reshape((len(outd), -1)) if outd
                  else np.zeros((0, 0), sendbuf.dtype))
-        blk = parts.shape[1] if outd else (
-            recvbuf.reshape((len(ind), -1)).shape[1] if recvbuf is not None
-            and len(ind) else 0)
         if recvbuf is None:
-            recvbuf = np.empty((len(ind), blk), sendbuf.dtype)
+            if not outd and ind:
+                # no out-edges to infer the block size from: the incoming
+                # blocks' size is unknowable here — demand a recvbuf
+                raise ValueError(
+                    "ineighbor_alltoall on a rank with in-edges but no "
+                    "out-edges needs an explicit recvbuf")
+            recvbuf = np.empty((len(ind), parts.shape[1]), sendbuf.dtype)
         rparts = recvbuf.reshape((len(ind), -1)) if len(ind) else recvbuf
         tag = _nbc_tag(comm)
         return _sched_neighbor(

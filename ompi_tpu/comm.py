@@ -117,7 +117,8 @@ class Communicator:
 
     @classmethod
     def _world(cls, ctx) -> "Communicator":
-        return cls(ctx, Group(range(ctx.size)), cid=0, name="world")
+        return cls(ctx, Group(getattr(ctx, "world_ranks", range(ctx.size))),
+                   cid=getattr(ctx, "world_cid", 0), name="world")
 
     def _attach_coll(self) -> None:
         if self.is_inter:

@@ -57,6 +57,12 @@ class Bootstrap:
         """Drain pending events for this rank."""
         raise NotImplementedError
 
+    def grow(self, nprocs: int):
+        """Reserve nprocs new global ranks (dynamic spawn). Only control
+        planes with a live coordinator support this."""
+        raise BootstrapError(
+            f"{type(self).__name__} does not support dynamic spawn")
+
     def finalize(self) -> None:
         pass
 

@@ -52,8 +52,14 @@ class Coordinator:
         self.job_id = job_id
         self.kv: Dict[Tuple[int, str], Any] = {}
         self.cond = threading.Condition()
-        self.fence_count = 0
-        self.fence_gen = 0
+        # fences are per process-group: the initial job is group 0; each
+        # GROW (dynamic spawn, ≙ PMIx_Spawn) creates a new group so a child
+        # job's startup fence never waits on parent ranks (and vice versa)
+        self.rank_group: Dict[int, int] = {r: 0 for r in range(size)}
+        self.group_size: Dict[int, int] = {0: size}
+        self.fence_count: Dict[int, int] = {0: 0}
+        self.fence_gen: Dict[int, int] = {0: 0}
+        self._next_group = 1
         self.events: List[List[Dict[str, Any]]] = [[] for _ in range(size)]
         self.aborted: Optional[Tuple[int, int, str]] = None
         self.finished = 0
@@ -104,16 +110,18 @@ class Coordinator:
                 elif op == "FENCE":
                     _, r, timeout = msg
                     with self.cond:
-                        gen = self.fence_gen
-                        self.fence_count += 1
-                        if self.fence_count == self.size:
-                            self.fence_count = 0
-                            self.fence_gen += 1
+                        gid = self.rank_group.get(r, 0)
+                        gen = self.fence_gen[gid]
+                        self.fence_count[gid] += 1
+                        if self.fence_count[gid] == self.group_size[gid]:
+                            self.fence_count[gid] = 0
+                            self.fence_gen[gid] += 1
                             self.cond.notify_all()
                             send_msg(conn, ("OK",))
                         else:
                             ok = self.cond.wait_for(
-                                lambda: self.fence_gen > gen or self.aborted,
+                                lambda: self.fence_gen[gid] > gen
+                                or self.aborted,
                                 timeout=timeout)
                             if self.aborted:
                                 send_msg(conn, ("ABORTED", self.aborted))
@@ -121,6 +129,20 @@ class Coordinator:
                                 send_msg(conn, ("TIMEOUT",))
                             else:
                                 send_msg(conn, ("OK",))
+                elif op == "GROW":
+                    _, r, nprocs = msg
+                    with self.cond:
+                        base = self.size
+                        gid = self._next_group
+                        self._next_group += 1
+                        self.size += nprocs
+                        self.group_size[gid] = nprocs
+                        self.fence_count[gid] = 0
+                        self.fence_gen[gid] = 0
+                        for nr in range(base, base + nprocs):
+                            self.rank_group[nr] = gid
+                            self.events.append([])
+                    send_msg(conn, ("OK", base, gid))
                 elif op == "EVENT":
                     _, r, event = msg
                     with self.cond:
@@ -201,6 +223,17 @@ class TcpBootstrap(Bootstrap):
 
     def fence(self, timeout: float = 60.0) -> None:
         self._rpc(("FENCE", self.rank, timeout))
+
+    def grow(self, nprocs: int) -> Tuple[int, int]:
+        """Reserve ``nprocs`` new global ranks in their own fence group
+        (dynamic spawn, ≙ PMIx_Spawn's resource request). Returns
+        (base_rank, group_id)."""
+        resp = self._rpc(("GROW", self.rank, nprocs))
+        return int(resp[1]), int(resp[2])
+
+    @property
+    def coord_address(self) -> Tuple[str, int]:
+        return self._addr
 
     def publish_event(self, event: Dict[str, Any]) -> None:
         self._rpc(("EVENT", self.rank, event))

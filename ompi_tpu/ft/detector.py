@@ -48,6 +48,10 @@ class FailureDetector:
         self.timeout = float(_var.get("ft_detector_timeout", 0.5))
         self.rank = ctx.rank
         self.size = ctx.size
+        # heartbeat ring runs over THIS job's world ranks (a spawned child
+        # job observes its own members, not the parents' global rank space)
+        self.members = list(getattr(ctx, "world_ranks", range(ctx.size)))
+        self._pos = self.members.index(ctx.rank)
         self._alive = True
         self._lock = threading.Lock()
         if not hasattr(ctx, "failed"):
@@ -65,19 +69,19 @@ class FailureDetector:
     # ring neighbors skip already-dead ranks
 
     def _observed(self) -> Optional[int]:
-        r = (self.rank - 1) % self.size
-        while r != self.rank:
-            if r not in self.failed:
-                return r
-            r = (r - 1) % self.size
+        i = (self._pos - 1) % self.size
+        while i != self._pos:
+            if self.members[i] not in self.failed:
+                return self.members[i]
+            i = (i - 1) % self.size
         return None
 
     def _emit_to(self) -> Optional[int]:
-        r = (self.rank + 1) % self.size
-        while r != self.rank:
-            if r not in self.failed:
-                return r
-            r = (r + 1) % self.size
+        i = (self._pos + 1) % self.size
+        while i != self._pos:
+            if self.members[i] not in self.failed:
+                return self.members[i]
+            i = (i + 1) % self.size
         return None
 
     def add_failure_callback(self, cb) -> None:
@@ -140,7 +144,7 @@ class FailureDetector:
         # receiver re-floods once, the same property the revoke path has
         # (≙ comm_ft_propagator reliable bcast: reaches all survivors if any
         # survivor delivers, even when the original detector dies mid-flood)
-        for r in range(self.size):
+        for r in self.members:
             if r not in self.failed and r != self.rank:
                 try:
                     self.ctx.layer.send(r, T.AM_FT,

@@ -91,6 +91,19 @@ class ShmTransport(T.Transport):
         self._bell = self._lib.doorbell_open(
             _bell_name(bootstrap.job_id, self.rank), 1)
 
+    def add_peers(self, new_size: int) -> None:
+        """Dynamic spawn grew the global rank space: create+attach rx rings
+        for the new peers (the receiver is the ring creator, so this must
+        run before a new peer's first send to us — dpm.spawn sequences it
+        via the ready key)."""
+        for peer in range(self.size, new_size):
+            h = self._lib.shmbox_attach(
+                _chan_name(self._bootstrap.job_id, peer, self.rank),
+                self._ring, 1)
+            if h >= 0:
+                self._rx[peer] = h
+        self.size = max(self.size, new_size)
+
     def reachable(self, peer: int) -> bool:
         if peer == self.rank or not (0 <= peer < self.size):
             return False

@@ -94,6 +94,11 @@ class TcpTransport(T.Transport):
     def reachable(self, peer: int) -> bool:
         return 0 <= peer < self.size
 
+    def add_peers(self, new_size: int) -> None:
+        """Dynamic spawn grew the global rank space: rx needs nothing (the
+        listener accepts anyone), tx connects lazily via the modex."""
+        self.size = max(self.size, new_size)
+
     def _addr_of(self, peer: int) -> tuple:
         addr = self._addrs.get(peer)
         if addr is None:

@@ -114,6 +114,12 @@ class TransportLayer:
     def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes = b"") -> None:
         self.for_peer(peer).send(peer, tag, header, payload)
 
+    def add_peers(self, new_size: int) -> None:
+        """Propagate a dynamic-spawn growth of the global rank space."""
+        for t in self.transports:
+            if hasattr(t, "add_peers"):
+                t.add_peers(new_size)
+
     def transport_matrix(self) -> Dict[int, str]:
         """Which transport serves each wired peer (≙ hook/comm_method's
         transport matrix dump, hook_comm_method_fns.c:25)."""

@@ -68,19 +68,26 @@ def init_device_plane(ctx, coordinator: Optional[str] = None,
     if os.environ.get("OMPI_TPU_DEVICE_PLANE") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
+    # device-plane identity is WORLD-relative: a spawned child job elects
+    # its own coordinator (its lowest world rank) and numbers processes by
+    # world position, so process_id ∈ [0, num_processes) holds even though
+    # global ranks start at WORLD_BASE
+    members = list(getattr(ctx, "world_ranks", range(ctx.size)))
+    pos = members.index(ctx.rank)
     if coordinator is None:
-        if ctx.rank == 0:
+        if pos == 0:
             host = os.environ.get("OMPI_TPU_COORD", "127.0.0.1:0"
                                   ).rpartition(":")[0] or "127.0.0.1"
             coordinator = f"{host}:{_pick_port()}"
             ctx.bootstrap.put("jax_coordinator", coordinator)
         else:
-            coordinator = str(ctx.bootstrap.get(0, "jax_coordinator",
+            coordinator = str(ctx.bootstrap.get(members[0],
+                                                "jax_coordinator",
                                                 timeout=timeout_s))
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=ctx.size,
-        process_id=ctx.rank,
+        process_id=pos,
         initialization_timeout=timeout_s,
     )
     _initialized = True

@@ -42,7 +42,21 @@ class Context:
     def __init__(self, bootstrap: Optional[Bootstrap] = None) -> None:
         self.bootstrap = bootstrap if bootstrap is not None else from_environment()
         self.rank = self.bootstrap.rank
-        self.size = self.bootstrap.size
+        # "world" = this job's ranks. A dynamically-spawned child job
+        # (dpm.spawn) lives at [WORLD_BASE, WORLD_BASE+WORLD_SIZE) of the
+        # grown global rank space: its COMM_WORLD covers only its own ranks
+        # (MPI semantics — children get their own world, talking to parents
+        # through the spawn intercommunicator), while transports address
+        # the full global space.
+        import os as _os
+        wbase = int(_os.environ.get("OMPI_TPU_WORLD_BASE", "0"))
+        wsize = int(_os.environ.get("OMPI_TPU_WORLD_SIZE",
+                                    str(self.bootstrap.size)))
+        self.world_ranks = list(range(wbase, wbase + wsize))
+        self.world_cid = (0 if wbase == 0
+                          else (1 << 43) | int(_os.environ.get(
+                              "OMPI_TPU_SPAWN_GROUP", "0")))
+        self.size = wsize
         self.engine = ProgressEngine()
         self.am_table: dict = {}
         mods = []

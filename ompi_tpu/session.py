@@ -68,7 +68,10 @@ class Session:
 
     def _pset_ranks(self, name: str) -> List[int]:
         if name == WORLD_PSET:
-            return list(range(self.ctx.size))
+            # this JOB's ranks — in a spawned child job the world is
+            # [base, base+size), not range(size)
+            return list(getattr(self.ctx, "world_ranks",
+                                range(self.ctx.size)))
         if name == SELF_PSET:
             return [self.ctx.rank]
         raise ValueError(f"unknown process set {name!r}")

@@ -1,0 +1,30 @@
+"""Child workload for the dynamic-spawn test: connect back to the parents
+through MPI_Comm_get_parent semantics, echo, merge, allreduce."""
+
+import sys
+
+import numpy as np
+
+from ompi_tpu import dpm, runtime
+
+
+def main() -> int:
+    ctx = runtime.init()
+    comm = ctx.comm_world            # the CHILD world: 2 ranks
+    assert comm.size == 2, comm.size
+    parent = dpm.get_parent(ctx)
+    assert parent is not None and parent.remote_size == 2
+    got = np.zeros(1, np.int64)
+    parent.recv(got, comm.rank, tag=1)
+    assert int(got[0]) == 100 + comm.rank, got
+    parent.send(np.array([1000 + comm.rank], np.int64), comm.rank, tag=2)
+    merged = parent.merge(high=True)
+    out = merged.coll.allreduce(merged, np.ones(2))
+    assert out[0] == 4, out
+    print(f"child {comm.rank}: CHILD-OK merged={merged.size}", flush=True)
+    runtime.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,35 @@
+"""Parent workload for the dynamic-spawn test (run under tpurun -np 2):
+spawns 2 children, exchanges over the spawn intercommunicator, merges it,
+and allreduces over the merged 4-rank intracomm (≙ the reference's
+test/simple spawn programs)."""
+
+import os
+import sys
+
+import numpy as np
+
+from ompi_tpu import dpm, runtime
+
+
+def main() -> int:
+    ctx = runtime.init()
+    comm = ctx.comm_world
+    child = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "dpm_spawn_child.py")
+    inter = dpm.spawn(comm, [child], maxprocs=2)
+    assert inter.is_inter and inter.remote_size == 2, inter
+    # each parent sends to the same-index child and gets rank echoed back
+    inter.send(np.array([100 + comm.rank], np.int64), comm.rank, tag=1)
+    got = np.zeros(1, np.int64)
+    inter.recv(got, comm.rank, tag=2)
+    assert int(got[0]) == 1000 + comm.rank, got
+    merged = inter.merge(high=False)
+    out = merged.coll.allreduce(merged, np.ones(2))
+    assert out[0] == 4, out
+    print(f"parent {comm.rank}: SPAWN-OK merged={merged.size}", flush=True)
+    runtime.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,78 @@
+"""Dynamic process management (≙ ompi/dpm/dpm.c + test/simple spawn/
+client-server programs): comm_spawn with real processes under tpurun, and
+port-based connect/accept between disjoint communicators."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ompi_tpu import dpm, runtime
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_spawn_real_processes_under_tpurun():
+    """Parents (tpurun -np 2) spawn 2 real child processes; both sides run
+    p2p over the spawn intercommunicator, merge, and allreduce over the
+    merged intracomm."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "2",
+         "--timeout", "120",
+         os.path.join(REPO, "tests", "dpm_spawn_parent.py")],
+        capture_output=True, text=True, env=env, timeout=180)
+    out = proc.stdout + proc.stderr
+    assert out.count("SPAWN-OK merged=4") == 2, out
+    assert out.count("CHILD-OK merged=4") == 2, out
+    assert proc.returncode == 0, (proc.returncode, out)
+
+
+def test_connect_accept_between_disjoint_comms():
+    """MPI_Open_port/accept/connect: the two halves of a split world
+    rendezvous by port name and get an intercommunicator."""
+    def body(ctx):
+        world = ctx.comm_world
+        side = ctx.rank % 2
+        local = world.split(side, ctx.rank)
+        if side == 0:
+            port = dpm.open_port(ctx) if local.rank == 0 else None
+            # share the port name inside the server side (out-of-band here;
+            # real apps print/publish it like the reference's examples)
+            port = local.coll.bcast(
+                local, np.frombuffer(
+                    (port or " " * 32).ljust(32).encode(), np.uint8).copy(),
+                root=0)
+            port = bytes(port).decode().strip()
+            inter = dpm.accept(port, local, timeout=30)
+        else:
+            port = "ompi-tpu-port:0:0"     # server rank 0's first port name
+            inter = dpm.connect(port, local, timeout=30)
+        assert inter.is_inter and inter.remote_size == 2
+        # cross-side sendrecv: pair up by rank
+        got = np.zeros(1, np.int64)
+        inter.sendrecv(np.array([10 * side + local.rank], np.int64),
+                       local.rank, got, local.rank)
+        assert int(got[0]) == 10 * (1 - side) + local.rank
+        return True
+
+    assert all(runtime.run_ranks(4, body, timeout=90))
+
+
+def test_spawn_refused_without_coordinator():
+    def body(ctx):
+        with pytest.raises(Exception, match="dynamic spawn"):
+            ctx.bootstrap.grow(2)
+        return True
+
+    assert all(runtime.run_ranks(2, body))
+
+
+def test_get_parent_none_in_plain_process():
+    def body(ctx):
+        return dpm.get_parent(ctx) is None
+
+    assert all(runtime.run_ranks(2, body))
