@@ -56,37 +56,54 @@ def spawn(comm: Communicator, command: Sequence[str], maxprocs: int,
     total = base + maxprocs
     children = list(range(base, base + maxprocs))
 
+    ok = np.zeros(1, np.int64)
     if comm.rank == root:
-        cmd = list(command)
-        if cmd[0].endswith(".py"):
-            cmd = [sys.executable] + cmd
-        coord = ctx.bootstrap.coord_address
-        for i, child in enumerate(children):
-            env = dict(os.environ)
-            if env_extra:
-                env.update(env_extra)
-            env.update({
-                "OMPI_TPU_RANK": str(child),
-                "OMPI_TPU_SIZE": str(total),
-                "OMPI_TPU_COORD": f"{coord[0]}:{coord[1]}",
-                "OMPI_TPU_JOB": ctx.bootstrap.job_id,
-                "OMPI_TPU_LOCAL_RANK": str(child),
-                "OMPI_TPU_WORLD_BASE": str(base),
-                "OMPI_TPU_WORLD_SIZE": str(maxprocs),
-                "OMPI_TPU_SPAWN_GROUP": str(gid),
-                "OMPI_TPU_PARENT_RANKS": ",".join(
-                    map(str, comm.group.world_ranks)),
-                "OMPI_TPU_PARENT_ROOT": str(
-                    comm.group.world_of_rank(root)),
-                "OMPI_TPU_PARENT_CID": str(_SPAWN_CID_BASE | gid),
-            })
-            subprocess.Popen(cmd, env=env)
-        # children's shm host keys appear once their transports are up;
-        # waiting here bounds the add_peers race window below (only the
-        # shm transport publishes this key — skip when it's not in play)
-        if any(t.name == "shm" for t in ctx.layer.transports):
-            for child in children:
-                ctx.bootstrap.get(child, "transport_shm_host", timeout=60.0)
+        try:
+            cmd = list(command)
+            if cmd[0].endswith(".py"):
+                cmd = [sys.executable] + cmd
+            coord = ctx.bootstrap.coord_address
+            for i, child in enumerate(children):
+                env = dict(os.environ)
+                # chip binding does NOT inherit: the children are a new job
+                # placement the caller controls via env_extra (≙ the
+                # MPI_Info keys of MPI_Comm_spawn)
+                env.pop("TPU_VISIBLE_DEVICES", None)
+                if env_extra:
+                    env.update(env_extra)
+                env.update({
+                    "OMPI_TPU_RANK": str(child),
+                    "OMPI_TPU_SIZE": str(total),
+                    "OMPI_TPU_COORD": f"{coord[0]}:{coord[1]}",
+                    "OMPI_TPU_JOB": ctx.bootstrap.job_id,
+                    "OMPI_TPU_LOCAL_RANK": str(i),
+                    "OMPI_TPU_NUM_LOCAL": str(maxprocs),
+                    "OMPI_TPU_WORLD_BASE": str(base),
+                    "OMPI_TPU_WORLD_SIZE": str(maxprocs),
+                    "OMPI_TPU_SPAWN_GROUP": str(gid),
+                    "OMPI_TPU_PARENT_RANKS": ",".join(
+                        map(str, comm.group.world_ranks)),
+                    "OMPI_TPU_PARENT_ROOT": str(
+                        comm.group.world_of_rank(root)),
+                    "OMPI_TPU_PARENT_CID": str(_SPAWN_CID_BASE | gid),
+                })
+                subprocess.Popen(cmd, env=env)
+            # children's ring-ready keys appear once their shm rx rings
+            # exist; waiting here closes the add_peers/first-send race
+            # (only the shm transport publishes them)
+            if any(t.name == "shm" for t in ctx.layer.transports):
+                for child in children:
+                    ctx.bootstrap.get(child, "transport_shm_rings",
+                                      timeout=60.0)
+            ok[0] = 1
+        except Exception as exc:   # surface collectively, not a hang
+            ok[0] = 0
+            err = exc
+    ok = np.asarray(comm.coll.bcast(comm, ok, root=root))
+    if not int(ok[0]):
+        if comm.rank == root:
+            raise RuntimeError(f"spawn failed to launch: {err!r}") from err
+        raise RuntimeError("spawn failed to launch (see root rank)")
     comm.coll.barrier(comm)
     ctx.layer.add_peers(total)       # every parent can now serve children
     comm.coll.barrier(comm)
@@ -192,16 +209,14 @@ def _wait_event(ctx, port: str, kind: str, timeout: float) -> dict:
         for i, ev in enumerate(stash):
             if ev.get("dpm") == kind and ev.get("port") == port:
                 return stash.pop(i)
-        for ev in ctx.bootstrap.poll_events():
+        for ev in ctx.poll_events():
             if ev.get("dpm"):
                 stash.append(ev)
             else:
-                # park non-dpm events where a future consumer can drain
-                # them; today dpm is the only control-plane event producer
-                # (the failure detector uses AM frames, not these events)
-                if getattr(ctx, "parked_events", None) is None:
-                    ctx.parked_events = []
-                ctx.parked_events.append(ev)
+                # not ours (e.g. the detector's proc_failed events): back
+                # onto the context's event backlog so the next
+                # ctx.poll_events() caller still sees it
+                ctx.push_event(ev)
         if time.monotonic() > deadline:
             raise TimeoutError(
                 f"dpm: no peer arrived on port {port!r} within {timeout}s")

@@ -1,0 +1,112 @@
+"""Mixture-of-Experts layer with expert parallelism over an ``ep`` axis.
+
+≙ what EP users build on the reference's alltoall/alltoallv
+(coll_base_alltoallv.c, SURVEY.md §2.6): token→expert dispatch and
+expert→token combine are all-to-all exchanges. TPU-natively neither is a
+hand-written collective: the dispatch/combine einsums contract a (tokens ×
+experts × capacity) one-hot against token activations, with the experts
+dimension sharded over ``ep`` — GSPMD lowers exactly those einsums to ICI
+all-to-alls (the "let XLA insert collectives" recipe), and the per-expert
+FFN batches onto the MXU as one (E, C, d) × (E, d, ff) matmul.
+
+Top-k routing with capacity dropping (GShard/Switch discipline): each
+expert takes at most C = ceil(T/E · k · capacity_factor) tokens; overflow
+tokens fall through on the residual stream (combine weights are zero for
+them). An auxiliary load-balancing loss (mean fraction × mean router prob
+per expert, scaled by E) keeps the router from collapsing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
+                    n_experts: int) -> Dict:
+    k = jax.random.split(rng, 4)
+
+    def dense(key, fan_in, shape):
+        return jax.random.normal(key, shape, jnp.float32) / np.sqrt(fan_in)
+
+    return {
+        "router": dense(k[0], d_model, (d_model, n_experts)),
+        "w_gate": dense(k[1], d_model, (n_experts, d_model, d_ff)),
+        "w_up": dense(k[2], d_model, (n_experts, d_model, d_ff)),
+        "w_down": dense(k[3], d_ff, (n_experts, d_ff, d_model)),
+    }
+
+
+def moe_param_specs() -> Dict:
+    """Experts dim over ep; expert-internal features over tp (composes the
+    Megatron column/row split with expert parallelism)."""
+    return {
+        "router": P(),
+        "w_gate": P("ep", None, "tp"),
+        "w_up": P("ep", None, "tp"),
+        "w_down": P("ep", "tp", None),
+    }
+
+
+def moe_block(h: jax.Array, params: Dict, n_experts: int, top_k: int = 2,
+              capacity_factor: float = 1.25,
+              ) -> Tuple[jax.Array, jax.Array]:
+    """h: (b, s, d) → (out (b, s, d), aux_loss scalar)."""
+    b, s, d = h.shape
+    t = b * s
+    x = h.reshape(t, d)
+    compute_dtype = h.dtype
+
+    logits = x.astype(jnp.float32) @ params["router"]        # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    capacity = int(np.ceil(t * top_k * capacity_factor / n_experts))
+    capacity = max(capacity, top_k)
+
+    # top-k choice per token; positions within each expert assigned by
+    # cumulative order (tokens beyond capacity are dropped)
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)      # (T, k)
+    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+
+    dispatch = jnp.zeros((t, n_experts, capacity), compute_dtype)
+    combine = jnp.zeros((t, n_experts, capacity), jnp.float32)
+    used = jnp.zeros((n_experts,), jnp.int32)   # slots filled per expert
+    for slot in range(top_k):
+        e = expert_idx[:, slot]                              # (T,)
+        onehot_e = jax.nn.one_hot(e, n_experts,
+                                  dtype=jnp.int32)           # (T, E)
+        # position of this token within its expert's capacity buffer:
+        # slots already used by earlier top-k rounds + earlier tokens in
+        # this round — all integer math (one_hot requires int positions,
+        # and occupancy must count DISPATCHED tokens, not nonzero gates)
+        pos_in_e = (jnp.cumsum(onehot_e, axis=0) - 1) * onehot_e  # (T, E)
+        pos = jnp.sum(pos_in_e, axis=1) + used[e]                 # (T,)
+        keep = pos < capacity
+        onehot_c = jax.nn.one_hot(pos, capacity,
+                                  dtype=jnp.int32)           # (T, C)
+        oh = onehot_e[:, :, None] * onehot_c[:, None, :]     # (T, E, C)
+        oh = oh * keep[:, None, None].astype(jnp.int32)
+        used = used + jnp.sum(oh, axis=(0, 2))
+        dispatch = dispatch + oh.astype(compute_dtype)
+        combine = combine + oh.astype(jnp.float32) \
+            * gate_vals[:, slot][:, None, None]
+
+    # expert inputs: (E, C, d) — E sharded over ep → GSPMD all-to-all
+    ein = jnp.einsum("tec,td->ecd", dispatch, x)
+    gate = jax.nn.silu(jnp.einsum(
+        "ecd,edf->ecf", ein, params["w_gate"].astype(compute_dtype)))
+    up = jnp.einsum("ecd,edf->ecf", ein,
+                    params["w_up"].astype(compute_dtype))
+    eout = jnp.einsum("ecf,efd->ecd", gate * up,
+                      params["w_down"].astype(compute_dtype))
+    out = jnp.einsum("tec,ecd->td", combine.astype(compute_dtype), eout)
+
+    # load-balance aux (Switch eq. 4): E · Σ_e fraction_e · mean_prob_e
+    frac = jnp.mean(
+        jax.nn.one_hot(expert_idx[:, 0], n_experts), axis=0)
+    aux = n_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
+    return out.reshape(b, s, d), aux

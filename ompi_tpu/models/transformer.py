@@ -41,6 +41,10 @@ class Config:
     dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-native)
     attn: str = "dense"              # "dense" | "ring"
     rope_base: float = 10000.0
+    mlp: str = "dense"               # "dense" | "moe" (expert-parallel)
+    n_experts: int = 8
+    moe_top_k: int = 2
+    moe_aux_weight: float = 0.01
 
 
 # -- init -------------------------------------------------------------------
@@ -59,15 +63,23 @@ def init_params(rng: jax.Array, cfg: Config) -> Dict:
     h = cfg.n_heads * cfg.head_dim
     for i in range(cfg.n_layers):
         k = jax.random.split(keys[2 + i], 6)
-        params["layers"].append({
+        layer = {
             "attn_norm": jnp.ones((cfg.d_model,), jnp.float32),
             "wqkv": dense(k[0], cfg.d_model, (cfg.d_model, 3 * h)),
             "wo": dense(k[1], h, (h, cfg.d_model)),
             "mlp_norm": jnp.ones((cfg.d_model,), jnp.float32),
-            "w_gate": dense(k[2], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-            "w_up": dense(k[3], cfg.d_model, (cfg.d_model, cfg.d_ff)),
-            "w_down": dense(k[4], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
-        })
+        }
+        if cfg.mlp == "moe":
+            from .moe import init_moe_params
+            layer["moe"] = init_moe_params(k[5], cfg.d_model, cfg.d_ff,
+                                           cfg.n_experts)
+        else:
+            layer.update({
+                "w_gate": dense(k[2], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+                "w_up": dense(k[3], cfg.d_model, (cfg.d_model, cfg.d_ff)),
+                "w_down": dense(k[4], cfg.d_ff, (cfg.d_ff, cfg.d_model)),
+            })
+        params["layers"].append(layer)
     return params
 
 
@@ -80,10 +92,16 @@ def param_specs(cfg: Config) -> Dict:
         "wqkv": P(None, "tp"),
         "wo": P("tp", None),
         "mlp_norm": P(),
-        "w_gate": P(None, "tp"),
-        "w_up": P(None, "tp"),
-        "w_down": P("tp", None),
     }
+    if cfg.mlp == "moe":
+        from .moe import moe_param_specs
+        layer["moe"] = moe_param_specs()
+    else:
+        layer.update({
+            "w_gate": P(None, "tp"),
+            "w_up": P(None, "tp"),
+            "w_down": P("tp", None),
+        })
     return {
         "embed": P("tp", None),
         "final_norm": P(),
@@ -93,8 +111,14 @@ def param_specs(cfg: Config) -> Dict:
 
 def shard_params(params: Dict, mesh: Mesh, cfg: Config) -> Dict:
     specs = param_specs(cfg)
+
+    def fit(s: P) -> P:
+        # drop axes the mesh doesn't have (e.g. no tp on a dp×ep mesh):
+        # that dimension is simply replicated
+        return P(*(a if a in mesh.axis_names else None for a in s))
+
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, fit(s))),
         params, specs, is_leaf=lambda x: isinstance(x, P))
 
 
@@ -120,10 +144,12 @@ def _rope(x, positions, base):
 
 def forward(params: Dict, tokens: jax.Array, cfg: Config,
             mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens: (batch, seq) int32 → logits (batch, seq, vocab)."""
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab); with
+    cfg.mlp == "moe" returns (logits, router_aux_loss)."""
     b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]      # (b, s, d)
     positions = jnp.arange(s)
+    aux_total = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
         h = _rms_norm(x, layer["attn_norm"])
         qkv = h @ layer["wqkv"].astype(cfg.dtype)      # (b, s, 3*heads*hd)
@@ -144,21 +170,30 @@ def forward(params: Dict, tokens: jax.Array, cfg: Config,
         att = att.reshape(b, s, cfg.n_heads * cfg.head_dim)
         x = x + att @ layer["wo"].astype(cfg.dtype)    # row-parallel → psum
         h = _rms_norm(x, layer["mlp_norm"])
-        gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
-        up = h @ layer["w_up"].astype(cfg.dtype)
-        x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
+        if "moe" in layer:
+            from .moe import moe_block
+            mlp_out, aux = moe_block(h, layer["moe"], cfg.n_experts,
+                                     cfg.moe_top_k)
+            x = x + mlp_out
+            aux_total = aux_total + aux
+        else:
+            gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
+            up = h @ layer["w_up"].astype(cfg.dtype)
+            x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
     x = _rms_norm(x, params["final_norm"])
     logits = x @ params["embed"].astype(cfg.dtype).T   # tied embedding
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    return (logits, aux_total) if cfg.mlp == "moe" else logits
 
 
 def loss_fn(params: Dict, tokens: jax.Array, cfg: Config,
             mesh: Optional[Mesh] = None) -> jax.Array:
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    out = forward(params, tokens[:, :-1], cfg, mesh)
+    logits, aux = out if cfg.mlp == "moe" else (out, 0.0)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits, axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll)
+    return jnp.mean(nll) + cfg.moe_aux_weight * aux
 
 
 # -- training ---------------------------------------------------------------

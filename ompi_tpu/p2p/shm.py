@@ -90,6 +90,9 @@ class ShmTransport(T.Transport):
         # quantum (≙ mpi_yield_when_idle for oversubscribed hosts)
         self._bell = self._lib.doorbell_open(
             _bell_name(bootstrap.job_id, self.rank), 1)
+        # published AFTER the rx rings exist: dynamic spawn waits on this
+        # key before letting anyone send to us (ring creator = receiver)
+        bootstrap.put("transport_shm_rings", True)
 
     def add_peers(self, new_size: int) -> None:
         """Dynamic spawn grew the global rank space: create+attach rx rings

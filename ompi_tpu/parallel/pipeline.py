@@ -1,0 +1,123 @@
+"""Pipeline parallelism — a GPipe-style schedule over a mesh axis.
+
+≙ what PP users build on the reference's p2p/partitioned sends
+(pml_ob1_isend.c:249, ompi/mca/part/part.h:30 — SURVEY.md §2.6): stage
+boundaries are neighbor exchanges. TPU-natively that is NOT host-driven
+send/recv: all stages run ONE compiled SPMD program under ``shard_map``
+over the ``pp`` axis, stage-local parameters come from a leading
+stages-dimension sharded over that axis, and the boundary transfer is a
+``lax.ppermute`` ring shift per schedule tick — the compiler overlaps the
+shift with the next tick's compute on the MXU (the same
+communication/compute overlap 1F1B hand-schedules on GPU clusters).
+
+Schedule: M microbatches drain through P stages in M+P-1 ticks (GPipe).
+Memory for the backward pass is handled by XLA's remat of the tick scan
+(``jax.checkpoint`` on the stage function), not by hand-interleaving —
+under jax.grad the whole pipeline differentiates as one program, which is
+the TPU-first answer to 1F1B's purpose (bounding live activations).
+
+Weight layout: ``stack_stage_params`` pytrees L layers into P stages of
+L/P stacked layers; inside the program each stage reads its own slice via
+``lax.axis_index``-free shard_map slicing (the leading dim IS the pp
+shard), and runs its layers with a ``lax.scan`` (compile once per stage
+depth, not per layer).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jax.experimental.shard_map import shard_map
+
+
+def stack_stage_params(layer_params: list, n_stages: int):
+    """[L per-layer pytrees] → pytree with leading (P, L//P) dims, ready to
+    shard P over the pp axis."""
+    n = len(layer_params)
+    if n % n_stages:
+        raise ValueError(f"{n} layers do not split into {n_stages} stages")
+    per = n // n_stages
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layer_params)
+    return jax.tree.map(
+        lambda x: x.reshape((n_stages, per) + x.shape[1:]), stacked)
+
+
+def shard_stage_params(stacked, mesh: Mesh, axis: str = "pp"):
+    """Put the stages dimension on the pp axis (everything else replicated;
+    compose with tp specs by sharding trailing dims upstream)."""
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))),
+        stacked)
+
+
+def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
+             stage_params, microbatches: jax.Array, mesh: Mesh,
+             axis: str = "pp", checkpoint: bool = True) -> jax.Array:
+    """Run ``microbatches`` (M, mb, ...) through P pipeline stages.
+
+    ``stage_fn(params_for_stage, x) -> y`` maps one microbatch through one
+    stage; activations keep one shape across stages (the transformer
+    residual-stream invariant). Returns (M, mb, ...) outputs of the LAST
+    stage. Differentiable end-to-end (jax.grad through the tick scan).
+    """
+    n_stages = mesh.shape[axis]
+    m_count = microbatches.shape[0]
+    fn = jax.checkpoint(stage_fn) if checkpoint else stage_fn
+
+    mb_spec = P(*([None] * microbatches.ndim))
+    par_spec = jax.tree.map(lambda _: P(axis), stage_params)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(par_spec, mb_spec),
+        out_specs=mb_spec, check_rep=False)
+    def run(params, mbs):
+        # params leaves: (1, L/P, ...) — my stage's slice; mbs: (M, mb, ...)
+        my = jax.tree.map(lambda x: x[0], params)
+        stage = lax.axis_index(axis)
+        last = n_stages - 1
+        zero = jnp.zeros(mbs.shape[1:], mbs.dtype)
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 ingests microbatch t (when one remains); others take
+            # the ppermute'd activation from the previous tick
+            feed = lax.cond(t < m_count,
+                            lambda: lax.dynamic_index_in_dim(
+                                mbs, jnp.minimum(t, m_count - 1), 0,
+                                keepdims=False),
+                            lambda: zero)
+            x = jnp.where(stage == 0, feed, state)
+            y = fn(my, x)
+            # the microbatch leaving the LAST stage at tick t is t-(P-1)
+            out_idx = t - last
+            outs = lax.cond(
+                (stage == last) & (out_idx >= 0),
+                lambda: lax.dynamic_update_index_in_dim(
+                    outs, y, jnp.maximum(out_idx, 0), 0),
+                lambda: outs)
+            # shift every stage's output one stage forward
+            state = lax.ppermute(
+                y, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (state, outs), None
+
+        outs0 = jnp.zeros_like(mbs)
+        (_, outs), _ = lax.scan(
+            tick, (zero, outs0), jnp.arange(m_count + n_stages - 1))
+        # only the last stage holds real outputs; broadcast them to all
+        # stages so the result is replicated over pp (psum of a one-hot)
+        outs = lax.psum(jnp.where(stage == last, outs, jnp.zeros_like(outs)),
+                        axis)
+        return outs
+
+    # jit so the schedule compiles as one program even when called eagerly
+    # (checkpointed stage_fn inside shard_map requires a surrounding jit;
+    # nested jit is a no-op when the caller already traces)
+    return jax.jit(run)(stage_params, microbatches)

@@ -148,6 +148,22 @@ class Context:
     def abort(self, code: int = 1, msg: str = "") -> None:
         self.bootstrap.abort(code, msg)
 
+    # -- control-plane events (the canonical poll point) ---------------------
+
+    def push_event(self, ev: dict) -> None:
+        """Re-queue an event another consumer drained but doesn't own."""
+        if not hasattr(self, "_event_backlog"):
+            self._event_backlog = []
+        self._event_backlog.append(ev)
+
+    def poll_events(self) -> list:
+        """Backlogged + freshly-arrived control-plane events. Consumers that
+        drain events they don't own must push_event() them back."""
+        out = getattr(self, "_event_backlog", [])
+        self._event_backlog = []
+        out.extend(self.bootstrap.poll_events())
+        return out
+
 
 _process_ctx: Optional[Context] = None
 
