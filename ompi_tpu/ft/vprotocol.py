@@ -1,0 +1,172 @@
+"""Pessimist-style message logging for replay-based recovery.
+
+≙ the reference's vprotocol framework (ompi/mca/vprotocol/pessimist/,
+interposed on pml via the ``pml/v`` wrapper; event log
+vprotocol_pessimist_eventlog.c): the nondeterministic outcomes of a rank's
+execution are its receive matches (which message satisfied which receive —
+ANY_SOURCE/ANY_TAG resolution) and their payloads. A *pessimist* protocol
+logs each outcome to stable storage before the application consumes it, so
+a crashed rank can be re-executed deterministically: replayed receives
+return exactly the logged messages in the logged order, without the
+original senders.
+
+Scope (vs the reference): event + payload logging at the RECEIVER (the
+reference logs payloads at the sender and events at an event-logger rank;
+a single stable log per rank gives the same replay power for fail-stop
+recovery of that rank, at the cost of logging bandwidth — an explicit
+trade, not an omission). Replay drives the application's receive sequence;
+sends during replay are suppressed (their effects are already reflected in
+the survivors, the standard pessimist discipline).
+
+Usage:
+    log = vprotocol.attach(ctx, logdir)          # wraps the live pml
+    ... run; crash ...
+    rp = vprotocol.Replayer(logdir, rank)        # restarted process
+    rp.recv(buf, src, tag) → replays the logged message stream
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+_MAGIC = b"OTPUVLG1"
+
+
+def _log_path(logdir: str, rank: int) -> str:
+    return os.path.join(logdir, f"msglog.{rank}.bin")
+
+
+class MessageLog:
+    """Append-only stable log of delivered receives (event + payload),
+    flushed per record — the 'pessimist' property: the event is durable
+    before the application can act on it."""
+
+    def __init__(self, ctx, logdir: str) -> None:
+        os.makedirs(logdir, exist_ok=True)
+        self.path = _log_path(logdir, ctx.rank)
+        self._fh = open(self.path, "wb")
+        self._fh.write(_MAGIC)
+        self._lock = threading.Lock()
+        self.events = 0
+
+    def record(self, src: int, tag: int, cid: int, payload: bytes) -> None:
+        rec = pickle.dumps({"src": src, "tag": tag, "cid": cid,
+                            "data": payload})
+        with self._lock:
+            self._fh.write(struct.pack("!I", len(rec)))
+            self._fh.write(rec)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.events += 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._fh.close()
+
+
+def attach(ctx, logdir: str) -> MessageLog:
+    """Interpose on the live pml (the pml/v position): every completed
+    receive is logged before its request completes. Idempotent."""
+    existing = getattr(ctx, "_msglog", None)
+    if existing is not None:
+        return existing
+    log = MessageLog(ctx, logdir)
+    ctx._msglog = log
+    p2p = ctx.p2p
+    orig_irecv = p2p.irecv
+
+    def irecv(buf, src=-1, tag=-1, cid=0, **kw):
+        req = orig_irecv(buf, src, tag, cid, **kw)
+
+        def logged(r):
+            if r.error is None and r.status.source >= 0:
+                data = _snapshot(buf, r.status.count)
+                log.record(r.status.source, r.status.tag, cid, data)
+        req.add_completion_callback(logged)
+        return req
+
+    p2p.irecv = irecv
+    ctx._msglog_orig = orig_irecv
+    return log
+
+
+def detach(ctx) -> None:
+    orig = getattr(ctx, "_msglog_orig", None)
+    if orig is not None:
+        ctx.p2p.irecv = orig
+        del ctx._msglog_orig
+    log = getattr(ctx, "_msglog", None)
+    if log is not None:
+        log.close()
+        del ctx._msglog
+
+
+def _snapshot(buf, count: int) -> bytes:
+    from ..accelerator import DeviceBuffer
+    if isinstance(buf, DeviceBuffer):
+        arr = np.asarray(buf.array)
+    else:
+        arr = np.asarray(buf)
+    return arr.reshape(-1).view(np.uint8).tobytes()[:count]
+
+
+class Replayer:
+    """Deterministic re-execution source for a restarted rank: receives
+    return the logged messages in logged order (matching src/tag when
+    named; ANY_SOURCE/ANY_TAG resolve to whatever was logged — that IS the
+    recorded nondeterminism). Sends are no-ops (suppressed, pessimist
+    replay discipline)."""
+
+    ANY = -1
+
+    def __init__(self, logdir: str, rank: int) -> None:
+        self.records = []
+        path = _log_path(logdir, rank)
+        with open(path, "rb") as fh:
+            if fh.read(len(_MAGIC)) != _MAGIC:
+                raise ValueError(f"{path}: not a message log")
+            while True:
+                hdr = fh.read(4)
+                if len(hdr) < 4:
+                    break
+                (n,) = struct.unpack("!I", hdr)
+                self.records.append(pickle.loads(fh.read(n)))
+        self._pos = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self.records) - self._pos
+
+    def recv(self, buf, src: int = ANY, tag: int = ANY, cid: int = 0
+             ) -> Dict[str, Any]:
+        """Replay the next logged receive; validates that a named src/tag
+        matches the log (a mismatch means the re-execution diverged, which
+        pessimist recovery must detect, not paper over)."""
+        if self._pos >= len(self.records):
+            raise RuntimeError("replay log exhausted")
+        rec = self.records[self._pos]
+        self._pos += 1
+        if src != self.ANY and src != rec["src"]:
+            raise RuntimeError(
+                f"replay divergence: recv from {src}, log has {rec['src']}")
+        if tag != self.ANY and tag != rec["tag"]:
+            raise RuntimeError(
+                f"replay divergence: recv tag {tag}, log has {rec['tag']}")
+        if cid != rec["cid"]:
+            raise RuntimeError(
+                f"replay divergence: recv cid {cid}, log has {rec['cid']}")
+        arr = np.asarray(buf)
+        flat = arr.reshape(-1).view(np.uint8)
+        data = np.frombuffer(rec["data"], np.uint8)
+        flat[:len(data)] = data
+        return {"source": rec["src"], "tag": rec["tag"],
+                "count": len(data)}
+
+    def send(self, *a, **kw) -> None:
+        """Suppressed during replay (survivors already saw the original)."""

@@ -70,7 +70,11 @@ def moe_block(h: jax.Array, params: Dict, n_experts: int, top_k: int = 2,
     # top-k choice per token; positions within each expert assigned by
     # cumulative order (tokens beyond capacity are dropped)
     gate_vals, expert_idx = jax.lax.top_k(probs, top_k)      # (T, k)
-    gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    if top_k > 1:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    # top_k == 1 keeps the RAW top-1 probability (Switch routing): the
+    # normalized value would be the constant 1.0, cutting the router off
+    # from the task-loss gradient entirely
 
     dispatch = jnp.zeros((t, n_experts, capacity), compute_dtype)
     combine = jnp.zeros((t, n_experts, capacity), jnp.float32)

@@ -83,8 +83,14 @@ class ShmTransport(T.Transport):
                 continue
             h = self._lib.shmbox_attach(
                 _chan_name(bootstrap.job_id, peer, self.rank), self._ring, 1)
-            if h >= 0:
-                self._rx[peer] = h
+            if h < 0:
+                # a create-attach can only fail for environmental reasons
+                # (/dev/shm exhausted, name collision) — failing init is the
+                # clean outcome; silently skipping would let senders crash
+                # later and would falsify the ring-ready key's guarantee
+                raise RuntimeError(
+                    f"shm transport: cannot create rx ring from rank {peer}")
+            self._rx[peer] = h
         # our doorbell: senders post it after writing into an empty ring so
         # an idle_wait()-blocked receiver wakes in µs, not a scheduler
         # quantum (≙ mpi_yield_when_idle for oversubscribed hosts)
@@ -103,8 +109,10 @@ class ShmTransport(T.Transport):
             h = self._lib.shmbox_attach(
                 _chan_name(self._bootstrap.job_id, peer, self.rank),
                 self._ring, 1)
-            if h >= 0:
-                self._rx[peer] = h
+            if h < 0:
+                raise RuntimeError(
+                    f"shm transport: cannot create rx ring from rank {peer}")
+            self._rx[peer] = h
         self.size = max(self.size, new_size)
 
     def reachable(self, peer: int) -> bool:

@@ -57,6 +57,9 @@ def shard_stage_params(stacked, mesh: Mesh, axis: str = "pp"):
         stacked)
 
 
+_RUN_CACHE: dict = {}
+
+
 def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
              stage_params, microbatches: jax.Array, mesh: Mesh,
              axis: str = "pp", checkpoint: bool = True) -> jax.Array:
@@ -69,6 +72,13 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     """
     n_stages = mesh.shape[axis]
     m_count = microbatches.shape[0]
+    # cache the jitted schedule per (stage_fn, mesh, shape class): a fresh
+    # closure per call would defeat jax.jit's cache and retrace every step
+    cache_key = (stage_fn, mesh, axis, checkpoint, m_count,
+                 jax.tree.structure(stage_params))
+    cached = _RUN_CACHE.get(cache_key)
+    if cached is not None:
+        return cached(stage_params, microbatches)
     fn = jax.checkpoint(stage_fn) if checkpoint else stage_fn
 
     mb_spec = P(*([None] * microbatches.ndim))
@@ -120,4 +130,6 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     # jit so the schedule compiles as one program even when called eagerly
     # (checkpointed stage_fn inside shard_map requires a surrounding jit;
     # nested jit is a no-op when the caller already traces)
-    return jax.jit(run)(stage_params, microbatches)
+    jitted = jax.jit(run)
+    _RUN_CACHE[cache_key] = jitted
+    return jitted(stage_params, microbatches)

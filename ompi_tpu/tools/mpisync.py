@@ -1,0 +1,72 @@
+"""Cross-rank clock synchronization for trace alignment.
+
+≙ ompi/tools/mpisync (mpigclock.c): every rank measures its clock offset
+against rank 0 with ping-pong rounds, taking the sample with the MINIMUM
+round-trip (the echo least perturbed by scheduling — mpigclock's RTT
+filter), offset = remote_midpoint_time - local_midpoint. The offsets let
+per-rank SPC/monitoring timestamps merge into one global timeline.
+
+Library: ``offsets = clock_sync(comm)`` (rank 0's table of every rank's
+offset, seconds; bcast to all). CLI: ``tpurun -np N -m
+ompi_tpu.tools.mpisync`` prints the table on rank 0.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+SYNC_TAG = 733            # user-tag space; callers pick quiescent moments
+DEFAULT_ROUNDS = 25
+
+
+def _measure_offset(comm, peer: int, rounds: int) -> float:
+    """Rank 0 side: offset of ``peer``'s clock relative to ours."""
+    best_rtt = float("inf")
+    best_off = 0.0
+    remote = np.zeros(1, np.float64)
+    for _ in range(rounds):
+        t0 = time.monotonic()
+        comm.send(np.zeros(1, np.float64), peer, SYNC_TAG)
+        comm.recv(remote, peer, SYNC_TAG)
+        t1 = time.monotonic()
+        rtt = t1 - t0
+        if rtt < best_rtt:
+            best_rtt = rtt
+            best_off = float(remote[0]) - (t0 + t1) / 2.0
+    return best_off
+
+
+def clock_sync(comm, rounds: int = DEFAULT_ROUNDS) -> np.ndarray:
+    """Collective: returns, on every rank, the per-rank clock offsets
+    (seconds, relative to rank 0; offsets[0] == 0)."""
+    offsets = np.zeros(comm.size, np.float64)
+    if comm.rank == 0:
+        for peer in range(1, comm.size):
+            offsets[peer] = _measure_offset(comm, peer, rounds)
+    else:
+        ping = np.zeros(1, np.float64)
+        for _ in range(rounds):
+            comm.recv(ping, 0, SYNC_TAG)
+            comm.send(np.array([time.monotonic()], np.float64), 0, SYNC_TAG)
+    return np.asarray(comm.coll.bcast(comm, offsets, root=0))
+
+
+def main(argv: Optional[list] = None) -> int:
+    from .. import runtime
+
+    ctx = runtime.init()
+    comm = ctx.comm_world
+    offsets = clock_sync(comm)
+    if ctx.rank == 0:
+        print("mpisync clock offsets vs rank 0 (seconds):")
+        for r, off in enumerate(offsets):
+            print(f"  rank {r:4d}  {off:+.6e}")
+    runtime.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -226,3 +226,69 @@ def test_ft_real_kill_under_tpurun():
     out = proc.stdout + proc.stderr
     assert out.count("SHRINK-OK size=3") == 3, out
     assert proc.returncode == 0, (proc.returncode, out)
+
+
+def test_message_logging_and_replay(tmp_path):
+    """vprotocol pessimist analog: a rank's delivered receives are logged
+    durably (event + payload); a 'restarted' execution replays them
+    deterministically without the senders, and divergence is detected."""
+    from ompi_tpu.ft import vprotocol
+
+    logdir = str(tmp_path)
+
+    # run: rank 0 receives two messages (one ANY_SOURCE) and logs them
+    def run_body(ctx):
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            log = vprotocol.attach(ctx, logdir)
+            a = np.zeros(4)
+            comm.recv(a, 1, tag=5)
+            b = np.zeros(2)
+            from ompi_tpu.p2p import ANY_SOURCE
+            comm.recv(b, ANY_SOURCE, tag=6)
+            assert log.events == 2
+            vprotocol.detach(ctx)
+            return (a.copy(), b.copy())
+        if ctx.rank == 1:
+            comm.send(np.array([1.0, 2, 3, 4]), 0, tag=5)
+        if ctx.rank == 2:
+            comm.send(np.array([9.0, 9]), 0, tag=6)
+        return None
+
+    res = runtime.run_ranks(3, run_body, timeout=60)
+    a, b = res[0]
+
+    # "restart": replay rank 0's log with no peers alive at all
+    rp = vprotocol.Replayer(logdir, 0)
+    assert rp.remaining == 2
+    a2 = np.zeros(4)
+    st = rp.recv(a2, src=1, tag=5)
+    np.testing.assert_array_equal(a2, a)
+    assert st["source"] == 1
+    b2 = np.zeros(2)
+    st = rp.recv(b2)                      # ANY: resolves as logged
+    np.testing.assert_array_equal(b2, b)
+    assert st["source"] == 2 and st["tag"] == 6
+    rp.send(np.zeros(1), 0)               # suppressed, no error
+
+    # divergence detection: wrong named source must raise
+    rp2 = vprotocol.Replayer(logdir, 0)
+    with pytest.raises(RuntimeError, match="divergence"):
+        rp2.recv(np.zeros(4), src=2, tag=5)
+
+
+def test_mpisync_clock_offsets():
+    """mpisync analog: offsets are finite, rank 0's is zero, and every rank
+    agrees on the table (same-process clocks → offsets ≈ 0)."""
+    from ompi_tpu.tools.mpisync import clock_sync
+
+    def body(ctx):
+        return clock_sync(ctx.comm_world, rounds=5)
+
+    res = runtime.run_ranks(3, body, timeout=60)
+    for table in res:
+        t = np.asarray(table)
+        assert t.shape == (3,) and t[0] == 0.0
+        assert np.isfinite(t).all()
+        assert np.abs(t).max() < 0.5          # same host, same clock
+    np.testing.assert_array_equal(np.asarray(res[0]), np.asarray(res[1]))
