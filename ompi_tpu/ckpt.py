@@ -1,0 +1,147 @@
+"""Checkpoint / resume — the recovery state plane.
+
+The reference removed BLCR system-level checkpointing in v5 (only the
+component-metadata flag remains, opal/mca/mca.h:350) and points users at
+app-level checkpointing composed with ULFM (docs/features/ulfm.rst;
+SURVEY.md §5.4 asks this framework for modern hooks instead). Here the
+hooks are TPU-native:
+
+  * ``save``/``restore``: orbax-backed pytree checkpointing. Save is
+    asynchronous (device→host DMA overlaps the next step — the
+    accelerator-framework staging discipline applied to state);
+  * restore takes a target ``sharding`` pytree/mesh, so state saved on one
+    topology restores onto another — THE property elastic ULFM recovery
+    needs: detect → revoke → shrink → rebuild a smaller mesh from the
+    survivors → ``restore`` onto it (ft/__init__ recipe);
+  * ``CheckpointManager``: step-numbered directory layout with retention,
+    latest-step discovery, and an every-N-steps ``should_save`` hook.
+
+Single-controller discipline: the controller process drives save/restore
+for the whole mesh (orbax handles per-shard IO). In the rank-per-chip
+plane, rank 0 of the job drives and the others fence — composing with the
+bootstrap exactly like every other collective bring-up step.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def save(path: str, state: Any, force: bool = True) -> None:
+    """Blocking save of a pytree of (possibly sharded) jax arrays."""
+    ckptr = _ocp().StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), state, force=force)
+    ckptr.wait_until_finished()
+
+
+def save_async(path: str, state: Any) -> "AsyncSave":
+    """Start an asynchronous save: device→host transfer happens now, disk
+    IO in the background; ``wait()`` (or the next save) joins it."""
+    ckptr = _ocp().AsyncCheckpointer(_ocp().StandardCheckpointHandler())
+    ckptr.save(os.path.abspath(path), args=_ocp().args.StandardSave(state))
+    return AsyncSave(ckptr)
+
+
+class AsyncSave:
+    def __init__(self, ckptr) -> None:
+        self._ckptr = ckptr
+
+    def wait(self) -> None:
+        self._ckptr.wait_until_finished()
+
+
+def restore(path: str, like: Any) -> Any:
+    """Restore onto the shardings/dtypes/shapes of ``like`` (an abstract or
+    concrete pytree). ``like`` may live on a DIFFERENT mesh than the save —
+    orbax reshards on read, which is what shrink-recovery needs."""
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_shard(x))
+        if hasattr(x, "shape") else x, like)
+    ckptr = _ocp().StandardCheckpointer()
+    return ckptr.restore(os.path.abspath(path), abstract)
+
+
+def _shard(x):
+    s = getattr(x, "sharding", None)
+    return s
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention (keep the newest K), every-N
+    cadence, and latest-step discovery — the app-level loop's whole
+    checkpoint surface:
+
+        mgr = CheckpointManager(dir, every=100, keep=3)
+        for step in ...:
+            if mgr.should_save(step):
+                mgr.save(step, state)
+        state = mgr.restore_latest(like=state)
+    """
+
+    def __init__(self, directory: str, every: int = 1, keep: int = 2) -> None:
+        self.directory = os.path.abspath(directory)
+        self.every = max(1, int(every))
+        self.keep = max(1, int(keep))
+        os.makedirs(self.directory, exist_ok=True)
+        self._pending: Optional[AsyncSave] = None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def steps(self) -> list:
+        out = []
+        for name in os.listdir(self.directory):
+            if name.startswith("step_"):
+                try:
+                    out.append(int(name[5:]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def should_save(self, step: int) -> bool:
+        return step % self.every == 0
+
+    def save(self, step: int, state: Any, blocking: bool = False) -> None:
+        if self._pending is not None:
+            self._pending.wait()          # one in flight at a time
+            self._pending = None
+        path = self._step_dir(step)
+        if blocking:
+            save(path, state)
+        else:
+            self._pending = save_async(path, state)
+        self._retain()
+
+    def wait(self) -> None:
+        if self._pending is not None:
+            self._pending.wait()
+            self._pending = None
+
+    def _retain(self) -> None:
+        import shutil
+        steps = self.steps()
+        for s in steps[:-self.keep]:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def restore(self, step: int, like: Any) -> Any:
+        self.wait()
+        return restore(self._step_dir(step), like)
+
+    def restore_latest(self, like: Any) -> Any:
+        step = self.latest_step()
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoints under {self.directory}")
+        return self.restore(step, like)

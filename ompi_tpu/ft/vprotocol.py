@@ -79,27 +79,40 @@ def attach(ctx, logdir: str) -> MessageLog:
     log = MessageLog(ctx, logdir)
     ctx._msglog = log
     p2p = ctx.p2p
-    orig_irecv = p2p.irecv
+    orig_irecv, orig_imrecv = p2p.irecv, p2p.imrecv
 
-    def irecv(buf, src=-1, tag=-1, cid=0, **kw):
-        req = orig_irecv(buf, src, tag, cid, **kw)
-
+    def _logged_cb(buf, cid):
         def logged(r):
+            # runs at the pml layer, BEFORE comm-level source remapping:
+            # logged sources are WORLD ranks (translate comm-local ranks
+            # through the group when replaying sub-communicator code)
             if r.error is None and r.status.source >= 0:
-                data = _snapshot(buf, r.status.count)
-                log.record(r.status.source, r.status.tag, cid, data)
-        req.add_completion_callback(logged)
+                log.record(r.status.source, r.status.tag, cid,
+                           _snapshot(buf, r.status.count))
+        return logged
+
+    def irecv(buf, src=-1, *a, **kw):
+        # pass positionals through untouched — pml.recv calls with 6
+        cid = a[1] if len(a) > 1 else kw.get("cid", 0)
+        req = orig_irecv(buf, src, *a, **kw)
+        req.add_completion_callback(_logged_cb(buf, cid))
         return req
 
-    p2p.irecv = irecv
-    ctx._msglog_orig = orig_irecv
+    def imrecv(msg, buf, *a, **kw):
+        # matched-message receives are deliveries too (mprobe/mrecv path)
+        req = orig_imrecv(msg, buf, *a, **kw)
+        req.add_completion_callback(_logged_cb(buf, 0))
+        return req
+
+    p2p.irecv, p2p.imrecv = irecv, imrecv
+    ctx._msglog_orig = (orig_irecv, orig_imrecv)
     return log
 
 
 def detach(ctx) -> None:
     orig = getattr(ctx, "_msglog_orig", None)
     if orig is not None:
-        ctx.p2p.irecv = orig
+        ctx.p2p.irecv, ctx.p2p.imrecv = orig
         del ctx._msglog_orig
     log = getattr(ctx, "_msglog", None)
     if log is not None:
@@ -147,7 +160,9 @@ class Replayer:
              ) -> Dict[str, Any]:
         """Replay the next logged receive; validates that a named src/tag
         matches the log (a mismatch means the re-execution diverged, which
-        pessimist recovery must detect, not paper over)."""
+        pessimist recovery must detect, not paper over). ``src`` is a
+        WORLD rank — the log records at the pml layer, below the
+        communicator's rank remapping."""
         if self._pos >= len(self.records):
             raise RuntimeError("replay log exhausted")
         rec = self.records[self._pos]

@@ -73,12 +73,16 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     n_stages = mesh.shape[axis]
     m_count = microbatches.shape[0]
     # cache the jitted schedule per (stage_fn, mesh, shape class): a fresh
-    # closure per call would defeat jax.jit's cache and retrace every step
+    # closure per call would defeat jax.jit's cache and retrace every step.
+    # Bounded FIFO: per-call stage_fn closures must not leak an executable
+    # per step (they still miss — pass a stable stage_fn to actually cache)
     cache_key = (stage_fn, mesh, axis, checkpoint, m_count,
-                 jax.tree.structure(stage_params))
+                 microbatches.ndim, jax.tree.structure(stage_params))
     cached = _RUN_CACHE.get(cache_key)
     if cached is not None:
         return cached(stage_params, microbatches)
+    while len(_RUN_CACHE) >= 32:
+        _RUN_CACHE.pop(next(iter(_RUN_CACHE)))
     fn = jax.checkpoint(stage_fn) if checkpoint else stage_fn
 
     mb_spec = P(*([None] * microbatches.ndim))

@@ -1,0 +1,78 @@
+"""Checkpoint/resume (ckpt.py): pytree save/restore, async save, manager
+retention, and the elastic-recovery property — state saved on one mesh
+restores onto a different (shrunken) mesh (SURVEY.md §5.4 + the ft
+recovery recipe)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ompi_tpu import ckpt
+from ompi_tpu.parallel import make_mesh
+
+
+def _state(seed=0):
+    k = jax.random.split(jax.random.key(seed), 2)
+    return {"w": jax.random.normal(k[0], (8, 16)),
+            "opt": {"m": jnp.zeros((8, 16)), "step": jnp.asarray(3)}}
+
+
+def _eq(a, b):
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), a, b)
+
+
+def test_save_restore_roundtrip(tmp_path):
+    s = _state()
+    ckpt.save(str(tmp_path / "c1"), s)
+    out = ckpt.restore(str(tmp_path / "c1"), like=jax.tree.map(
+        lambda x: jnp.zeros_like(x), s))
+    _eq(out, s)
+
+
+def test_async_save(tmp_path):
+    s = _state(1)
+    job = ckpt.save_async(str(tmp_path / "c2"), s)
+    job.wait()
+    _eq(ckpt.restore(str(tmp_path / "c2"), like=s), s)
+
+
+def test_restore_onto_shrunken_mesh(tmp_path):
+    """The elastic-recovery property: save sharded over 8 devices, restore
+    onto a 4-device mesh (survivors after shrink)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    big = make_mesh({"dp": 8})
+    w = jax.device_put(jnp.arange(64, dtype=jnp.float32).reshape(8, 8),
+                       NamedSharding(big, P("dp", None)))
+    ckpt.save(str(tmp_path / "c3"), {"w": w})
+
+    small = jax.sharding.Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+    like = jax.ShapeDtypeStruct(
+        (8, 8), jnp.float32, sharding=NamedSharding(small, P("dp", None)))
+    out = ckpt.restore(str(tmp_path / "c3"), like={"w": like})
+    assert set(out["w"].devices()) == set(jax.devices()[:4])
+    np.testing.assert_array_equal(
+        np.asarray(out["w"]),
+        np.arange(64, dtype=np.float32).reshape(8, 8))
+
+
+def test_manager_cadence_retention_latest(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"), every=10, keep=2)
+    assert mgr.should_save(0) and not mgr.should_save(5)
+    states = {}
+    for step in (0, 10, 20):
+        states[step] = _state(step)
+        mgr.save(step, states[step], blocking=True)
+    mgr.wait()
+    assert mgr.steps() == [10, 20]           # keep=2 dropped step 0
+    assert mgr.latest_step() == 20
+    out = mgr.restore_latest(like=states[20])
+    _eq(out, states[20])
+
+
+def test_manager_empty_raises(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "empty"))
+    with pytest.raises(FileNotFoundError):
+        mgr.restore_latest(like={})

@@ -14,14 +14,18 @@ from ompi_tpu.parallel import DeviceComm, attach_mesh, make_mesh  # noqa: E402
 N = 8
 
 
-@pytest.fixture(scope="module", params=["8dev", "1dev"])
+@pytest.fixture(scope="module", params=["8dev", "4dev", "1dev"])
 def dc(request):
-    """Both regimes: rank-per-device (8 devices) and all ranks on one device
-    (the single-chip bench mode — multiple rows per mesh position)."""
+    """Three regimes: rank-per-device (8 devices), two rows per device
+    (4 devices — the r>1 multi-device paths: block all-to-all, two-ppermute
+    ring shift, local-prefix scan), and all ranks on one device (the
+    single-chip bench mode)."""
+    import jax as _jax
     if request.param == "8dev":
         mesh = make_mesh({"x": N})
+    elif request.param == "4dev":
+        mesh = make_mesh({"x": 4}, devices=_jax.devices()[:4])
     else:
-        import jax as _jax
         mesh = make_mesh({"x": 1}, devices=_jax.devices()[:1])
     return DeviceComm(mesh, "x")
 
