@@ -55,7 +55,12 @@ class AsyncSave:
         self._ckptr = ckptr
 
     def wait(self) -> None:
-        self._ckptr.wait_until_finished()
+        if self._ckptr is not None:
+            self._ckptr.wait_until_finished()
+            # each async save owns its checkpointer; close it or its
+            # background threads outlive the save and accumulate
+            self._ckptr.close()
+            self._ckptr = None
 
 
 def restore(path: str, like: Any) -> Any:
@@ -107,6 +112,7 @@ class CheckpointManager:
         return sorted(out)
 
     def latest_step(self) -> Optional[int]:
+        self.wait()          # an in-flight save IS the latest once finalized
         s = self.steps()
         return s[-1] if s else None
 

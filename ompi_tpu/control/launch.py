@@ -87,10 +87,12 @@ def main(argv: List[str] | None = None) -> int:
                          "rank does NOT take the job down; survivors run "
                          "detector/revoke/shrink recovery. Job exit code is "
                          "0 if any rank exits 0.")
+    ap.add_argument("-m", dest="module", default=None,
+                    help="run a python module as the program (like python -m)")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and args (a python script or executable)")
     args = ap.parse_args(argv)
-    if not args.command:
+    if not args.command and not args.module:
         ap.error("no command given")
     if args.device_plane == "cpu" and args.chips_per_rank > 0:
         ap.error("--device-plane cpu and --chips-per-rank conflict "
@@ -102,7 +104,9 @@ def main(argv: List[str] | None = None) -> int:
     mca = [f"{n}={v}" for n, v in args.mca]
 
     cmd = args.command
-    if cmd[0].endswith(".py"):
+    if args.module:
+        cmd = [sys.executable, "-m", args.module] + cmd
+    elif cmd[0].endswith(".py"):
         cmd = [sys.executable] + cmd
 
     procs: List[subprocess.Popen] = []

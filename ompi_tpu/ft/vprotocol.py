@@ -99,9 +99,12 @@ def attach(ctx, logdir: str) -> MessageLog:
         return req
 
     def imrecv(msg, buf, *a, **kw):
-        # matched-message receives are deliveries too (mprobe/mrecv path)
+        # matched-message receives are deliveries too (mprobe/mrecv path);
+        # the message's cid travels in its wire header — read it before
+        # consume() empties the handle
+        cid = msg._u.header.get("cid", 0) if msg._u is not None else 0
         req = orig_imrecv(msg, buf, *a, **kw)
-        req.add_completion_callback(_logged_cb(buf, 0))
+        req.add_completion_callback(_logged_cb(buf, cid))
         return req
 
     p2p.irecv, p2p.imrecv = irecv, imrecv
