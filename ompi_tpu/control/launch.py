@@ -88,10 +88,22 @@ def main(argv: List[str] | None = None) -> int:
                          "detector/revoke/shrink recovery. Job exit code is "
                          "0 if any rank exits 0.")
     ap.add_argument("-m", dest="module", default=None,
-                    help="run a python module as the program (like python -m)")
+                    help="run a python module as the program (like python "
+                         "-m); everything after the module name goes to it")
     ap.add_argument("command", nargs=argparse.REMAINDER,
                     help="program and args (a python script or executable)")
+    if argv is None:
+        argv = sys.argv[1:]
+    # argparse.REMAINDER only engages at the first positional, so module
+    # arguments like `-m mod --flag` would be rejected — split manually:
+    # everything after `-m <module>` belongs to the module, verbatim
+    module_rest: List[str] = []
+    if "-m" in argv:
+        i = argv.index("-m")
+        module_rest = argv[i + 2:]
+        argv = argv[:i + 2]
     args = ap.parse_args(argv)
+    args.command = args.command + module_rest
     if not args.command and not args.module:
         ap.error("no command given")
     if args.device_plane == "cpu" and args.chips_per_rank > 0:

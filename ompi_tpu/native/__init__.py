@@ -6,6 +6,7 @@ pieces where native actually pays on a TPU *host*:
 
   * ``shmbox.cpp``    — shared-memory SPSC ring channels (≙ btl/sm)
   * ``convertor.cpp`` — derived-datatype pack/unpack loops (≙ opal_convertor)
+  * ``cma.cpp``       — cross-memory-attach single-copy reads (≙ smsc/cma)
 
 Build strategy (no pip, no pybind11 in the image): a single ``g++ -O3
 -shared -fPIC`` invocation at first import. The artifact name embeds a
@@ -26,7 +27,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["shmbox.cpp", "convertor.cpp"]
+_SOURCES = ["shmbox.cpp", "convertor.cpp", "cma.cpp"]
 
 _lock = threading.Lock()
 _lib = None
@@ -110,7 +111,31 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         fn.argtypes = [u8p, u8p, ctypes.c_uint64, i64p, ctypes.c_uint64,
                        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64]
         fn.restype = None
+    lib.cma_read.argtypes = [ctypes.c_int32, ctypes.c_uint64, u8p,
+                             ctypes.c_uint64]
+    lib.cma_read.restype = ctypes.c_int64
+    lib.cma_probe.argtypes = []
+    lib.cma_probe.restype = ctypes.c_int
     return lib
+
+
+def cma_usable() -> bool:
+    """True when single-copy cross-process reads should work: syscall
+    probe, plus a yama hint — scope>0 restricts reads to descendants
+    UNLESS the process holds CAP_SYS_PTRACE (approximated by euid 0).
+    This is advisory: the receive path latches CMA off on a real EPERM,
+    so an over-optimistic answer costs one failed syscall, not
+    correctness."""
+    lib = load()
+    if lib is None or not lib.cma_probe():
+        return False
+    if os.geteuid() == 0:
+        return True     # CAP_SYS_PTRACE-class privilege: yama won't block
+    try:
+        with open("/proc/sys/kernel/yama/ptrace_scope") as fh:
+            return fh.read().strip() == "0"
+    except OSError:
+        return True     # no yama: classic same-uid rule applies
 
 
 def load():

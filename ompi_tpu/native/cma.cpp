@@ -1,0 +1,51 @@
+// Cross-memory-attach single-copy transfers (≙ the smsc/cma component,
+// opal/mca/smsc/cma — SURVEY.md §2.2: shared-memory SINGLE-copy
+// cross-process transfers via process_vm_readv). The rendezvous receiver
+// pulls the sender's user buffer directly into its own — one copy total,
+// versus two (sender→ring, ring→receiver) through the shm rings.
+//
+// Availability: same-uid processes; YAMA ptrace_scope>0 restricts reads to
+// descendants, which sibling ranks are not — cma_probe() reports that so
+// the pml can keep the fragment path.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <sys/uio.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Read n bytes at `addr` of process `pid` into `dst`. Returns bytes read
+// or -errno.
+int64_t cma_read(int32_t pid, uint64_t addr, uint8_t* dst, uint64_t n) {
+  struct iovec local{dst, static_cast<size_t>(n)};
+  struct iovec remote{reinterpret_cast<void*>(addr), static_cast<size_t>(n)};
+  int64_t total = 0;
+  while (static_cast<uint64_t>(total) < n) {
+    ssize_t got = process_vm_readv(pid, &local, 1, &remote, 1, 0);
+    if (got < 0) return -static_cast<int64_t>(errno);
+    if (got == 0) break;
+    total += got;
+    local.iov_base = dst + total;
+    local.iov_len = n - total;
+    remote.iov_base = reinterpret_cast<uint8_t*>(addr) + total;
+    remote.iov_len = n - total;
+  }
+  return total;
+}
+
+// Can this process CMA-read its own memory? (A self-read succeeds whenever
+// the syscall exists and is not wholly disabled; the sibling-process case
+// is additionally gated by yama, which the Python side checks.)
+int cma_probe(void) {
+  uint64_t cookie = 0x6f6d70695f747075ULL;
+  uint64_t out = 0;
+  int64_t got = cma_read(static_cast<int32_t>(getpid()),
+                         reinterpret_cast<uint64_t>(&cookie),
+                         reinterpret_cast<uint8_t*>(&out), sizeof(out));
+  return got == sizeof(out) && out == cookie;
+}
+
+}  // extern "C"

@@ -47,6 +47,12 @@ class TruncateError(RuntimeError):
     pass
 
 
+_var.register("smsc", "", "enabled", True, type=bool, level=4,
+              help="Allow CMA single-copy rendezvous over shared memory "
+                   "(≙ the smsc/cma component; disable to force the "
+                   "fragment protocol).")
+
+
 def _capacity_count(nbytes: int, dt: Datatype) -> int:
     """How many datatype elements fit in nbytes — extent-aware: element i
     occupies [i*extent, i*extent + span) where span is the used byte range
@@ -76,13 +82,15 @@ def _buffer_args(buf, datatype: Optional[Datatype], count: Optional[int]
 
 
 class _SendState:
-    __slots__ = ("req", "data", "dst", "offset")
+    __slots__ = ("req", "data", "dst", "offset", "keep")
 
-    def __init__(self, req: Request, data: bytes, dst: int) -> None:
+    def __init__(self, req: Request, data: Optional[bytes], dst: int,
+                 keep=None) -> None:
         self.req = req
-        self.data = data
+        self.data = data      # packed bytes; None for CMA-exposed sends
         self.dst = dst
         self.offset = 0
+        self.keep = keep      # pins the user array while CMA-readable
 
 
 class _RecvState:
@@ -163,6 +171,7 @@ class P2P:
               datatype: Optional[Datatype] = None, count: Optional[int] = None,
               sync: bool = False) -> Request:
         info = _accel.check_addr(buf)
+        raw = None            # contiguous host array: CMA single-copy donor
         if info is not None:   # explicit device staging, never np.asarray
             if datatype is not None and count is None:
                 count = _capacity_count(info.nbytes, datatype)
@@ -170,31 +179,53 @@ class P2P:
             self.spc.inc("device_stage_out_bytes", len(data))
         else:
             arr, dt, cnt = _buffer_args(buf, datatype, count)
-            data = Convertor(arr, dt, cnt).pack() if cnt else b""
+            if cnt and dt.is_contiguous and arr.flags["C_CONTIGUOUS"] \
+                    and dt.size * cnt == arr.nbytes:
+                raw = arr      # pack lazily; rendezvous may never copy it
+                data = None
+            else:
+                data = Convertor(arr, dt, cnt).pack() if cnt else b""
         req = Request()
+        nbytes = raw.nbytes if raw is not None else len(data)
         req.status.source = self.rank
         req.status.tag = tag
-        req.status.count = len(data)
+        req.status.count = nbytes
         seq = self._send_seq[(cid, dst)]
         self._send_seq[(cid, dst)] = seq + 1
         transport = self.layer.for_peer(dst)
         self.spc.inc("isends")
-        self.spc.inc("bytes_sent", len(data))
-        self.spc.peer_traffic("tx", dst, len(data))
-        if not sync and len(data) <= transport.eager_limit:
+        self.spc.inc("bytes_sent", nbytes)
+        self.spc.peer_traffic("tx", dst, nbytes)
+        if not sync and nbytes <= transport.eager_limit:
             self.spc.inc("eager_sends")
             hdr = {"k": "match", "cid": cid, "tag": tag, "seq": seq,
-                   "size": len(data)}
-            transport.send(dst, T.AM_P2P, hdr, data)
+                   "size": nbytes}
+            transport.send(dst, T.AM_P2P, hdr,
+                           raw.tobytes() if raw is not None else data)
             req.complete()   # eager: locally complete once buffered
             return req
         self.spc.inc("rndv_sends")
         sreq = next(self._sreq)
-        self._pending_send[sreq] = _SendState(req, data, dst)
+        self._pending_send[sreq] = _SendState(req, data, dst, keep=raw)
         hdr = {"k": "rndv", "cid": cid, "tag": tag, "seq": seq,
-               "size": len(data), "sreq": sreq}
+               "size": nbytes, "sreq": sreq}
+        if raw is not None and transport.name == "shm" and self._cma_ok():
+            # single-copy rendezvous (≙ smsc/cma): advertise the user
+            # buffer; the receiver pulls it with process_vm_readv and FINs.
+            # MPI already forbids touching the buffer until completion, so
+            # exposing it until FIN adds no new aliasing.
+            import os as _os
+            hdr["cma"] = (_os.getpid(), int(raw.ctypes.data))
         transport.send(dst, T.AM_P2P, hdr, b"")
         return req
+
+    def _cma_ok(self) -> bool:
+        ok = getattr(self, "_cma_usable", None)
+        if ok is None:
+            from .. import native
+            ok = self._cma_usable = bool(
+                _var.get("smsc_enabled", True) and native.cma_usable())
+        return ok
 
     def send(self, buf, dst: int, tag: int = 0, cid: int = 0,
              datatype: Optional[Datatype] = None, count: Optional[int] = None,
@@ -265,7 +296,21 @@ class P2P:
                     Convertor(arr, dt, cnt).unpack(u.payload)
                 req.status.count = len(u.payload)
                 req.complete()
-            else:  # rendezvous: ACK with a recv-request id, collect FRAGs
+            else:  # rendezvous
+                # single-copy fast path (≙ smsc/cma): pull the sender's
+                # buffer directly, FIN instead of ACK+FRAGs
+                cma = u.header.get("cma")
+                if cma is not None and dinfo is None and dt.is_contiguous \
+                        and arr.flags["C_CONTIGUOUS"] \
+                        and u.header["size"] <= arr.nbytes \
+                        and self._cma_pull(cma, arr, u.header["size"]):
+                    req.status.count = u.header["size"]
+                    self.layer.send(u.src, T.AM_P2P,
+                                    {"k": "fin", "sreq": u.header["sreq"]},
+                                    b"")
+                    req.complete()
+                    return
+                # fragment path: ACK with a recv-request id, collect FRAGs
                 rreq = next(self._rreq)
                 if dinfo is not None:
                     sink = _PackedSink(u.header["size"])
@@ -400,6 +445,10 @@ class P2P:
                 state.req.complete()
             else:
                 self._stream_frags(src, header["rreq"], state)
+        elif k == "fin":             # CMA single-copy done: nothing to stream
+            state = self._pending_send.pop(header["sreq"])
+            state.keep = None
+            state.req.complete()
         elif k == "frag":
             state = self._pending_recv[header["rreq"]]
             state.conv.set_position(header["off"])
@@ -413,9 +462,38 @@ class P2P:
         else:
             raise RuntimeError(f"unknown p2p frame kind {k!r}")
 
+    def _cma_pull(self, cma, arr: np.ndarray, size: int) -> bool:
+        """Read the sender's exposed buffer via process_vm_readv; False
+        falls back to the fragment protocol."""
+        import ctypes
+
+        from .. import native
+        lib = native.load()
+        if lib is None:
+            return False
+        if getattr(self, "_cma_recv_off", False):
+            return False
+        pid, addr = int(cma[0]), int(cma[1])
+        dest = arr.reshape(-1).view(np.uint8)
+        got = lib.cma_read(
+            pid, addr,
+            dest.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), size)
+        if got == size:
+            # (bytes_recvd/peer matrix already counted by on_match)
+            self.spc.inc("cma_single_copies")
+            return True
+        import errno
+        if got == -errno.EPERM:
+            # ptrace policy forbids sibling reads here: latch off so later
+            # messages skip the doomed syscall and go straight to frags
+            self._cma_recv_off = True
+        return False
+
     def _stream_frags(self, dst: int, rreq: int, state: _SendState) -> None:
         transport = self.layer.for_peer(dst)
         chunk = transport.max_send_size
+        if state.data is None and state.keep is not None:
+            state.data = state.keep.tobytes()   # CMA declined: pack now
         data = state.data
         if not data:
             state.req.complete()

@@ -78,6 +78,10 @@ class Context:
         # when the user constructs Context directly instead of runtime.init()
         from .core.progress import adopt_engine
         adopt_engine(self.engine)
+        from . import memchecker         # registers memchecker_enabled
+        from .core import var as _var
+        if _var.get("memchecker_enabled", False):
+            memchecker.install(self)    # --mca memchecker_enabled 1
 
     def _install_idle_hook(self, mods) -> None:
         """Wire the engine's blocking idle hook: block on the shm doorbell

@@ -173,3 +173,90 @@ class TestShmTransport:
             ctx.p2p.recv(buf, 0, tag=2)
             return bool((buf == np.arange(n, dtype=np.float32)).all())
         assert all(runtime.run_ranks(2, body, timeout=120))
+
+
+class TestCmaSingleCopy:
+    """smsc/cma analog: large contiguous rendezvous transfers pull the
+    sender's buffer with ONE copy (process_vm_readv) instead of streaming
+    fragments through the ring."""
+
+    def test_probe(self):
+        from ompi_tpu import native
+        if not native.available():
+            import pytest
+            pytest.skip("native toolchain unavailable")
+        assert native.load().cma_probe() in (0, 1)
+
+    def test_large_send_uses_single_copy(self):
+        import numpy as np
+
+        from ompi_tpu import native, runtime
+
+        if not native.cma_usable():
+            import pytest
+            pytest.skip("CMA not usable here")
+
+        n = 500_000   # 4 MB > eager limit → rendezvous
+
+        def fn(ctx):
+            c = ctx.comm_world
+            if ctx.rank == 0:
+                c.send(np.arange(n, dtype=np.float64), 1, tag=3)
+                return ctx.spc.get("cma_single_copies")
+            buf = np.zeros(n, np.float64)
+            c.recv(buf, 0, tag=3)
+            np.testing.assert_array_equal(buf, np.arange(n))
+            return ctx.spc.get("cma_single_copies")
+
+        res = runtime.run_ranks(2, fn, timeout=90)
+        assert res[1] >= 1, "receiver did not take the CMA path"
+
+    def test_disabled_falls_back_to_frags(self):
+        import numpy as np
+
+        from ompi_tpu import runtime
+        from ompi_tpu.core import var
+
+        var.registry.set_cli("smsc_enabled", "0")
+        var.registry.reset_cache()
+        try:
+            n = 300_000
+
+            def fn(ctx):
+                c = ctx.comm_world
+                if ctx.rank == 0:
+                    c.send(np.arange(n, dtype=np.float64), 1, tag=4)
+                    return None
+                buf = np.zeros(n, np.float64)
+                c.recv(buf, 0, tag=4)
+                np.testing.assert_array_equal(buf, np.arange(n))
+                return ctx.spc.get("cma_single_copies")
+
+            res = runtime.run_ranks(2, fn, timeout=90)
+            assert res[1] == 0
+        finally:
+            var.registry.clear_cli("smsc_enabled")
+            var.registry.reset_cache()
+
+    def test_noncontiguous_rendezvous_still_correct(self):
+        import numpy as np
+
+        from ompi_tpu import runtime
+        from ompi_tpu.datatype import FLOAT64, Datatype
+
+        dt = Datatype.vector(30_000, 2, 4, FLOAT64).commit()
+
+        def fn(ctx):
+            c = ctx.comm_world
+            if ctx.rank == 0:
+                c.send(np.arange(120_000, dtype=np.float64), 1,
+                       datatype=dt, count=1)
+                return None
+            buf = np.zeros(60_000, np.float64)
+            c.recv(buf, 0)
+            return buf
+
+        res = runtime.run_ranks(2, fn, timeout=90)
+        expect = np.arange(120_000, dtype=np.float64).reshape(
+            30_000, 4)[:, :2].ravel()
+        np.testing.assert_array_equal(res[1], expect)

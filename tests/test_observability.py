@@ -163,3 +163,78 @@ def test_monitoring_osc_class(tmp_path):
 
     res = runtime.run_ranks(2, body, timeout=60)
     assert res[0] and res[0][1][1] == 32    # 4 float64 put to peer 1
+
+
+def test_memchecker_detects_send_buffer_modification():
+    """≙ memchecker/valgrind modify-while-in-flight detection (SURVEY §5.2):
+    touching the send buffer while a rendezvous send is pending is
+    reported; a clean exchange reports nothing."""
+    from ompi_tpu import memchecker
+
+    def body(ctx):
+        rep = memchecker.install(ctx)
+        comm = ctx.comm_world
+        n = 200_000                       # > eager limit → pending send
+        if ctx.rank == 0:
+            buf = np.zeros(n)
+            req = comm.isend(buf, 1, tag=1)
+            buf[0] = 777.0                # ILLEGAL: modify while in flight
+            req.wait()
+            return list(rep.findings)
+        recv = np.zeros(n)
+        comm.recv(recv, 0, tag=1)
+        return list(rep.findings)
+
+    res = runtime.run_ranks(2, body, timeout=90)
+    assert any("MODIFIED" in f for f in res[0]), res[0]
+    assert res[1] == []
+
+
+def test_memchecker_poisons_recv_buffer():
+    """Read-before-receive: the posted buffer carries the poison pattern
+    until the message lands; afterwards it carries the payload."""
+    from ompi_tpu import memchecker
+
+    def body(ctx):
+        memchecker.install(ctx)
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            buf = np.zeros(8)
+            req = comm.irecv(buf, 1, tag=2)
+            early = memchecker.poisoned_fraction(buf)   # before completion
+            req.wait()
+            late = memchecker.poisoned_fraction(buf)
+            np.testing.assert_array_equal(buf, np.arange(8))
+            return early, late
+        import time
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 0.3:
+            ctx.engine.progress()
+        comm.send(np.arange(8, dtype=np.float64), 0, tag=2)
+        return None
+
+    res = runtime.run_ranks(2, body, timeout=60)
+    early, late = res[0]
+    assert early == 1.0           # fully poisoned pre-delivery
+    assert late < 0.5             # payload overwrote the poison
+
+
+def test_memchecker_eager_modify_detected_next_pass():
+    """Eager sends complete immediately, but modifying the buffer in the
+    same tick is still caught on the next engine pass."""
+    from ompi_tpu import memchecker
+
+    def body(ctx):
+        rep = memchecker.install(ctx)
+        comm = ctx.comm_world
+        if ctx.rank == 0:
+            buf = np.zeros(4)
+            comm.isend(buf, 1, tag=9)       # eager: done on return
+            buf[0] = 5.0                    # same-tick modification
+            ctx.engine.progress()           # drain pass
+            return list(rep.findings)
+        comm.recv(np.zeros(4), 0, tag=9)
+        return None
+
+    res = runtime.run_ranks(2, body, timeout=60)
+    assert any("eager" in f for f in res[0]), res[0]
