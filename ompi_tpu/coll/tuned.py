@@ -43,7 +43,7 @@ from ..core.component import Component, component
 from ..op import Op
 from ..p2p.request import wait_all
 from .basic import BasicModule, T_ALLGATHER, T_ALLTOALL, T_BARRIER, T_BCAST, \
-    T_REDUCE, T_RSCAT, T_SCAN, _inplace
+    T_GATHER, T_REDUCE, T_RSCAT, T_SCAN, T_SCATTER, _inplace
 from .framework import CollModule
 
 
@@ -499,6 +499,146 @@ def reduce_binomial(comm, send: np.ndarray, recv: Optional[np.ndarray],
     return recv
 
 
+def reduce_pipeline(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                    op: Op, root: int, segsize: int) -> Optional[np.ndarray]:
+    """coll_base_reduce.c:414 — segmented chain toward the root: each rank
+    receives its child's partial segment, folds it (own value as the LEFT
+    operand, so the fold is associativity-equivalent to the canonical
+    order), and forwards — segment k+1 arrives while segment k reduces.
+    Like every segmented algorithm, valid for ELEMENTWISE ops only (all
+    MPI predefined ops are; whole-buffer user ops go through the in-order
+    tree instead)."""
+    size, rank = comm.size, comm.rank
+    vrank = (rank - root) % size
+    acc = np.asarray(send).copy()
+    flat = acc.reshape(-1)
+    segs = _segments(flat, segsize)
+    child = ((vrank + 1) + root) % size if vrank + 1 < size else None
+    parent = ((vrank - 1) + root) % size if vrank > 0 else None
+    rreqs = []
+    if child is not None:
+        inboxes = [np.empty_like(s) for s in segs]
+        rreqs = [comm.irecv(b, child, T_REDUCE) for b in inboxes]
+    sreqs = []
+    for j, s in enumerate(segs):
+        if child is not None:
+            rreqs[j].wait()
+            s[...] = op(s.copy(), inboxes[j])   # own left, child right
+        if parent is not None:
+            sreqs.append(comm.isend(s, parent, T_REDUCE))
+    wait_all(sreqs)
+    if rank != root:
+        return None
+    if recv is None:
+        recv = np.empty_like(np.asarray(send))
+    recv[...] = acc
+    return recv
+
+
+def gather_binomial(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                    root: int) -> Optional[np.ndarray]:
+    """coll_base_gather.c:41 — binomial tree: each internal node forwards
+    its whole contiguous vrank-subtree block in one message (log p rounds,
+    vs p-1 messages at the linear root)."""
+    size, rank = comm.size, comm.rank
+    vrank = (rank - root) % size
+    row = np.asarray(send).reshape(-1)
+    # scratch = only MY subtree (lowbit(vrank) rows; the root holds all):
+    # a leaf allocates 1 row, not O(p·n) (r2 review finding)
+    subtree = size if vrank == 0 else min(vrank & -vrank, size - vrank)
+    work = np.empty((subtree, row.size), row.dtype)
+    work[0] = row
+    mask = 1
+    while mask < size:
+        if vrank & mask:
+            parent = ((vrank & ~mask) + root) % size
+            comm.send(work[:min(mask, size - vrank)], parent, T_GATHER)
+            return None
+        vchild = vrank | mask
+        if vchild < size:
+            cnt = min(mask, size - vchild)
+            comm.recv(work[mask:mask + cnt], (vchild + root) % size,
+                      T_GATHER)
+        mask <<= 1
+    if recv is None:
+        recv = np.empty((size,) + np.asarray(send).shape, row.dtype)
+    out = recv.reshape(size, -1)
+    for v in range(size):            # un-rotate vrank order → global ranks
+        out[(v + root) % size] = work[v]
+    return recv
+
+
+def scatter_binomial(comm, send: Optional[np.ndarray], recv: np.ndarray,
+                     root: int) -> np.ndarray:
+    """coll_base_scatter.c:63 — the gather tree reversed: the root peels
+    off subtree blocks; each internal node forwards its children's."""
+    size, rank = comm.size, comm.rank
+    vrank = (rank - root) % size
+    recv = np.asarray(recv)
+    blk = recv.reshape(-1).size
+    if vrank == 0:
+        parts = np.asarray(send).reshape(size, -1)
+        work = np.empty((size, blk), parts.dtype)
+        for g in range(size):        # rotate global ranks → vrank order
+            work[(g - root) % size] = parts[g]
+    else:
+        # my subtree block arrives from the parent in one message
+        sub = 1
+        while not (vrank & sub):
+            sub <<= 1
+        cnt = min(sub, size - vrank)
+        work = np.empty((cnt, blk), recv.dtype)
+        parent = ((vrank & ~sub) + root) % size
+        comm.recv(work, parent, T_SCATTER)
+    mask = 1
+    while mask < size and not (vrank & mask):
+        mask <<= 1
+    m = mask >> 1
+    while m >= 1:                    # forward sub-blocks, farthest first
+        vchild = vrank | m
+        if vchild < size:
+            cnt = min(m, size - vchild)
+            comm.send(np.ascontiguousarray(work[m:m + cnt]),
+                      (vchild + root) % size, T_SCATTER)
+        m >>= 1
+    recv.reshape(-1)[:] = work[0]
+    return recv
+
+
+def barrier_double_ring(comm) -> None:
+    """coll_base_barrier.c:116 — the token circles twice; 2p messages but
+    only nearest-neighbor links (the topology-friendliest barrier)."""
+    size, rank = comm.size, comm.rank
+    token = np.zeros(0, np.uint8)
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for _round in range(2):
+        if rank == 0:
+            comm.send(token, right, T_BARRIER)
+            comm.recv(token, left, T_BARRIER)
+        else:
+            comm.recv(token, left, T_BARRIER)
+            comm.send(token, right, T_BARRIER)
+
+
+def allgatherv_ring(comm, send: np.ndarray, recv: np.ndarray,
+                    counts: Sequence[int], displs: Sequence[int]) -> None:
+    """coll_base_allgatherv.c:371 — the ring schedule with per-rank block
+    sizes; p-1 neighbor exchanges instead of the basic component's p-1
+    point-to-point pairs per rank."""
+    size, rank = comm.size, comm.rank
+    flat = recv.reshape(-1)
+    flat[displs[rank]:displs[rank] + counts[rank]] = \
+        np.asarray(send).reshape(-1)
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        s = (rank - step) % size
+        d = (rank - step - 1) % size
+        inbox = np.empty(counts[d], flat.dtype)
+        comm.sendrecv(flat[displs[s]:displs[s] + counts[s]], right,
+                      inbox, left, T_ALLGATHER, T_ALLGATHER)
+        flat[displs[d]:displs[d] + counts[d]] = inbox
+
+
 # ---------------------------------------------------------------------------
 # allgather / alltoall / reduce_scatter / barrier
 # ---------------------------------------------------------------------------
@@ -779,10 +919,13 @@ _var.register("coll", "tuned", "dynamic_rules", "", type=str, level=4,
 for _coll, _algs in {
     "allreduce": "recursive_doubling|ring|segmented_ring|rabenseifner",
     "bcast": "binomial|knomial|pipeline|chain|scatter_allgather",
-    "reduce": "binomial|inorder_binary",
+    "reduce": "binomial|inorder_binary|pipeline",
     "allgather": "recursive_doubling|ring|neighbor_exchange|bruck",
     "alltoall": "pairwise|bruck",
     "reduce_scatter_block": "recursive_halving|butterfly",
+    "gather": "binomial|linear",
+    "scatter": "binomial|linear",
+    "barrier": "recursive_doubling|double_ring",
 }.items():
     _var.register("coll", "tuned", f"{_coll}_algorithm", "", type=str, level=3,
                   help=f"Force the {_coll} algorithm ({_algs}; empty = auto).")
@@ -792,6 +935,8 @@ for _coll, _algs in {
 # TUNE_SWEEP.json (tools/coll_tune.py), not guesses.
 _var.register("coll", "tuned", "allreduce_segsize", 256 << 10, type=int,
               level=4, help="Segment bytes for segmented-ring allreduce.")
+_var.register("coll", "tuned", "reduce_segsize", 256 << 10, type=int,
+              level=4, help="Segment bytes for pipeline reduce.")
 _var.register("coll", "tuned", "bcast_segsize", 128 << 10, type=int,
               level=4, help="Segment bytes for pipeline/chain bcast.")
 _var.register("coll", "tuned", "bcast_chains", 4, type=int, level=4,
@@ -907,10 +1052,57 @@ class TunedModule(CollModule):
             # in-order binary tree keeps the canonical fold order at
             # log(p) depth (vs the linear gather fallback)
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
-        alg = self._pick("reduce", comm, send.nbytes, "binomial")
+        # pipeline wins the bandwidth regime (segmented chain overlaps
+        # wire and fold), binomial the latency regime
+        alg = self._pick("reduce", comm, send.nbytes,
+                         "binomial" if send.nbytes <= (1 << 17)
+                         else "pipeline")
         if alg == "inorder_binary":
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
+        if alg == "pipeline":
+            return reduce_pipeline(
+                comm, send, recvbuf, op, root,
+                int(_var.get("coll_tuned_reduce_segsize", 256 << 10)))
         return reduce_binomial(comm, send, recvbuf, op, root)
+
+    def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        if comm.size == 1:
+            return self.basic.gather(comm, sendbuf, recvbuf, root)
+        alg = self._pick("gather", comm,
+                         np.asarray(sendbuf).nbytes * comm.size, "binomial")
+        if alg == "linear":
+            return self.basic.gather(comm, sendbuf, recvbuf, root)
+        return gather_binomial(comm, np.asarray(sendbuf), recvbuf, root)
+
+    def scatter(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        if comm.size == 1:
+            return self.basic.scatter(comm, sendbuf, recvbuf, root)
+        if recvbuf is None:
+            if comm.rank != root:
+                raise ValueError("non-root scatter needs recvbuf")
+            sb = np.asarray(sendbuf)
+            recvbuf = np.empty(sb.reshape((comm.size, -1)).shape[1:],
+                               sb.dtype)
+        alg = self._pick("scatter", comm,
+                         np.asarray(recvbuf).nbytes * comm.size, "binomial")
+        if alg == "linear":
+            return self.basic.scatter(comm, sendbuf, recvbuf, root)
+        return scatter_binomial(comm, sendbuf, recvbuf, root)
+
+    def allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
+                   displs=None):
+        if counts is None or comm.size == 1:
+            return self.basic.allgatherv(comm, sendbuf, recvbuf, counts,
+                                         displs)
+        if displs is None:
+            displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]]))
+        if recvbuf is None:
+            # size by the furthest write, not sum(counts): user displs may
+            # leave gaps (same contract as the basic module)
+            total = max(int(d) + int(c) for d, c in zip(displs, counts))
+            recvbuf = np.empty(total, np.asarray(sendbuf).dtype)
+        allgatherv_ring(comm, np.asarray(sendbuf), recvbuf, counts, displs)
+        return recvbuf
 
     def allgather(self, comm, sendbuf, recvbuf=None):
         sendbuf = np.asarray(sendbuf)
@@ -972,7 +1164,12 @@ class TunedModule(CollModule):
         return recvbuf
 
     def barrier(self, comm):
-        if comm.size > 1:
+        if comm.size <= 1:
+            return
+        alg = self._pick("barrier", comm, 0, "recursive_doubling")
+        if alg == "double_ring":
+            barrier_double_ring(comm)
+        else:
             barrier_recursive_doubling(comm)
 
     def scan(self, comm, sendbuf, recvbuf=None, op: Op = None):

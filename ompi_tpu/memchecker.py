@@ -8,8 +8,11 @@ caught directly:
 
   * **modify-while-in-flight**: MPI forbids touching a send buffer while a
     nonblocking send is pending. The send buffer is checksummed at post
-    and re-checked at completion (and for eager sends at the next engine
-    pass) — a mismatch is reported with the peer/tag.
+    and re-checked at completion — a mismatch is reported with the
+    peer/tag. Eager sends are exempt by construction here: the payload is
+    snapshotted into an immutable frame before isend returns, so
+    post-return reuse (legal — the request is already complete) can never
+    corrupt the message.
   * **read-before-receive**: the receive buffer is poisoned with a
     recognizable byte pattern at post; any value the application reads
     before completion is loudly garbage rather than stale plausible data,
@@ -63,25 +66,6 @@ def install(ctx) -> Report:
     ctx._memchecker = rep
     p2p = ctx.p2p
     orig_isend, orig_irecv = p2p.isend, p2p.irecv
-    eager_pending: List = []     # (buf, crc, dst, tag) re-checked next pass
-
-    def _drain_eager() -> int:
-        # eager sends complete immediately, but the frame may still sit in
-        # the transport ring; one engine pass later is the earliest honest
-        # re-check point for modify-after-isend bugs
-        while eager_pending:
-            buf, before, dst, tag = eager_pending.pop()
-            if _crc(buf) != before:
-                rep.add(f"send buffer to rank {dst} (tag {tag}) was "
-                        f"MODIFIED right after an eager isend — the "
-                        f"transport may not have flushed it yet")
-        return 0
-
-    # high priority: low-pri callbacks only run every Nth pass, and the
-    # check should fire on the FIRST pass after the modification (no-op
-    # per pass when nothing is pending — this is a debug build anyway)
-    ctx.engine.register(_drain_eager)
-    ctx._memchecker_drain = _drain_eager
 
     def isend(buf, dst, *a, **kw):
         try:
@@ -96,9 +80,12 @@ def install(ctx) -> Report:
                 rep.add(f"send buffer to rank {dst} (tag {tag}) was "
                         f"MODIFIED while the send was in flight — MPI "
                         f"forbids touching it before completion")
-        if req.done:
-            eager_pending.append((buf, before, dst, tag))
-        else:
+        if not req.done:
+            # pending (rendezvous/CMA) sends only: an eager request is
+            # complete at return and its payload was snapshotted into an
+            # immutable frame before isend returned, so later buffer reuse
+            # is legal AND harmless — flagging it would cry wolf on
+            # conforming programs
             req.add_completion_callback(check)
         return req
 
@@ -121,10 +108,6 @@ def uninstall(ctx) -> None:
     if orig is not None:
         ctx.p2p.isend, ctx.p2p.irecv = orig
         del ctx._memchecker_orig
-    drain = getattr(ctx, "_memchecker_drain", None)
-    if drain is not None:
-        ctx.engine.unregister(drain)
-        del ctx._memchecker_drain
     if getattr(ctx, "_memchecker", None) is not None:
         del ctx._memchecker
 

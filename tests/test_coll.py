@@ -486,3 +486,79 @@ def test_reduce_inorder_binary_noncommutative(size, root):
     for i, r in enumerate(res):
         if i != root:
             assert r is None
+
+
+@pytest.mark.parametrize("size,root", [(3, 0), (5, 2), (8, 1)])
+def test_gather_scatter_binomial(size, root):
+    _force("coll_tuned_gather_algorithm", "binomial")
+    _force("coll_tuned_scatter_algorithm", "binomial")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            mine = np.full(3, float(c.rank))
+            gat = c.coll.gather(c, mine, root=root)
+            if c.rank == root:
+                expect = np.stack([np.full(3, float(r))
+                                   for r in range(size)])
+                np.testing.assert_array_equal(
+                    np.asarray(gat).reshape(size, 3), expect)
+            src = (np.arange(size * 2, dtype=np.float64) * 10
+                   if c.rank == root else None)
+            out = np.zeros(2)
+            c.coll.scatter(c, src, out, root=root)
+            np.testing.assert_array_equal(
+                out, np.array([c.rank * 2, c.rank * 2 + 1]) * 10.0)
+            return True
+
+        assert all(runtime.run_ranks(size, fn))
+    finally:
+        _force("coll_tuned_gather_algorithm", "")
+        _force("coll_tuned_scatter_algorithm", "")
+
+
+@pytest.mark.parametrize("size", [3, 4])
+def test_reduce_pipeline_segmented(size):
+    _force("coll_tuned_reduce_algorithm", "pipeline")
+    _force("coll_tuned_reduce_segsize", "4096")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(5000, dtype=np.float64) * (c.rank + 1)
+            out = np.zeros(5000) if c.rank == 1 else None
+            return c.coll.reduce(c, send, out, root=1)
+
+        res = runtime.run_ranks(size, fn)
+        expect = sum(np.arange(5000, dtype=np.float64) * (r + 1)
+                     for r in range(size))
+        np.testing.assert_allclose(res[1], expect)
+        assert all(r is None for i, r in enumerate(res) if i != 1)
+    finally:
+        _force("coll_tuned_reduce_algorithm", "")
+        _force("coll_tuned_reduce_segsize", str(256 << 10))
+
+
+def test_barrier_double_ring():
+    _force("coll_tuned_barrier_algorithm", "double_ring")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            for _ in range(3):
+                c.coll.barrier(c)
+            return True
+
+        assert all(runtime.run_ranks(4, fn))
+    finally:
+        _force("coll_tuned_barrier_algorithm", "")
+
+
+def test_allgatherv_ring_variant():
+    def fn(ctx):
+        c = world(ctx)
+        counts = [2, 1, 3]
+        mine = np.full(counts[c.rank], float(c.rank))
+        out = c.coll.allgatherv(c, mine, counts=counts)
+        np.testing.assert_array_equal(np.asarray(out),
+                                      [0, 0, 1, 2, 2, 2])
+        return True
+
+    assert all(runtime.run_ranks(3, fn))

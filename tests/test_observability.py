@@ -219,9 +219,10 @@ def test_memchecker_poisons_recv_buffer():
     assert late < 0.5             # payload overwrote the poison
 
 
-def test_memchecker_eager_modify_detected_next_pass():
-    """Eager sends complete immediately, but modifying the buffer in the
-    same tick is still caught on the next engine pass."""
+def test_memchecker_eager_reuse_is_legal():
+    """Post-return reuse of an EAGER send buffer is conforming (the request
+    completes at isend and the payload was snapshotted) — the checker must
+    NOT cry wolf on it."""
     from ompi_tpu import memchecker
 
     def body(ctx):
@@ -229,12 +230,13 @@ def test_memchecker_eager_modify_detected_next_pass():
         comm = ctx.comm_world
         if ctx.rank == 0:
             buf = np.zeros(4)
-            comm.isend(buf, 1, tag=9)       # eager: done on return
-            buf[0] = 5.0                    # same-tick modification
-            ctx.engine.progress()           # drain pass
+            req = comm.isend(buf, 1, tag=9)     # eager: done on return
+            assert req.done
+            buf[0] = 5.0                        # LEGAL reuse
+            ctx.engine.progress()
             return list(rep.findings)
         comm.recv(np.zeros(4), 0, tag=9)
         return None
 
     res = runtime.run_ranks(2, body, timeout=60)
-    assert any("eager" in f for f in res[0]), res[0]
+    assert res[0] == [], res[0]
