@@ -662,17 +662,11 @@ def allgather_recursive_doubling(comm, send: np.ndarray,
 
 
 def allgather_ring(comm, send: np.ndarray, recv: np.ndarray) -> None:
-    """coll_base_allgather.c:330."""
-    size, rank = comm.size, comm.rank
-    parts = recv.reshape(size, -1)
-    parts[rank] = send.reshape(-1)
-    right, left = (rank + 1) % size, (rank - 1) % size
-    for step in range(size - 1):
-        s = (rank - step) % size
-        r = (rank - step - 1) % size
-        inbox = np.empty_like(parts[r])
-        comm.sendrecv(parts[s], right, inbox, left, T_ALLGATHER, T_ALLGATHER)
-        parts[r] = inbox
+    """coll_base_allgather.c:330 — the uniform-counts case of the ring
+    schedule (one implementation, see allgatherv_ring)."""
+    n = recv.reshape(comm.size, -1).shape[1]
+    allgatherv_ring(comm, send, recv, [n] * comm.size,
+                    [i * n for i in range(comm.size)])
 
 
 def allgather_bruck(comm, send: np.ndarray, recv: np.ndarray) -> None:
@@ -925,6 +919,7 @@ for _coll, _algs in {
     "reduce_scatter_block": "recursive_halving|butterfly",
     "gather": "binomial|linear",
     "scatter": "binomial|linear",
+    "allgatherv": "ring|linear",
     "barrier": "recursive_doubling|double_ring",
 }.items():
     _var.register("coll", "tuned", f"{_coll}_algorithm", "", type=str, level=3,
@@ -1052,11 +1047,10 @@ class TunedModule(CollModule):
             # in-order binary tree keeps the canonical fold order at
             # log(p) depth (vs the linear gather fallback)
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
-        # pipeline wins the bandwidth regime (segmented chain overlaps
-        # wire and fold), binomial the latency regime
-        alg = self._pick("reduce", comm, send.nbytes,
-                         "binomial" if send.nbytes <= (1 << 17)
-                         else "pipeline")
+        # sweep (TUNE_SWEEP.json, 4 ranks, ONE core): binomial wins at all
+        # sizes — the pipeline's wire/fold overlap needs ranks on their own
+        # cores to pay off, so it stays selectable, not default
+        alg = self._pick("reduce", comm, send.nbytes, "binomial")
         if alg == "inorder_binary":
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
         if alg == "pipeline":
@@ -1068,8 +1062,12 @@ class TunedModule(CollModule):
     def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
         if comm.size == 1:
             return self.basic.gather(comm, sendbuf, recvbuf, root)
-        alg = self._pick("gather", comm,
-                         np.asarray(sendbuf).nbytes * comm.size, "binomial")
+        # sweep: binomial wins the latency regime, linear the bandwidth one
+        # (interior nodes re-forward subtree data the linear root receives
+        # once)
+        alg = self._pick("gather", comm, np.asarray(sendbuf).nbytes,
+                         "binomial" if np.asarray(sendbuf).nbytes <= (1 << 13)
+                         else "linear")
         if alg == "linear":
             return self.basic.gather(comm, sendbuf, recvbuf, root)
         return gather_binomial(comm, np.asarray(sendbuf), recvbuf, root)
@@ -1083,15 +1081,22 @@ class TunedModule(CollModule):
             sb = np.asarray(sendbuf)
             recvbuf = np.empty(sb.reshape((comm.size, -1)).shape[1:],
                                sb.dtype)
+        # sweep: linear won at every size on 4 ranks (forwarding doubles
+        # interior bytes); binomial stays selectable for large rank counts
+        # where the root's p-1 sends become the bottleneck
         alg = self._pick("scatter", comm,
-                         np.asarray(recvbuf).nbytes * comm.size, "binomial")
-        if alg == "linear":
-            return self.basic.scatter(comm, sendbuf, recvbuf, root)
-        return scatter_binomial(comm, sendbuf, recvbuf, root)
+                         np.asarray(recvbuf).nbytes, "linear")
+        if alg == "binomial":
+            return scatter_binomial(comm, sendbuf, recvbuf, root)
+        return self.basic.scatter(comm, sendbuf, recvbuf, root)
 
     def allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
                    displs=None):
         if counts is None or comm.size == 1:
+            return self.basic.allgatherv(comm, sendbuf, recvbuf, counts,
+                                         displs)
+        nbytes = int(np.sum(counts)) * np.asarray(sendbuf).dtype.itemsize
+        if self._pick("allgatherv", comm, nbytes, "ring") == "linear":
             return self.basic.allgatherv(comm, sendbuf, recvbuf, counts,
                                          displs)
         if displs is None:

@@ -1,0 +1,69 @@
+"""Generic init/finalize interposition hooks (≙ ompi/mca/hook).
+
+The reference's hook framework lets components interpose on runtime
+bring-up/teardown without touching the core (mpi_init top/bottom,
+mpi_finalize top/bottom); its shipped component ``comm_method`` prints the
+per-peer transport matrix (hook_comm_method_fns.c:25). Same shape here:
+hook components register through the standard component registry and
+implement any subset of the event methods; the runtime fires the events at
+the matching points.
+
+Events: ``init_bottom`` (Context fully constructed), ``finalize_top``
+(before transports drain). Add-on tools can register at runtime:
+
+    @component("hook", "mytool", priority=10)
+    class MyHook(Component):
+        def query(self, scope):
+            return self.priority, self
+        def finalize_top(self, ctx): ...
+"""
+
+from __future__ import annotations
+
+from .core import var as _var
+from .core.component import Component, component, frameworks
+
+EVENTS = ("init_bottom", "finalize_top")
+
+_var.register("hook", "comm_method", "enabled", False, type=bool, level=3,
+              help="Print which transport serves each wired peer at "
+                   "finalize (≙ the hook/comm_method component).")
+
+
+def fire(event: str, ctx) -> None:
+    """Invoke ``event`` on every selected hook component (failures are
+    reported, never fatal — a diagnostics hook must not take the job
+    down)."""
+    from .core.output import output
+    try:
+        rows = frameworks.framework("hook").select_all(ctx)
+    except Exception as exc:
+        output.verbose(1, "hook",
+                       f"hook selection failed; all hooks skipped: {exc}")
+        return
+    for _pri, comp, module in rows:
+        fn = getattr(module, event, None)
+        if fn is None:
+            continue
+        try:
+            fn(ctx)
+        except Exception as exc:
+            output.verbose(1, "hook",
+                           f"component {comp.name} {event} failed: {exc}")
+
+
+@component("hook", "comm_method", priority=10)
+class CommMethodHook(Component):
+    """≙ hook/comm_method: the transport-selection matrix dump."""
+
+    def query(self, scope):
+        return self.priority, self
+
+    def finalize_top(self, ctx) -> None:
+        if not _var.get("hook_comm_method_enabled", False):
+            return
+        matrix = ctx.layer.transport_matrix()
+        lines = [f"comm_method (rank {ctx.rank}): peer → transport"]
+        for peer, name in sorted(matrix.items()):
+            lines.append(f"  {peer:4d} → {name}")
+        print("\n".join(lines), flush=True)

@@ -82,6 +82,8 @@ class Context:
         from .core import var as _var
         if _var.get("memchecker_enabled", False):
             memchecker.install(self)    # --mca memchecker_enabled 1
+        from . import hook
+        hook.fire("init_bottom", self)   # ≙ mca/hook mpi_init hooks
 
     def _install_idle_hook(self, mods) -> None:
         """Wire the engine's blocking idle hook: block on the shm doorbell
@@ -121,6 +123,8 @@ class Context:
         if getattr(self, "_monitor", None) is not None:
             from . import monitoring
             monitoring.finalize_dump(self)
+        from . import hook
+        hook.fire("finalize_top", self)  # ≙ mca/hook mpi_finalize hooks
         # Drain transports before fencing: frames parked when a ring/socket
         # was full (e.g. shm's _pending queue) must reach the wire, or a
         # peer still blocked in recv never completes. The reference runs

@@ -30,6 +30,11 @@ ALGS = {
               "scatter_allgather"],
     "allgather": ["recursive_doubling", "ring", "neighbor_exchange", "bruck"],
     "reduce_scatter_block": ["recursive_halving", "butterfly"],
+    "reduce": ["binomial", "pipeline"],
+    "allgatherv": ["ring", "linear"],
+    "gather": ["binomial", "linear"],
+    "scatter": ["binomial", "linear"],
+    "barrier": ["recursive_doubling", "double_ring"],
 }
 
 SIZES = [64, 1024, 16 << 10, 256 << 10, 2 << 20]
@@ -57,6 +62,29 @@ def _run_case(coll: str, alg: str, nbytes: int, ranks: int, iters: int
         elif coll == "reduce_scatter_block":
             buf = np.arange(count - count % ranks, dtype=np.float64)
             call = lambda a: c.coll.reduce_scatter_block(c, buf)  # noqa: E731
+            args = lambda: None                            # noqa: E731
+        elif coll == "reduce":
+            out = np.zeros(count) if c.rank == 0 else None
+            call = lambda a: c.coll.reduce(c, send, out, root=0)  # noqa: E731
+            args = lambda: None                            # noqa: E731
+        elif coll == "gather":
+            call = lambda a: c.coll.gather(c, send, root=0)  # noqa: E731
+            args = lambda: None                            # noqa: E731
+        elif coll == "scatter":
+            big = np.arange(count * ranks, dtype=np.float64) \
+                if c.rank == 0 else None
+            out2 = np.zeros(count)
+            call = lambda a: c.coll.scatter(c, big, out2, root=0)  # noqa: E731
+            args = lambda: None                            # noqa: E731
+        elif coll == "allgatherv":
+            counts = [max(1, count // ranks + (1 if r < count % ranks else 0))
+                      for r in range(ranks)]
+            mine = np.full(counts[c.rank], 1.0)
+            call = lambda a: c.coll.allgatherv(   # noqa: E731
+                c, mine, counts=counts)
+            args = lambda: None                            # noqa: E731
+        elif coll == "barrier":
+            call = lambda a: c.coll.barrier(c)             # noqa: E731
             args = lambda: None                            # noqa: E731
         else:
             call = lambda a: c.coll.allreduce(c, send)     # noqa: E731
@@ -87,7 +115,8 @@ def main(argv=None) -> int:
     rows = []
     winners: dict = {}
     for coll, algs in ALGS.items():
-        for nbytes in SIZES:
+        sizes = SIZES if coll != "barrier" else SIZES[:1]  # no payload
+        for nbytes in sizes:
             best = (None, float("inf"))
             for alg in algs:
                 if alg == "recursive_doubling" and coll == "allgather" \

@@ -240,3 +240,46 @@ def test_memchecker_eager_reuse_is_legal():
 
     res = runtime.run_ranks(2, body, timeout=60)
     assert res[0] == [], res[0]
+
+
+def test_hook_framework_comm_method(capsys):
+    """Generic hook interposition (≙ ompi/mca/hook): a registered component
+    fires at init/finalize; comm_method prints the transport matrix when
+    enabled (hook_comm_method_fns.c:25)."""
+    from ompi_tpu import hook
+    from ompi_tpu.core.component import Component, component
+
+    seen = []
+
+    @component("hook", "probe_test", priority=5)
+    class ProbeHook(Component):
+        def query(self, scope):
+            return self.priority, self
+
+        def init_bottom(self, ctx):
+            seen.append(("init", ctx.rank))
+
+        def finalize_top(self, ctx):
+            seen.append(("fin", ctx.rank))
+
+    var.registry.set_cli("hook_comm_method_enabled", "1")
+    var.registry.reset_cache()
+    try:
+        def body(ctx):
+            c = ctx.comm_world
+            if ctx.rank == 0:
+                c.send(np.zeros(1), 1, tag=1)
+            elif ctx.rank == 1:
+                c.recv(np.zeros(1), 0, tag=1)
+            return True
+
+        assert all(runtime.run_ranks(2, body, timeout=60))
+        kinds = [k for k, _ in seen]
+        assert kinds.count("init") == 2 and kinds.count("fin") == 2
+        out = capsys.readouterr().out
+        assert "comm_method" in out and "shm" in out
+    finally:
+        var.registry.set_cli("hook_comm_method_enabled", "")
+        var.registry.reset_cache()
+        from ompi_tpu.core.component import frameworks
+        frameworks.framework("hook").components.pop("probe_test", None)
