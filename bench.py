@@ -120,8 +120,10 @@ def run_sweep(platform: str) -> dict:
         x.block_until_ready()
         # input rotation (see _time_op): enough distinct resident arrays
         # that no timed call repeats an (executable, input) pair a cache
-        # could serve — bounded by a ~512 MB provisioning budget (large
-        # sizes run few reps anyway, so few inputs suffice)
+        # could serve. Budget: ~256 MB of extra arrays, EXCEPT the floor of
+        # 5 inputs (needed so max_reps = len(xs)-2 ≥ 3) overrides it at the
+        # largest sizes — worst case 5 × rows × 64 MB resident (~2.5 GB in
+        # single-chip rows=8 mode), fine for ≥16 GB HBM parts
         n_inputs = int(max(5, min(22, (1 << 28) // max(nbytes * rows, 1) + 3)))
         xs = [x] + [jax.device_put(jnp.asarray(
             host_rows + np.float32(i)), dc.sharding())

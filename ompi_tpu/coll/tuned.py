@@ -4,20 +4,40 @@
 plus coll/tuned's decision machinery (coll_tuned_decision_fixed.c:55-104,
 dynamic rules file coll_tuned_dynamic_file.c:58).
 
-Algorithms implemented (reference file:line for the original):
-  allreduce: recursive-doubling (coll_base_allreduce.c:133), ring (:344),
+Algorithms implemented (reference file:line for the original; the full
+SURVEY.md Appendix A inventory — linear/in-order baselines live in
+coll/basic.py):
+  allreduce: nonoverlapping reduce+bcast (coll_base_allreduce.c:57),
+             recursive-doubling (:133), ring (:344),
              segmented/pipelined ring (:621),
-             Rabenseifner reduce-scatter+allgather (:973)
-  bcast:     binomial tree (coll_base_bcast.c:333), pipeline (:277),
-             chain (:305), knomial (:720), scatter+allgather (:774)
-  reduce:    binomial tree (coll_base_reduce.c:476),
-             in-order binary for non-commutative ops (:514)
-  allgather: recursive-doubling (coll_base_allgather.c:85), ring (:330),
-             neighbor-exchange (:456), bruck (:767 k=2)
-  reduce_scatter_block: recursive-halving (coll_base_reduce_scatter.c:132),
-             butterfly for any comm size (:691)
-  alltoall:  pairwise (coll_base_alltoall.c:180), bruck (:239)
-  barrier:   recursive-doubling (coll_base_barrier.c:188), bruck (:269)
+             Rabenseifner reduce-scatter+allgather (:973),
+             allgather+local-reduce (:1267)
+  bcast:     pipeline (coll_base_bcast.c:277), chain (:305),
+             binomial tree (:333), split-binary tree (:361),
+             knomial (:720), scatter+allgather[-ring] (:774/:951)
+  reduce:    chain (coll_base_reduce.c:384), pipeline (:414),
+             binomial tree (:476), in-order binary for
+             non-commutative ops (:514), Rabenseifner
+             reduce-scatter+gather (:811), knomial (:1166)
+  allgather: recursive-doubling (coll_base_allgather.c:85),
+             sparbit (:227), ring (:330), neighbor-exchange (:456),
+             two-procs (:570), [k-]bruck (:767),
+             direct-messaging (:930)
+  allgatherv: bruck (coll_base_allgatherv.c:95), sparbit (:259),
+             ring (:371), neighbor-exchange (:498), two-procs (:643)
+  alltoall:  pairwise (coll_base_alltoall.c:180), bruck (:239),
+             linear-sync (:378), two-procs (:537)
+  alltoallv: pairwise (coll_base_alltoallv.c:194)
+  reduce_scatter: recursive-halving (coll_base_reduce_scatter.c:132),
+             ring (:456), butterfly any-size/any-counts (:691)
+  reduce_scatter_block: recursive-halving (:132 adapted),
+             recursive-doubling (coll_base_reduce_scatter_block.c:197),
+             butterfly (:691)
+  barrier:   double-ring (coll_base_barrier.c:116),
+             recursive-doubling/bruck (:188/:269), two-procs (:307),
+             tree (:427)
+  gather:    binomial (coll_base_gather.c:41), linear-sync (:208)
+  scatter:   binomial (coll_base_scatter.c:63), non-blocking linear (:289)
   scan/exscan: recursive-doubling prefix (coll_base_scan.c:157)
 
 Selection: fixed size/msg-size rules, overridable per-collective with the
@@ -800,21 +820,24 @@ def _neighbor_exchange_schedule(size: int):
     return sched
 
 
-def reduce_scatter_block_butterfly(comm, send: np.ndarray,
-                                   recv: np.ndarray, op: Op) -> None:
-    """coll_base_reduce_scatter.c:691 — butterfly for ANY comm size:
-    non-power-of-two remainders fold their full vector into a partner
-    first, the 2^k survivors run recursive vector halving along original-
-    block boundaries, then folded-out ranks get their block back."""
+def reduce_scatter_butterfly(comm, send: np.ndarray, recv: np.ndarray,
+                             counts: Sequence[int], displs: Sequence[int],
+                             op: Op) -> None:
+    """coll_base_reduce_scatter.c:691 — butterfly for ANY comm size and
+    arbitrary per-rank counts: non-power-of-two remainders fold their full
+    vector into a partner first, the 2^k survivors run recursive vector
+    halving along original-block boundaries, then folded-out ranks get
+    their block back."""
     size, rank = comm.size, comm.rank
-    flat = send.reshape(-1).astype(send.dtype, copy=True)
-    blk = flat.size // size
+    flat = np.asarray(send).reshape(-1).astype(send.dtype, copy=True)
+    total = flat.size
     pof2 = 1 << (size.bit_length() - 1)
     rem = size - pof2
+    myview = recv.reshape(-1)
     if rank < 2 * rem:
         if rank % 2 == 0:           # folds out; receives its block at the end
             comm.send(flat, rank + 1, T_RSCAT)
-            comm.recv(recv.reshape(-1), rank + 1, T_RSCAT)
+            comm.recv(myview, rank + 1, T_RSCAT)
             return
         tmp = np.empty_like(flat)
         comm.recv(tmp, rank - 1, T_RSCAT)
@@ -825,6 +848,9 @@ def reduce_scatter_block_butterfly(comm, send: np.ndarray,
 
     def start_block(nr: int) -> int:      # first original block nr represents
         return 2 * nr if nr < rem else nr + rem
+
+    def bound(g: int) -> int:             # element offset of group boundary g
+        return total if g >= pof2 else int(displs[start_block(g)])
 
     glo, ghi = 0, pof2
     mask = pof2 >> 1
@@ -838,9 +864,8 @@ def reduce_scatter_block_butterfly(comm, send: np.ndarray,
         else:
             keep = (glo, gmid)
             send_rng = (gmid, ghi)
-        k_lo, k_hi = start_block(keep[0]) * blk, start_block(keep[1]) * blk
-        s_lo, s_hi = start_block(send_rng[0]) * blk, \
-            start_block(send_rng[1]) * blk
+        k_lo, k_hi = bound(keep[0]), bound(keep[1])
+        s_lo, s_hi = bound(send_rng[0]), bound(send_rng[1])
         inbox = np.empty(k_hi - k_lo, flat.dtype)
         comm.sendrecv(flat[s_lo:s_hi], peer, inbox, peer, T_RSCAT, T_RSCAT)
         seg = flat[k_lo:k_hi]
@@ -850,10 +875,19 @@ def reduce_scatter_block_butterfly(comm, send: np.ndarray,
     # newrank now holds the reduced segment for its original block(s)
     b0 = start_block(newrank)
     if newrank < rem:                     # deliver the even partner's block
-        comm.send(flat[b0 * blk:(b0 + 1) * blk], rank - 1, T_RSCAT)
-        recv.reshape(-1)[:] = flat[(b0 + 1) * blk:(b0 + 2) * blk]
-    else:
-        recv.reshape(-1)[:] = flat[b0 * blk:(b0 + 1) * blk]
+        comm.send(flat[displs[b0]:displs[b0] + counts[b0]], rank - 1, T_RSCAT)
+        b0 += 1
+    myview[:] = flat[displs[b0]:displs[b0] + counts[b0]]
+
+
+def reduce_scatter_block_butterfly(comm, send: np.ndarray,
+                                   recv: np.ndarray, op: Op) -> None:
+    """coll_base_reduce_scatter.c:691, equal-block case (see
+    reduce_scatter_butterfly for the general-counts engine)."""
+    size = comm.size
+    blk = np.asarray(send).reshape(-1).size // size
+    reduce_scatter_butterfly(comm, send, recv, [blk] * size,
+                             [i * blk for i in range(size)], op)
 
 
 def barrier_recursive_doubling(comm) -> None:
@@ -903,6 +937,631 @@ def scan_recursive_doubling(comm, send: np.ndarray, recv: np.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# block-exchange schedule engine (shared by sparbit / bruck / k-bruck /
+# neighbor-exchange allgather[v] variants)
+# ---------------------------------------------------------------------------
+
+_BLOCK_SCHED_CACHE: dict = {}
+
+
+def _block_schedule(size: int, distances: tuple, radix: int):
+    """Precompute a deterministic block-exchange schedule: in the round for
+    distance d, every rank sends to (rank - j*d) % size for j in 1..radix-1
+    all blocks it holds that the receiver neither holds nor has been
+    promised earlier this round, and symmetrically receives from
+    (rank + j*d).  Built by simulating all ranks at once, so both endpoints
+    of every message agree on its block list (and size) by construction —
+    the same determinism argument as the neighbor-exchange schedule.
+
+    Distance-halving distances give sparbit (coll_base_allgather.c:227),
+    distance-doubling gives Bruck without the final rotation (:767 /
+    allgatherv :95) — blocks travel addressed by their ORIGINAL indices, so
+    no rotation pass is needed and per-rank counts may vary freely."""
+    key = (size, distances, radix)
+    cached = _BLOCK_SCHED_CACHE.get(key)
+    if cached is not None:
+        return cached
+    have = {r: {r} for r in range(size)}
+    order = {r: [r] for r in range(size)}   # deterministic block ordering
+    rounds = {r: [] for r in range(size)}
+    for d in distances:
+        snap_order = {r: list(order[r]) for r in range(size)}
+        snap_have = {r: set(have[r]) for r in range(size)}
+        promised = {r: set() for r in range(size)}
+        entry = {r: ([], []) for r in range(size)}
+        for j in range(1, radix):
+            for r in range(size):
+                frm = (r + j * d) % size
+                if frm == r:
+                    continue
+                rb = [b for b in snap_order[frm]
+                      if b not in snap_have[r] and b not in promised[r]]
+                if not rb:
+                    continue
+                promised[r].update(rb)
+                entry[r][1].append((frm, rb))     # my recv
+                entry[frm][0].append((r, rb))     # the matching send
+        for r in range(size):
+            rounds[r].append(entry[r])
+            for _frm, rb in entry[r][1]:
+                for b in rb:
+                    have[r].add(b)
+                    order[r].append(b)
+    assert all(len(have[r]) == size for r in range(size)), \
+        "block schedule incomplete"
+    _BLOCK_SCHED_CACHE[key] = rounds
+    return rounds
+
+
+def _run_block_schedule(comm, rounds, get, tag) -> None:
+    """Execute one rank's schedule; ``get(b)`` returns the (already-sized)
+    destination view for block b — sends concatenate current views, recvs
+    scatter back into them."""
+    for sends, recvs in rounds:
+        rinfo = []
+        for frm, blocks in recvs:
+            views = [get(b).reshape(-1) for b in blocks]
+            inbox = np.empty(int(sum(v.size for v in views)),
+                             views[0].dtype)
+            rinfo.append((comm.irecv(inbox, frm, tag), views, inbox))
+        sreqs = []
+        for to, blocks in sends:
+            out = get(blocks[0]).reshape(-1) if len(blocks) == 1 else \
+                np.concatenate([get(b).reshape(-1) for b in blocks])
+            sreqs.append(comm.isend(out, to, tag))
+        for req, views, inbox in rinfo:
+            req.wait()
+            off = 0
+            for v in views:
+                v[...] = inbox[off:off + v.size]
+                off += v.size
+        wait_all(sreqs)
+
+
+def _doubling_distances(size: int, radix: int = 2) -> tuple:
+    d, out = 1, []
+    while d < size:
+        out.append(d)
+        d *= radix
+    return tuple(out)
+
+
+def allgather_sparbit(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_allgather.c:227 — sparbit: distance-HALVING block
+    exchanges, ceil(log2 p) rounds for any p, no Bruck-style final
+    rotation (blocks are addressed by their original indices)."""
+    size, rank = comm.size, comm.rank
+    parts = recv.reshape(size, -1)
+    parts[rank] = send.reshape(-1)
+    dists = tuple(reversed(_doubling_distances(size)))
+    rounds = _block_schedule(size, dists, 2)[rank]
+    _run_block_schedule(comm, rounds, lambda b: parts[b], T_ALLGATHER)
+
+
+def allgather_kbruck(comm, send: np.ndarray, recv: np.ndarray,
+                     radix: int) -> None:
+    """coll_base_allgather.c:767 — radix-k Bruck: ceil(log_k p) rounds,
+    up to k-1 peers per round (distance-doubling in base k); shallower
+    than k=2 when latency dominates and ports allow concurrent sends."""
+    size, rank = comm.size, comm.rank
+    radix = max(2, radix)
+    parts = recv.reshape(size, -1)
+    parts[rank] = send.reshape(-1)
+    rounds = _block_schedule(size, _doubling_distances(size, radix),
+                             radix)[rank]
+    _run_block_schedule(comm, rounds, lambda b: parts[b], T_ALLGATHER)
+
+
+def allgather_two_procs(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_allgather.c:570 — the 2-rank special case: one sendrecv."""
+    rank = comm.rank
+    parts = recv.reshape(2, -1)
+    parts[rank] = send.reshape(-1)
+    peer = 1 - rank
+    comm.sendrecv(parts[rank], peer, parts[peer], peer,
+                  T_ALLGATHER, T_ALLGATHER)
+
+
+def allgather_direct(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_allgather.c:930 — direct messaging: p-1 concurrent
+    isend/irecv pairs; one round, maximal port pressure."""
+    size, rank = comm.size, comm.rank
+    parts = recv.reshape(size, -1)
+    parts[rank] = send.reshape(-1)
+    reqs = []
+    for peer in range(size):
+        if peer == rank:
+            continue
+        reqs.append(comm.irecv(parts[peer], peer, T_ALLGATHER))
+        reqs.append(comm.isend(parts[rank], peer, T_ALLGATHER))
+    wait_all(reqs)
+
+
+def _v_accessor(flat: np.ndarray, counts: Sequence[int],
+                displs: Sequence[int]):
+    return lambda b: flat[int(displs[b]):int(displs[b]) + int(counts[b])]
+
+
+def allgatherv_bruck(comm, send: np.ndarray, recv: np.ndarray,
+                     counts: Sequence[int], displs: Sequence[int]) -> None:
+    """coll_base_allgatherv.c:95 — Bruck with per-rank counts; the
+    original-index addressing of the schedule engine removes the final
+    rotation the reference needs."""
+    size, rank = comm.size, comm.rank
+    flat = recv.reshape(-1)
+    acc = _v_accessor(flat, counts, displs)
+    acc(rank)[...] = np.asarray(send).reshape(-1)
+    rounds = _block_schedule(size, _doubling_distances(size), 2)[rank]
+    _run_block_schedule(comm, rounds, acc, T_ALLGATHER)
+
+
+def allgatherv_sparbit(comm, send: np.ndarray, recv: np.ndarray,
+                       counts: Sequence[int], displs: Sequence[int]) -> None:
+    """coll_base_allgatherv.c:259 — sparbit with per-rank counts."""
+    size, rank = comm.size, comm.rank
+    flat = recv.reshape(-1)
+    acc = _v_accessor(flat, counts, displs)
+    acc(rank)[...] = np.asarray(send).reshape(-1)
+    dists = tuple(reversed(_doubling_distances(size)))
+    rounds = _block_schedule(size, dists, 2)[rank]
+    _run_block_schedule(comm, rounds, acc, T_ALLGATHER)
+
+
+def allgatherv_neighbor_exchange(comm, send: np.ndarray, recv: np.ndarray,
+                                 counts: Sequence[int],
+                                 displs: Sequence[int]) -> None:
+    """coll_base_allgatherv.c:498 — even comm sizes (caller guards)."""
+    size, rank = comm.size, comm.rank
+    flat = recv.reshape(-1)
+    acc = _v_accessor(flat, counts, displs)
+    acc(rank)[...] = np.asarray(send).reshape(-1)
+    rounds = [([(peer, sb)], [(peer, rb)])
+              for peer, sb, rb in _neighbor_exchange_schedule(size)[rank]]
+    _run_block_schedule(comm, rounds, acc, T_ALLGATHER)
+
+
+def allgatherv_two_procs(comm, send: np.ndarray, recv: np.ndarray,
+                         counts: Sequence[int],
+                         displs: Sequence[int]) -> None:
+    """coll_base_allgatherv.c:643."""
+    rank = comm.rank
+    flat = recv.reshape(-1)
+    acc = _v_accessor(flat, counts, displs)
+    acc(rank)[...] = np.asarray(send).reshape(-1)
+    peer = 1 - rank
+    comm.sendrecv(acc(rank), peer, acc(peer), peer,
+                  T_ALLGATHER, T_ALLGATHER)
+
+
+# ---------------------------------------------------------------------------
+# remaining allreduce / bcast / reduce variants
+# ---------------------------------------------------------------------------
+
+def allreduce_nonoverlapping(comm, send: np.ndarray, recv: np.ndarray,
+                             op: Op) -> None:
+    """coll_base_allreduce.c:57 — reduce to rank 0 then bcast; the plain
+    composition the overlapped algorithms are measured against."""
+    reduce_binomial(comm, send, recv if comm.rank == 0 else None, op, 0)
+    bcast_binomial(comm, recv, 0)
+
+
+def allreduce_allgather_reduce(comm, send: np.ndarray, recv: np.ndarray,
+                               op: Op) -> None:
+    """coll_base_allreduce.c:1267 — allgather every contribution then fold
+    locally in strict rank order: p·n bytes, but a canonical fold, so valid
+    for ANY op including non-commutative ones."""
+    size = comm.size
+    gath = np.empty((size,) + send.shape, send.dtype)
+    allgather_bruck(comm, send, gath)
+    acc = gath[0].copy()
+    for i in range(1, size):
+        acc = op(acc, gath[i])
+    recv[...] = acc
+
+
+def bcast_split_binary(comm, buf: np.ndarray, root: int) -> None:
+    """coll_base_bcast.c:361 — split-binary tree: the message is halved;
+    each half is binomial-bcast down one of the two subtrees hanging off
+    the root, then mirror ranks of the two subtrees swap halves pairwise
+    (every rank sends ~n/2 + receives n, vs n down every tree edge)."""
+    size, rank = comm.size, comm.rank
+    flat = buf.reshape(-1)
+    if size <= 3 or flat.size < 2:
+        return bcast_binomial(comm, buf, root)
+    mid = flat.size // 2
+    halves = (flat[:mid], flat[mid:])
+    vrank = (rank - root) % size
+    nL = size // 2                      # |left group| ≥ |right group|
+    grp = [list(range(1, nL + 1)), list(range(nL + 1, size))]
+
+    def gmap(side: int, idx: int) -> int:
+        return (grp[side][idx] + root) % size
+
+    if vrank == 0:
+        reqs = [comm.isend(halves[s], gmap(s, 0), T_BCAST)
+                for s in (0, 1) if grp[s]]
+        wait_all(reqs)
+        return
+    side = 0 if vrank <= nL else 1
+    idx = vrank - 1 if side == 0 else vrank - 1 - nL
+    m = len(grp[side])
+    parent, children = _binomial_children(idx, m, 0)
+    my = halves[side]
+    src = root if parent is None else gmap(side, parent)
+    comm.recv(my, src, T_BCAST)
+    reqs = [comm.isend(my, gmap(side, c), T_BCAST) for c in reversed(children)]
+    other = 1 - side
+    mo = len(grp[other])
+    if idx < mo:
+        partner = gmap(other, idx)
+        comm.sendrecv(my, partner, halves[other], partner,
+                      T_ALLGATHER, T_ALLGATHER)
+    else:
+        # |L| = |R|+1: the odd left member gets the other half from the
+        # last right-group rank (which serves two left partners)
+        comm.recv(halves[other], gmap(other, mo - 1), T_ALLGATHER)
+    mL, mR = len(grp[0]), len(grp[1])
+    if side == 1 and idx == mR - 1 and mL > mR:
+        comm.send(my, gmap(0, mL - 1), T_ALLGATHER)
+    wait_all(reqs)
+
+
+def reduce_chain(comm, send: np.ndarray, recv: Optional[np.ndarray], op: Op,
+                 root: int, segsize: int, fanout: int) -> Optional[np.ndarray]:
+    """coll_base_reduce.c:384 — ``fanout`` independent segmented chains;
+    each chain pipelines partial folds toward its head, heads stream their
+    segments to the root, which folds across chains (commutative ops only —
+    the dispatcher guards)."""
+    size, rank = comm.size, comm.rank
+    vrank = (rank - root) % size
+    fanout = max(1, min(fanout, size - 1))
+    clen = -(-(size - 1) // fanout)
+    acc = np.asarray(send).copy()
+    flat = acc.reshape(-1)
+    segs = _segments(flat, segsize)
+    if vrank == 0:
+        heads = list(range(0, size - 1, clen))
+        inbox = {h: [np.empty_like(s) for s in segs] for h in heads}
+        rreqs = {h: [comm.irecv(b, (h + 1 + root) % size, T_REDUCE)
+                     for b in inbox[h]] for h in heads}
+        for j, s in enumerate(segs):
+            for h in heads:
+                rreqs[h][j].wait()
+                s[...] = op(inbox[h][j], s)
+        if recv is None:
+            recv = np.empty_like(np.asarray(send))
+        recv[...] = acc
+        return recv
+    idx = vrank - 1
+    pos = idx % clen
+    parent = root if pos == 0 else (idx - 1 + 1 + root) % size
+    child = (idx + 1 + 1 + root) % size \
+        if (pos + 1 < clen and idx + 1 < size - 1) else None
+    rreqs, inboxes = [], []
+    if child is not None:
+        inboxes = [np.empty_like(s) for s in segs]
+        rreqs = [comm.irecv(b, child, T_REDUCE) for b in inboxes]
+    sreqs = []
+    for j, s in enumerate(segs):
+        if child is not None:
+            rreqs[j].wait()
+            s[...] = op(inboxes[j], s)
+        sreqs.append(comm.isend(s, parent, T_REDUCE))
+    wait_all(sreqs)
+    return None
+
+
+def reduce_knomial(comm, send: np.ndarray, recv: Optional[np.ndarray], op: Op,
+                   root: int, radix: int) -> Optional[np.ndarray]:
+    """coll_base_reduce.c:1166 — radix-k tree reduce: log_k p rounds
+    (commutative ops only — the dispatcher guards)."""
+    parent, children = _knomial_tree(comm.rank, comm.size, root,
+                                     max(2, radix))
+    acc = np.asarray(send).copy()
+    tmp = np.empty_like(acc)
+    for c in reversed(children):
+        comm.recv(tmp, c, T_REDUCE)
+        acc = op(tmp, acc)
+    if parent is not None:
+        comm.send(acc, parent, T_REDUCE)
+        return None
+    if recv is None:
+        recv = np.empty_like(np.asarray(send))
+    recv[...] = acc
+    return recv
+
+
+def _halving_span(nr: int, down_to_mask: int, n: int, pof2: int):
+    """Span held after recursive-halving decisions for masks ≥ down_to_mask
+    (spans must be recomputed per rank: halving an odd-length span is
+    uneven)."""
+    blo, bhi = 0, n
+    m = pof2 >> 1
+    while m >= down_to_mask:
+        mid = blo + (bhi - blo) // 2
+        if nr & m:
+            blo = mid
+        else:
+            bhi = mid
+        m >>= 1
+    return blo, bhi
+
+
+def reduce_rabenseifner(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                        op: Op, root: int) -> Optional[np.ndarray]:
+    """coll_base_reduce.c:811 — recursive-halving reduce-scatter followed
+    by a binomial gather of the spans onto the pof2 survivor holding
+    newrank 0, which forwards the result to the root when different (the
+    reference grafts the root into the gather tree; the single extra
+    n-byte hop here trades that bookkeeping away). Commutative only."""
+    size, rank = comm.size, comm.rank
+    flat = np.asarray(send).reshape(-1).copy()
+    pof2 = 1 << (size.bit_length() - 1)
+    rem = size - pof2
+    holder = 1 if rem > 0 else 0         # original rank of newrank 0
+    if rank < 2 * rem:
+        if rank % 2 == 0:
+            comm.send(flat, rank + 1, T_REDUCE)
+            newrank = -1
+        else:
+            tmp = np.empty_like(flat)
+            comm.recv(tmp, rank - 1, T_REDUCE)
+            flat[...] = op(tmp, flat)
+            newrank = rank // 2
+    else:
+        newrank = rank - rem
+    if newrank >= 0:
+        mask = pof2 >> 1
+        lo, hi = 0, flat.size
+        while mask > 0:
+            peer_new = newrank ^ mask
+            peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+            mid = lo + (hi - lo) // 2
+            if newrank & mask:
+                keep_lo, keep_hi, send_lo, send_hi = mid, hi, lo, mid
+            else:
+                keep_lo, keep_hi, send_lo, send_hi = lo, mid, mid, hi
+            inbox = np.empty(keep_hi - keep_lo, flat.dtype)
+            comm.sendrecv(flat[send_lo:send_hi], peer, inbox, peer,
+                          T_RSCAT, T_RSCAT)
+            seg = flat[keep_lo:keep_hi]
+            seg[...] = op(inbox, seg)
+            lo, hi = keep_lo, keep_hi
+            mask >>= 1
+        # binomial gather of spans toward newrank 0
+        mask = 1
+        while mask < pof2:
+            if newrank & mask:
+                peer_new = newrank ^ mask
+                peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+                comm.send(flat[lo:hi], peer, T_GATHER)
+                break
+            peer_new = newrank | mask
+            if peer_new < pof2:
+                peer = peer_new * 2 + 1 if peer_new < rem else peer_new + rem
+                plo, phi = _halving_span(peer_new, mask, flat.size, pof2)
+                comm.recv(flat[plo:phi], peer, T_GATHER)
+                lo, hi = min(lo, plo), max(hi, phi)
+            mask <<= 1
+    if rank == holder and root != holder:
+        comm.send(flat, root, T_REDUCE)
+    if rank == root:
+        if root != holder:
+            comm.recv(flat, holder, T_REDUCE)
+        if recv is None:
+            recv = np.empty_like(np.asarray(send))
+        recv.reshape(-1)[:] = flat
+        return recv
+    return None
+
+
+# ---------------------------------------------------------------------------
+# alltoall[v] variants
+# ---------------------------------------------------------------------------
+
+def alltoall_linear_sync(comm, send: np.ndarray, recv: np.ndarray,
+                         max_outstanding: int) -> None:
+    """coll_base_alltoall.c:378 — linear with a bounded window of
+    outstanding isend/irecv pairs: the next peer's pair is posted only as
+    an earlier one completes (flow control at large fan-out)."""
+    from ..p2p.request import wait_any
+    size, rank = comm.size, comm.rank
+    sp = send.reshape(size, -1)
+    rp = recv.reshape(size, -1)
+    rp[rank] = sp[rank]
+    window = max(1, max_outstanding)
+    pending: list = []
+    for step in range(1, size):
+        peer = (rank + step) % size
+        while len(pending) >= 2 * window:
+            pending.pop(wait_any(pending))
+        pending.append(comm.irecv(rp[peer], peer, T_ALLTOALL))
+        pending.append(comm.isend(sp[peer], peer, T_ALLTOALL))
+    wait_all(pending)
+
+
+def alltoall_two_procs(comm, send: np.ndarray, recv: np.ndarray) -> None:
+    """coll_base_alltoall.c:537."""
+    rank = comm.rank
+    sp = send.reshape(2, -1)
+    rp = recv.reshape(2, -1)
+    rp[rank] = sp[rank]
+    peer = 1 - rank
+    comm.sendrecv(sp[peer], peer, rp[peer], peer, T_ALLTOALL, T_ALLTOALL)
+
+
+def alltoallv_pairwise(comm, sendbuf: np.ndarray, recvbuf: np.ndarray,
+                       sendcounts: Sequence[int], recvcounts: Sequence[int],
+                       sdispls: Sequence[int],
+                       rdispls: Sequence[int]) -> None:
+    """coll_base_alltoallv.c:194 — p-1 offset-paired exchange rounds; one
+    in-flight message per rank per round instead of the linear variant's
+    2(p-1) concurrent requests."""
+    size, rank = comm.size, comm.rank
+    sflat = np.asarray(sendbuf).reshape(-1)
+    rflat = recvbuf.reshape(-1)
+    rflat[rdispls[rank]:rdispls[rank] + recvcounts[rank]] = \
+        sflat[sdispls[rank]:sdispls[rank] + sendcounts[rank]]
+    for step in range(1, size):
+        to = (rank + step) % size
+        frm = (rank - step) % size
+        comm.sendrecv(sflat[sdispls[to]:sdispls[to] + sendcounts[to]], to,
+                      rflat[rdispls[frm]:rdispls[frm] + recvcounts[frm]],
+                      frm, T_ALLTOALL, T_ALLTOALL)
+
+
+# ---------------------------------------------------------------------------
+# reduce_scatter (per-rank counts) variants
+# ---------------------------------------------------------------------------
+
+def reduce_scatter_ring(comm, send: np.ndarray, recv: np.ndarray,
+                        counts: Sequence[int], displs: Sequence[int],
+                        op: Op) -> None:
+    """coll_base_reduce_scatter.c:456 — ring: block b circles from rank
+    b+1 around to its owner, accumulating a contribution at every hop;
+    bandwidth-optimal, p-1 neighbor rounds (commutative only)."""
+    size, rank = comm.size, comm.rank
+    flat = np.asarray(send).reshape(-1).copy()
+    right, left = (rank + 1) % size, (rank - 1) % size
+    for step in range(size - 1):
+        s = (rank - step - 1) % size
+        d = (rank - step - 2) % size
+        inbox = np.empty(int(counts[d]), flat.dtype)
+        comm.sendrecv(flat[displs[s]:displs[s] + counts[s]], right,
+                      inbox, left, T_RSCAT, T_RSCAT)
+        seg = flat[displs[d]:displs[d] + counts[d]]
+        seg[...] = op(inbox, seg)
+    recv.reshape(-1)[:] = flat[displs[rank]:displs[rank] + counts[rank]]
+
+
+def reduce_scatter_recursive_halving(comm, send: np.ndarray,
+                                     recv: np.ndarray,
+                                     counts: Sequence[int],
+                                     displs: Sequence[int], op: Op) -> None:
+    """coll_base_reduce_scatter.c:132 — power-of-two comms, arbitrary
+    counts: vector halving along rank-block boundaries."""
+    size, rank = comm.size, comm.rank
+    flat = np.asarray(send).reshape(-1).copy()
+    total = flat.size
+
+    def bound(b: int) -> int:
+        return total if b >= size else int(displs[b])
+
+    lo_b, hi_b = 0, size
+    mask = size >> 1
+    while mask > 0:
+        peer = rank ^ mask
+        mid_b = lo_b + (hi_b - lo_b) // 2
+        if rank & mask:
+            keep, send_rng = (mid_b, hi_b), (lo_b, mid_b)
+        else:
+            keep, send_rng = (lo_b, mid_b), (mid_b, hi_b)
+        inbox = np.empty(bound(keep[1]) - bound(keep[0]), flat.dtype)
+        comm.sendrecv(flat[bound(send_rng[0]):bound(send_rng[1])], peer,
+                      inbox, peer, T_RSCAT, T_RSCAT)
+        seg = flat[bound(keep[0]):bound(keep[1])]
+        if op.commutative or peer < rank:
+            seg[...] = op(inbox, seg)
+        else:
+            seg[...] = op(seg.copy(), inbox)
+        lo_b, hi_b = keep
+        mask >>= 1
+    recv.reshape(-1)[:] = flat[displs[rank]:displs[rank] + counts[rank]]
+
+
+def reduce_scatter_block_recursive_doubling(comm, send: np.ndarray,
+                                            recv: np.ndarray, op: Op) -> None:
+    """coll_base_reduce_scatter_block.c:197 — power-of-two comms: log p
+    xor-paired rounds over a shrinking alive-set of blocks; each round a
+    rank ships the alive blocks belonging to its peer's half and folds the
+    ones arriving for its own."""
+    size, rank = comm.size, comm.rank
+    parts = send.reshape(size, -1).copy()
+    alive = list(range(size))
+    mask = 1
+    while mask < size:
+        peer = rank ^ mask
+        sel = [b for b in alive if (b & mask) == (peer & mask)]
+        keep = [b for b in alive if (b & mask) == (rank & mask)]
+        inbox = np.empty((len(keep), parts.shape[1]), parts.dtype)
+        comm.sendrecv(np.ascontiguousarray(parts[sel]), peer, inbox, peer,
+                      T_RSCAT, T_RSCAT)
+        if op.commutative or peer < rank:
+            parts[keep] = op(inbox, parts[keep])
+        else:
+            parts[keep] = op(parts[keep].copy(), inbox)
+        alive = keep
+        mask <<= 1
+    recv.reshape(-1)[:] = parts[rank]
+
+
+# ---------------------------------------------------------------------------
+# remaining barrier / gather / scatter variants
+# ---------------------------------------------------------------------------
+
+def barrier_tree(comm) -> None:
+    """coll_base_barrier.c:427 — binomial gather-up then release-down."""
+    rank, size = comm.rank, comm.size
+    token = np.zeros(0, np.uint8)
+    parent, children = _binomial_children(rank, size, 0)
+    for c in children:
+        comm.recv(token, c, T_BARRIER)
+    if parent is not None:
+        comm.send(token, parent, T_BARRIER)
+        comm.recv(token, parent, T_BARRIER)
+    for c in children:
+        comm.send(token, c, T_BARRIER)
+
+
+def barrier_two_procs(comm) -> None:
+    """coll_base_barrier.c:307."""
+    token = np.zeros(0, np.uint8)
+    peer = 1 - comm.rank
+    comm.sendrecv(token, peer, token, peer, T_BARRIER, T_BARRIER)
+
+
+def gather_linear_sync(comm, send: np.ndarray, recv: Optional[np.ndarray],
+                       root: int) -> Optional[np.ndarray]:
+    """coll_base_gather.c:208 — root-paced linear gather: each rank sends
+    only after the root's zero-byte go-ahead, bounding unexpected-message
+    buildup at the root for large payloads."""
+    size, rank = comm.size, comm.rank
+    token = np.zeros(0, np.uint8)
+    if rank != root:
+        comm.recv(token, root, T_GATHER)
+        comm.send(np.asarray(send), root, T_GATHER)
+        return None
+    if recv is None:
+        recv = np.empty((size,) + np.asarray(send).shape,
+                        np.asarray(send).dtype)
+    out = recv.reshape(size, -1)
+    out[root] = np.asarray(send).reshape(-1)
+    for src in range(size):
+        if src == root:
+            continue
+        comm.send(token, src, T_GATHER)
+        comm.recv(out[src], src, T_GATHER)
+    return recv
+
+
+def scatter_linear_nb(comm, send: Optional[np.ndarray], recv: np.ndarray,
+                      root: int) -> np.ndarray:
+    """coll_base_scatter.c:289 — non-blocking linear: the root posts all
+    p-1 isends at once instead of serializing them."""
+    size, rank = comm.size, comm.rank
+    recv = np.asarray(recv)
+    if rank == root:
+        parts = np.asarray(send).reshape(size, -1)
+        reqs = [comm.isend(parts[p], p, T_SCATTER)
+                for p in range(size) if p != root]
+        recv.reshape(-1)[:] = parts[root]
+        wait_all(reqs)
+    else:
+        comm.recv(recv.reshape(-1), root, T_SCATTER)
+    return recv
+
+
+# ---------------------------------------------------------------------------
 # the tuned module: decision rules + dispatch
 # ---------------------------------------------------------------------------
 
@@ -911,16 +1570,24 @@ _var.register("coll", "tuned", "dynamic_rules", "", type=str, level=4,
                    "'<coll> <min_comm_size> <min_bytes> <algorithm>'.")
 
 for _coll, _algs in {
-    "allreduce": "recursive_doubling|ring|segmented_ring|rabenseifner",
-    "bcast": "binomial|knomial|pipeline|chain|scatter_allgather",
-    "reduce": "binomial|inorder_binary|pipeline",
-    "allgather": "recursive_doubling|ring|neighbor_exchange|bruck",
-    "alltoall": "pairwise|bruck",
-    "reduce_scatter_block": "recursive_halving|butterfly",
-    "gather": "binomial|linear",
-    "scatter": "binomial|linear",
-    "allgatherv": "ring|linear",
-    "barrier": "recursive_doubling|double_ring",
+    "allreduce": "recursive_doubling|ring|segmented_ring|rabenseifner"
+                 "|nonoverlapping|allgather_reduce",
+    "bcast": "binomial|knomial|pipeline|chain|scatter_allgather"
+             "|split_binary",
+    "reduce": "binomial|inorder_binary|pipeline|chain|knomial|rabenseifner",
+    "allgather": "recursive_doubling|ring|neighbor_exchange|bruck|sparbit"
+                 "|k_bruck|two_procs|direct|linear",
+    "alltoall": "pairwise|bruck|linear_sync|two_procs|linear",
+    "alltoallv": "pairwise|linear",
+    "reduce_scatter": "nonoverlapping|ring|recursive_halving|butterfly",
+    "reduce_scatter_block": "recursive_halving|butterfly"
+                            "|recursive_doubling",
+    "gather": "binomial|linear|linear_sync",
+    "scatter": "binomial|linear|linear_nb",
+    "allgatherv": "ring|linear|bruck|sparbit|neighbor_exchange|two_procs",
+    "barrier": "recursive_doubling|double_ring|tree|two_procs|bruck",
+    "scan": "recursive_doubling|linear",
+    "exscan": "recursive_doubling|linear",
 }.items():
     _var.register("coll", "tuned", f"{_coll}_algorithm", "", type=str, level=3,
                   help=f"Force the {_coll} algorithm ({_algs}; empty = auto).")
@@ -938,6 +1605,14 @@ _var.register("coll", "tuned", "bcast_chains", 4, type=int, level=4,
               help="Number of chains for chain bcast.")
 _var.register("coll", "tuned", "bcast_knomial_radix", 4, type=int, level=4,
               help="Radix for knomial bcast.")
+_var.register("coll", "tuned", "reduce_knomial_radix", 4, type=int, level=4,
+              help="Radix for knomial reduce.")
+_var.register("coll", "tuned", "reduce_chain_fanout", 4, type=int, level=4,
+              help="Number of chains for chain reduce.")
+_var.register("coll", "tuned", "allgather_kbruck_radix", 4, type=int, level=4,
+              help="Radix for k-Bruck allgather.")
+_var.register("coll", "tuned", "alltoall_sync_requests", 8, type=int, level=4,
+              help="Outstanding isend/irecv pairs for linear-sync alltoall.")
 
 
 def _load_dynamic_rules():
@@ -995,8 +1670,9 @@ class TunedModule(CollModule):
         default = ("recursive_doubling" if nbytes <= (1 << 16) else
                    ("ring" if nbytes <= (1 << 20) else "segmented_ring"))
         alg = self._pick("allreduce", comm, nbytes, default)
-        if send.size < comm.size:   # tiny vectors can't be scattered
-            alg = "recursive_doubling"
+        if send.size < comm.size and alg not in ("nonoverlapping",
+                                                 "allgather_reduce"):
+            alg = "recursive_doubling"  # tiny vectors can't be scattered
         if alg == "ring":
             allreduce_ring(comm, send, recvbuf, op)
         elif alg == "segmented_ring":
@@ -1005,6 +1681,10 @@ class TunedModule(CollModule):
                 int(_var.get("coll_tuned_allreduce_segsize", 256 << 10)))
         elif alg == "rabenseifner":
             allreduce_rabenseifner(comm, send, recvbuf, op)
+        elif alg == "nonoverlapping":
+            allreduce_nonoverlapping(comm, send, recvbuf, op)
+        elif alg == "allgather_reduce":
+            allreduce_allgather_reduce(comm, send, recvbuf, op)
         else:
             allreduce_recursive_doubling(comm, send, recvbuf, op)
         return recvbuf
@@ -1022,6 +1702,8 @@ class TunedModule(CollModule):
         alg = self._pick("bcast", comm, nbytes, default)
         if alg == "scatter_allgather" and buf.size >= comm.size:
             bcast_scatter_allgather(comm, buf, root)
+        elif alg == "split_binary":
+            bcast_split_binary(comm, buf, root)
         elif alg in ("pipeline", "chain"):
             bcast_pipeline(
                 comm, buf, root,
@@ -1057,6 +1739,17 @@ class TunedModule(CollModule):
             return reduce_pipeline(
                 comm, send, recvbuf, op, root,
                 int(_var.get("coll_tuned_reduce_segsize", 256 << 10)))
+        if alg == "chain":
+            return reduce_chain(
+                comm, send, recvbuf, op, root,
+                int(_var.get("coll_tuned_reduce_segsize", 256 << 10)),
+                int(_var.get("coll_tuned_reduce_chain_fanout", 4)))
+        if alg == "knomial":
+            return reduce_knomial(
+                comm, send, recvbuf, op, root,
+                int(_var.get("coll_tuned_reduce_knomial_radix", 4)))
+        if alg == "rabenseifner":
+            return reduce_rabenseifner(comm, send, recvbuf, op, root)
         return reduce_binomial(comm, send, recvbuf, op, root)
 
     def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
@@ -1070,6 +1763,9 @@ class TunedModule(CollModule):
                          else "linear")
         if alg == "linear":
             return self.basic.gather(comm, sendbuf, recvbuf, root)
+        if alg == "linear_sync":
+            return gather_linear_sync(comm, np.asarray(sendbuf), recvbuf,
+                                      root)
         return gather_binomial(comm, np.asarray(sendbuf), recvbuf, root)
 
     def scatter(self, comm, sendbuf, recvbuf=None, root: int = 0):
@@ -1088,6 +1784,8 @@ class TunedModule(CollModule):
                          np.asarray(recvbuf).nbytes, "linear")
         if alg == "binomial":
             return scatter_binomial(comm, sendbuf, recvbuf, root)
+        if alg == "linear_nb":
+            return scatter_linear_nb(comm, sendbuf, recvbuf, root)
         return self.basic.scatter(comm, sendbuf, recvbuf, root)
 
     def allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
@@ -1096,7 +1794,8 @@ class TunedModule(CollModule):
             return self.basic.allgatherv(comm, sendbuf, recvbuf, counts,
                                          displs)
         nbytes = int(np.sum(counts)) * np.asarray(sendbuf).dtype.itemsize
-        if self._pick("allgatherv", comm, nbytes, "ring") == "linear":
+        alg = self._pick("allgatherv", comm, nbytes, "ring")
+        if alg == "linear":
             return self.basic.allgatherv(comm, sendbuf, recvbuf, counts,
                                          displs)
         if displs is None:
@@ -1106,7 +1805,21 @@ class TunedModule(CollModule):
             # leave gaps (same contract as the basic module)
             total = max(int(d) + int(c) for d, c in zip(displs, counts))
             recvbuf = np.empty(total, np.asarray(sendbuf).dtype)
-        allgatherv_ring(comm, np.asarray(sendbuf), recvbuf, counts, displs)
+        if alg == "bruck":
+            allgatherv_bruck(comm, np.asarray(sendbuf), recvbuf, counts,
+                             displs)
+        elif alg == "sparbit":
+            allgatherv_sparbit(comm, np.asarray(sendbuf), recvbuf, counts,
+                               displs)
+        elif alg == "neighbor_exchange" and comm.size % 2 == 0:
+            allgatherv_neighbor_exchange(comm, np.asarray(sendbuf), recvbuf,
+                                         counts, displs)
+        elif alg == "two_procs" and comm.size == 2:
+            allgatherv_two_procs(comm, np.asarray(sendbuf), recvbuf, counts,
+                                 displs)
+        else:
+            allgatherv_ring(comm, np.asarray(sendbuf), recvbuf, counts,
+                            displs)
         return recvbuf
 
     def allgather(self, comm, sendbuf, recvbuf=None):
@@ -1127,6 +1840,18 @@ class TunedModule(CollModule):
             allgather_recursive_doubling(comm, sendbuf, recvbuf)
         elif alg == "bruck":
             allgather_bruck(comm, sendbuf, recvbuf)
+        elif alg == "sparbit":
+            allgather_sparbit(comm, sendbuf, recvbuf)
+        elif alg == "k_bruck":
+            allgather_kbruck(
+                comm, sendbuf, recvbuf,
+                int(_var.get("coll_tuned_allgather_kbruck_radix", 4)))
+        elif alg == "two_procs" and comm.size == 2:
+            allgather_two_procs(comm, sendbuf, recvbuf)
+        elif alg == "direct":
+            allgather_direct(comm, sendbuf, recvbuf)
+        elif alg == "linear":
+            return self.basic.allgather(comm, sendbuf, recvbuf)
         elif alg == "neighbor_exchange" and even:
             allgather_neighbor_exchange(comm, sendbuf, recvbuf)
         else:
@@ -1145,8 +1870,64 @@ class TunedModule(CollModule):
                          "bruck" if nbytes <= 1024 else "pairwise")
         if alg == "bruck":
             alltoall_bruck(comm, sendbuf, recvbuf)
+        elif alg == "linear_sync":
+            alltoall_linear_sync(
+                comm, sendbuf, recvbuf,
+                int(_var.get("coll_tuned_alltoall_sync_requests", 8)))
+        elif alg == "two_procs" and comm.size == 2:
+            alltoall_two_procs(comm, sendbuf, recvbuf)
+        elif alg == "linear":
+            return self.basic.alltoall(comm, sendbuf, recvbuf)
         else:
             alltoall_pairwise(comm, sendbuf, recvbuf)
+        return recvbuf
+
+    def alltoallv(self, comm, sendbuf, recvbuf,
+                  sendcounts, recvcounts, sdispls=None, rdispls=None):
+        if comm.size == 1:
+            return self.basic.alltoallv(comm, sendbuf, recvbuf, sendcounts,
+                                        recvcounts, sdispls, rdispls)
+        nbytes = int(np.sum(sendcounts)) * \
+            np.asarray(sendbuf).dtype.itemsize
+        alg = self._pick("alltoallv", comm, nbytes, "pairwise")
+        if alg == "linear":
+            return self.basic.alltoallv(comm, sendbuf, recvbuf, sendcounts,
+                                        recvcounts, sdispls, rdispls)
+        if sdispls is None:
+            sdispls = list(np.concatenate([[0], np.cumsum(sendcounts)[:-1]]))
+        if rdispls is None:
+            rdispls = list(np.concatenate([[0], np.cumsum(recvcounts)[:-1]]))
+        alltoallv_pairwise(comm, sendbuf, recvbuf, sendcounts, recvcounts,
+                           sdispls, rdispls)
+        return recvbuf
+
+    def reduce_scatter(self, comm, sendbuf, recvbuf, counts, op: Op = None):
+        op = _sum_default(op)
+        sendbuf = np.asarray(sendbuf)
+        if comm.size == 1 or not op.commutative:
+            return self.basic.reduce_scatter(comm, sendbuf, recvbuf, counts,
+                                             op)
+        counts = [int(c) for c in counts]
+        displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]])
+                      .astype(int))
+        if recvbuf is None:
+            recvbuf = np.empty(counts[comm.rank], sendbuf.dtype)
+        pof2 = (comm.size & (comm.size - 1)) == 0
+        nbytes = sendbuf.nbytes
+        default = ("ring" if nbytes > (1 << 18) else
+                   ("recursive_halving" if pof2 else "butterfly"))
+        alg = self._pick("reduce_scatter", comm, nbytes, default)
+        if alg == "nonoverlapping":
+            return self.basic.reduce_scatter(comm, sendbuf, recvbuf, counts,
+                                             op)
+        if alg == "recursive_halving" and pof2:
+            reduce_scatter_recursive_halving(comm, sendbuf, recvbuf, counts,
+                                             displs, op)
+        elif alg == "butterfly" or (alg == "recursive_halving" and not pof2):
+            reduce_scatter_butterfly(comm, sendbuf, recvbuf, counts, displs,
+                                     op)
+        else:
+            reduce_scatter_ring(comm, sendbuf, recvbuf, counts, displs, op)
         return recvbuf
 
     def reduce_scatter_block(self, comm, sendbuf, recvbuf=None, op: Op = None):
@@ -1162,7 +1943,10 @@ class TunedModule(CollModule):
             return self.basic.reduce_scatter_block(comm, sendbuf, recvbuf, op)
         alg = self._pick("reduce_scatter_block", comm, sendbuf.nbytes,
                          "recursive_halving" if pof2 else "butterfly")
-        if alg == "butterfly" or not pof2:
+        if alg == "recursive_doubling" and pof2:
+            reduce_scatter_block_recursive_doubling(comm, sendbuf, recvbuf,
+                                                    op)
+        elif alg == "butterfly" or not pof2:
             reduce_scatter_block_butterfly(comm, sendbuf, recvbuf, op)
         else:
             reduce_scatter_block_recursive_halving(comm, sendbuf, recvbuf, op)
@@ -1174,7 +1958,13 @@ class TunedModule(CollModule):
         alg = self._pick("barrier", comm, 0, "recursive_doubling")
         if alg == "double_ring":
             barrier_double_ring(comm)
+        elif alg == "tree":
+            barrier_tree(comm)
+        elif alg == "two_procs" and comm.size == 2:
+            barrier_two_procs(comm)
         else:
+            # recursive_doubling; "bruck" (coll_base_barrier.c:269) is the
+            # same +mask/-mask pairing here (see barrier_recursive_doubling)
             barrier_recursive_doubling(comm)
 
     def scan(self, comm, sendbuf, recvbuf=None, op: Op = None):
@@ -1182,6 +1972,9 @@ class TunedModule(CollModule):
         send = _inplace(sendbuf, recvbuf)
         if recvbuf is None:
             recvbuf = np.empty_like(send)
+        if self._pick("scan", comm, send.nbytes,
+                      "recursive_doubling") == "linear":
+            return self.basic.scan(comm, send, recvbuf, op)
         scan_recursive_doubling(comm, send, recvbuf, op, exclusive=False)
         return recvbuf
 
@@ -1190,6 +1983,9 @@ class TunedModule(CollModule):
         send = _inplace(sendbuf, recvbuf)
         if recvbuf is None:
             recvbuf = np.empty_like(send)
+        if self._pick("exscan", comm, send.nbytes,
+                      "recursive_doubling") == "linear":
+            return self.basic.exscan(comm, send, recvbuf, op)
         scan_recursive_doubling(comm, send, recvbuf, op, exclusive=True)
         return recvbuf
 

@@ -562,3 +562,281 @@ def test_allgatherv_ring_variant():
         return True
 
     assert all(runtime.run_ranks(3, fn))
+
+
+# ---------------------------------------------------------------------------
+# Appendix-A completion block (round-2): the remaining reference algorithm
+# variants — coll_base_allreduce.c:57/:1267, coll_base_bcast.c:361,
+# coll_base_reduce.c:384/:811/:1166, coll_base_allgather.c:227/:570/:767/:930,
+# coll_base_allgatherv.c:95/:259/:498/:643, coll_base_alltoall.c:378/:537,
+# coll_base_alltoallv.c:194, coll_base_reduce_scatter.c:132/:456/:691,
+# coll_base_reduce_scatter_block.c:197, coll_base_barrier.c:307/:427,
+# coll_base_gather.c:208, coll_base_scatter.c:289
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("alg", ["nonoverlapping", "allgather_reduce"])
+@pytest.mark.parametrize("size", [3, 4])
+def test_allreduce_remaining_variants(alg, size):
+    _force("coll_tuned_allreduce_algorithm", alg)
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(100, dtype=np.float64) * (c.rank + 1)
+            return c.coll.allreduce(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        expect = sum(np.arange(100, dtype=np.float64) * (r + 1)
+                     for r in range(size))
+        for r in res:
+            np.testing.assert_allclose(r, expect)
+    finally:
+        _force("coll_tuned_allreduce_algorithm", "")
+
+
+@pytest.mark.parametrize("size,root", [(4, 0), (5, 2), (7, 1)])
+def test_bcast_split_binary(size, root):
+    _force("coll_tuned_bcast_algorithm", "split_binary")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            buf = (np.arange(501, dtype=np.int64) if c.rank == root
+                   else np.zeros(501, np.int64))
+            c.coll.bcast(c, buf, root=root)
+            return buf
+
+        res = runtime.run_ranks(size, fn)
+        for r in res:
+            np.testing.assert_array_equal(r, np.arange(501, dtype=np.int64))
+    finally:
+        _force("coll_tuned_bcast_algorithm", "")
+
+
+@pytest.mark.parametrize("alg", ["chain", "knomial", "rabenseifner"])
+@pytest.mark.parametrize("size", [4, 5])
+def test_reduce_remaining_variants(alg, size):
+    _force("coll_tuned_reduce_algorithm", alg)
+    _force("coll_tuned_reduce_segsize", "1024")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(1000, dtype=np.float64) * (c.rank + 1)
+            out = np.zeros(1000) if c.rank == 1 else None
+            return c.coll.reduce(c, send, out, root=1)
+
+        res = runtime.run_ranks(size, fn)
+        expect = sum(np.arange(1000, dtype=np.float64) * (r + 1)
+                     for r in range(size))
+        np.testing.assert_allclose(res[1], expect)
+        assert all(r is None for i, r in enumerate(res) if i != 1)
+    finally:
+        _force("coll_tuned_reduce_algorithm", "")
+        _force("coll_tuned_reduce_segsize", str(256 << 10))
+
+
+@pytest.mark.parametrize("alg,size", [
+    ("sparbit", 3), ("sparbit", 4), ("sparbit", 6),
+    ("k_bruck", 4), ("k_bruck", 5), ("k_bruck", 9),
+    ("direct", 3), ("two_procs", 2), ("linear", 3),
+])
+def test_allgather_remaining_variants(alg, size):
+    _force("coll_tuned_allgather_algorithm", alg)
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(7, dtype=np.float64) + 10 * c.rank
+            return c.coll.allgather(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        expect = np.stack([np.arange(7, dtype=np.float64) + 10 * r
+                           for r in range(size)])
+        for r in res:
+            np.testing.assert_array_equal(np.asarray(r).reshape(size, 7),
+                                          expect)
+    finally:
+        _force("coll_tuned_allgather_algorithm", "")
+
+
+@pytest.mark.parametrize("alg,size", [
+    ("bruck", 3), ("bruck", 4), ("bruck", 5),
+    ("sparbit", 3), ("sparbit", 5),
+    ("neighbor_exchange", 4), ("neighbor_exchange", 6),
+    ("two_procs", 2),
+])
+def test_allgatherv_remaining_variants(alg, size):
+    _force("coll_tuned_allgatherv_algorithm", alg)
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            counts = [(r % 3) + 1 for r in range(c.size)]
+            mine = np.full(counts[c.rank], float(c.rank))
+            out = c.coll.allgatherv(c, mine, counts=counts)
+            return np.asarray(out)
+
+        res = runtime.run_ranks(size, fn)
+        counts = [(r % 3) + 1 for r in range(size)]
+        expect = np.concatenate([np.full(counts[r], float(r))
+                                 for r in range(size)])
+        for r in res:
+            np.testing.assert_array_equal(r, expect)
+    finally:
+        _force("coll_tuned_allgatherv_algorithm", "")
+
+
+@pytest.mark.parametrize("alg,size", [
+    ("linear_sync", 3), ("linear_sync", 5), ("two_procs", 2), ("linear", 4),
+])
+def test_alltoall_remaining_variants(alg, size):
+    _force("coll_tuned_alltoall_algorithm", alg)
+    _force("coll_tuned_alltoall_sync_requests", "2")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(c.size * 3, dtype=np.int64) + 100 * c.rank
+            return c.coll.alltoall(c, send)
+
+        res = runtime.run_ranks(size, fn)
+        for me, r in enumerate(res):
+            expect = np.concatenate(
+                [np.arange(me * 3, me * 3 + 3) + 100 * src
+                 for src in range(size)])
+            np.testing.assert_array_equal(np.asarray(r).reshape(-1), expect)
+    finally:
+        _force("coll_tuned_alltoall_algorithm", "")
+        _force("coll_tuned_alltoall_sync_requests", "8")
+
+
+@pytest.mark.parametrize("size", [3, 4])
+def test_alltoallv_pairwise(size):
+    _force("coll_tuned_alltoallv_algorithm", "pairwise")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            # rank r sends (dst+1) items of value 100*r+dst to each dst
+            sendcounts = [d + 1 for d in range(c.size)]
+            send = np.concatenate(
+                [np.full(d + 1, 100 * c.rank + d) for d in range(c.size)])
+            recvcounts = [c.rank + 1] * c.size
+            recv = np.zeros(sum(recvcounts), np.int64)
+            c.coll.alltoallv(c, send.astype(np.int64), recv,
+                             sendcounts, recvcounts)
+            return recv
+
+        res = runtime.run_ranks(size, fn)
+        for me, r in enumerate(res):
+            expect = np.concatenate(
+                [np.full(me + 1, 100 * src + me) for src in range(size)])
+            np.testing.assert_array_equal(r, expect)
+    finally:
+        _force("coll_tuned_alltoallv_algorithm", "")
+
+
+@pytest.mark.parametrize("alg,size", [
+    ("ring", 3), ("ring", 4), ("recursive_halving", 4),
+    ("butterfly", 3), ("butterfly", 5), ("nonoverlapping", 3),
+])
+def test_reduce_scatter_remaining_variants(alg, size):
+    _force("coll_tuned_reduce_scatter_algorithm", alg)
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            counts = [(r % 2) + 2 for r in range(c.size)]
+            send = (np.arange(sum(counts), dtype=np.float64)
+                    * (c.rank + 1))
+            recv = np.zeros(counts[c.rank])
+            c.coll.reduce_scatter(c, send, recv, counts)
+            return recv
+
+        res = runtime.run_ranks(size, fn)
+        counts = [(r % 2) + 2 for r in range(size)]
+        displs = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(int)
+        full = sum(np.arange(sum(counts), dtype=np.float64) * (r + 1)
+                   for r in range(size))
+        for me, r in enumerate(res):
+            np.testing.assert_allclose(
+                r, full[displs[me]:displs[me] + counts[me]])
+    finally:
+        _force("coll_tuned_reduce_scatter_algorithm", "")
+
+
+def test_reduce_scatter_block_recursive_doubling():
+    _force("coll_tuned_reduce_scatter_block_algorithm", "recursive_doubling")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            send = np.arange(c.size * 4, dtype=np.float64) * (c.rank + 1)
+            return c.coll.reduce_scatter_block(c, send)
+
+        size = 4
+        res = runtime.run_ranks(size, fn)
+        full = sum(np.arange(size * 4, dtype=np.float64) * (r + 1)
+                   for r in range(size))
+        for me, r in enumerate(res):
+            np.testing.assert_allclose(r, full[me * 4:(me + 1) * 4])
+    finally:
+        _force("coll_tuned_reduce_scatter_block_algorithm", "")
+
+
+@pytest.mark.parametrize("alg,size", [("tree", 5), ("two_procs", 2)])
+def test_barrier_remaining_variants(alg, size):
+    _force("coll_tuned_barrier_algorithm", alg)
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            for _ in range(3):
+                c.coll.barrier(c)
+            return True
+
+        assert all(runtime.run_ranks(size, fn))
+    finally:
+        _force("coll_tuned_barrier_algorithm", "")
+
+
+def test_gather_linear_sync_and_scatter_linear_nb():
+    _force("coll_tuned_gather_algorithm", "linear_sync")
+    _force("coll_tuned_scatter_algorithm", "linear_nb")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            gathered = c.coll.gather(
+                c, np.full(3, float(c.rank)), root=1)
+            if c.rank == 1:
+                assert gathered is not None
+                np.testing.assert_array_equal(
+                    np.asarray(gathered).reshape(c.size, 3),
+                    np.stack([np.full(3, float(r)) for r in range(c.size)]))
+                send = np.arange(c.size * 2, dtype=np.float64)
+            else:
+                send = None
+            recv = np.zeros(2)
+            c.coll.scatter(c, send, recv, root=1)
+            return recv
+
+        res = runtime.run_ranks(4, fn)
+        for me, r in enumerate(res):
+            np.testing.assert_array_equal(r, [2 * me, 2 * me + 1])
+    finally:
+        _force("coll_tuned_gather_algorithm", "")
+        _force("coll_tuned_scatter_algorithm", "")
+
+
+def test_scan_linear_forced():
+    _force("coll_tuned_scan_algorithm", "linear")
+    _force("coll_tuned_exscan_algorithm", "linear")
+    try:
+        def fn(ctx):
+            c = world(ctx)
+            v = np.full(4, float(c.rank + 1))
+            return (np.asarray(c.coll.scan(c, v)),
+                    np.asarray(c.coll.exscan(c, v)) if c.rank > 0
+                    else c.coll.exscan(c, v))
+
+        res = runtime.run_ranks(3, fn)
+        for me, (sc, ex) in enumerate(res):
+            np.testing.assert_allclose(
+                sc, np.full(4, sum(range(1, me + 2))))
+            if me > 0:
+                np.testing.assert_allclose(
+                    np.asarray(ex), np.full(4, sum(range(1, me + 1))))
+    finally:
+        _force("coll_tuned_scan_algorithm", "")
+        _force("coll_tuned_exscan_algorithm", "")
