@@ -1,0 +1,367 @@
+"""OMPIO sub-framework components: fs / fbtl / fcoll / sharedfp.
+
+≙ the reference's OMPIO architecture (SURVEY.md §2.4 row fbtl/fcoll/fs/
+sharedfp): MPI-IO is not one monolith but four orthogonal frameworks —
+  * ``fs``       filesystem ops (open/close/delete/resize) —
+                 reference ompi/mca/fs/ (ufs/lustre/gpfs/ime)
+  * ``fbtl``     individual file byte transfer —
+                 reference ompi/mca/fbtl/ (posix/ime)
+  * ``fcoll``    collective-IO aggregation strategy —
+                 reference ompi/mca/fcoll/ (vulcan/dynamic_gen2/individual),
+                 aggregator machinery common_ompio_aggregators.c
+  * ``sharedfp`` shared-file-pointer storage —
+                 reference ompi/mca/sharedfp/ (sm/lockedfile/individual)
+
+Each is a real framework in the MCA-analog registry: selectable via the
+framework variable (``--mca fcoll individual``, ``--mca sharedfp
+lockedfile``), priorities overridable per component — so alternative
+backends (an object-store fs, a burst-buffer fcoll) slot in the way the
+reference's lustre/ime components do. ``File`` (file.py) selects one module
+per framework at open time and orchestrates MPI semantics above them.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core import var as _var
+from ..core.component import Component, component
+
+_TAG_IO = -400000          # collective two-phase internal band
+
+_var.register("io", "ompio", "num_aggregators", 0, type=int, level=4,
+              help="Aggregator count for two-phase collective IO "
+                   "(0 = auto, ≙ OMPIO's aggregator selection).")
+
+_path_mutexes: dict = {}
+_path_mutexes_guard = threading.Lock()
+
+
+def path_mutex(path: str) -> threading.Lock:
+    """Process-wide per-path mutex: fcntl locks are per-process, so ranks
+    running as threads of one process (run_ranks) need this extra layer."""
+    with _path_mutexes_guard:
+        m = _path_mutexes.get(path)
+        if m is None:
+            m = _path_mutexes[path] = threading.Lock()
+        return m
+
+
+# ---------------------------------------------------------------------------
+# fs — filesystem operations (≙ ompi/mca/fs/ufs)
+# ---------------------------------------------------------------------------
+
+class _UfsModule:
+    """POSIX filesystem ops."""
+
+    def open(self, path: str, flags: int) -> int:
+        return os.open(path, flags, 0o644)
+
+    def close(self, fd: int) -> None:
+        os.close(fd)
+
+    def delete(self, path: str) -> None:
+        os.unlink(path)
+
+    def set_size(self, fd: int, nbytes: int) -> None:
+        os.ftruncate(fd, nbytes)
+
+    def size(self, fd: int) -> int:
+        return os.fstat(fd).st_size
+
+    def sync(self, fd: int) -> None:
+        os.fsync(fd)
+
+
+@component("fs", "ufs", priority=10)
+class UfsFs(Component):
+    name = "ufs"
+
+    def query(self, scope):
+        return self.priority, _UfsModule()
+
+
+# ---------------------------------------------------------------------------
+# fbtl — individual file byte transfer (≙ ompi/mca/fbtl/posix)
+# ---------------------------------------------------------------------------
+
+class _PosixFbtl:
+    """pread/pwrite over (offset, nbytes) run lists. The async (ipreadv/
+    ipwritev) role of fbtl/posix's aio path is played by File's worker
+    thread, which funnels into these blocking entry points."""
+
+    def readv(self, fd: int, runs: List[Tuple[int, int]]) -> bytes:
+        out = bytearray()
+        for off, n in runs:
+            out += os.pread(fd, n, off)
+        return bytes(out)
+
+    def writev(self, fd: int, runs: List[Tuple[int, int]],
+               data: bytes) -> int:
+        done = 0
+        for off, n in runs:
+            os.pwrite(fd, data[done:done + n], off)
+            done += n
+        return done
+
+
+@component("fbtl", "posix", priority=10)
+class PosixFbtl(Component):
+    name = "posix"
+
+    def query(self, scope):
+        return self.priority, _PosixFbtl()
+
+
+# ---------------------------------------------------------------------------
+# fcoll — collective IO strategy (≙ ompi/mca/fcoll/vulcan + /individual)
+# ---------------------------------------------------------------------------
+
+class _TwoPhaseFcoll:
+    """Two-phase collective IO: intents exchanged over the communicator,
+    aggregator ranks merge file-domain chunks into large sequential POSIX
+    operations (≙ fcoll/vulcan + common_ompio_aggregators.c)."""
+
+    def _aggregators(self, f) -> List[int]:
+        n = int(_var.get("io_ompio_num_aggregators", 0))
+        if n <= 0:
+            n = min(f.comm.size, 4)
+        return list(range(min(n, f.comm.size)))
+
+    def run(self, f, my_runs: List[Tuple[int, int]],
+            data: Optional[bytes]) -> Optional[bytes]:
+        """Write (data given) or read my_runs collectively."""
+        comm = f.comm
+        seq = f._coll_seq
+        f._coll_seq += 1
+        aggs = self._aggregators(f)
+        # file-domain split: global [lo, hi) carved evenly across aggregators
+        my_lo = min((o for o, _n in my_runs), default=np.iinfo(np.int64).max)
+        my_hi = max((o + n for o, n in my_runs), default=0)
+        # global [lo, hi): one MAX allreduce gives both bounds (MIN of the
+        # offsets rides as MAX of their negation)
+        from ..op import MAX as _MAX
+        bounds = comm.coll.allreduce(
+            comm, np.array([-my_lo, my_hi], np.int64), op=_MAX)
+        lo, hi = -int(bounds[0]), int(bounds[1])
+        if hi <= lo:
+            return b"" if data is None else None
+        domain = max((hi - lo + len(aggs) - 1) // len(aggs), 1)
+
+        def agg_of(off: int) -> int:
+            return aggs[min((off - lo) // domain, len(aggs) - 1)]
+
+        # split my runs on domain boundaries, grouped per aggregator
+        per_agg: dict = {a: [] for a in aggs}
+        cursor = 0
+        for off, n in my_runs:
+            while n > 0:
+                a = agg_of(off)
+                dom_end = lo + (((off - lo) // domain) + 1) * domain
+                take = min(n, dom_end - off)
+                per_agg[a].append((off, take, cursor))
+                cursor += take
+                off += take
+                n -= take
+
+        tag_meta = _TAG_IO - (seq % 1000) * 4
+        tag_data = tag_meta - 1
+        tag_reply = tag_meta - 2
+        # send intents (+payload when writing) to each aggregator
+        reqs = []
+        for a in aggs:
+            runs = per_agg[a]
+            meta = np.array([len(runs)] + [v for off, n, _c in runs
+                                           for v in (off, n)], np.int64)
+            reqs.append(comm.isend(meta, a, tag_meta))
+            if data is not None:
+                chunk = b"".join(data[c:c + n] for _o, n, c in runs)
+                reqs.append(comm.isend(
+                    np.frombuffer(chunk, np.uint8) if chunk else
+                    np.zeros(0, np.uint8), a, tag_data))
+
+        # aggregator role: collect, coalesce, hit the filesystem via fbtl
+        if comm.rank in aggs:
+            gathered = []       # (off, n, src, order)
+            blobs = {}
+            for src in range(comm.size):
+                st = comm.probe(src, tag_meta, timeout=60)
+                meta = np.zeros(st["count"] // 8, np.int64)
+                comm.recv(meta, src, tag_meta)
+                runs = [(int(meta[1 + 2 * i]), int(meta[2 + 2 * i]))
+                        for i in range(int(meta[0]))]
+                if data is not None:
+                    total = sum(n for _o, n in runs)
+                    blob = np.zeros(total, np.uint8)
+                    comm.recv(blob, src, tag_data)
+                    blobs[src] = blob.tobytes()
+                pos = 0
+                for off, n in runs:
+                    gathered.append((off, n, src, pos))
+                    pos += n
+            if data is not None:
+                # merge in offset order → large sequential writes
+                for off, n, src, pos in sorted(gathered):
+                    f._fbtl.writev(f._fd, [(off, n)],
+                                   blobs[src][pos:pos + n])
+            else:
+                # replies go out as isends so a slow requester never
+                # serializes the others behind a blocking send
+                for off, n, src, pos in sorted(gathered):
+                    piece = f._fbtl.readv(f._fd, [(off, n)])
+                    reqs.append(comm.isend(
+                        np.frombuffer(piece, np.uint8), src, tag_reply))
+
+        out: Optional[bytes] = None
+        if data is None:
+            # collect replies back into visible-byte order; per-(src,tag)
+            # non-overtaking keeps each aggregator's pieces in offset order,
+            # which is per_agg insertion order (view ranges ascend)
+            chunks = bytearray(cursor)
+            for a in aggs:
+                for off, n, c in per_agg[a]:
+                    piece = np.zeros(n, np.uint8)
+                    comm.recv(piece, a, tag_reply)
+                    chunks[c:c + n] = piece.tobytes()
+            out = bytes(chunks)
+        for r in reqs:
+            r.wait(timeout=60)
+        comm.barrier()
+        return out
+
+
+@component("fcoll", "two_phase", priority=20)
+class TwoPhaseFcoll(Component):
+    name = "two_phase"
+
+    def query(self, scope):
+        return self.priority, _TwoPhaseFcoll()
+
+
+class _IndividualFcoll:
+    """Each rank performs its own runs independently (≙ fcoll/individual):
+    no aggregation exchange — wins when runs are already large and
+    contiguous per rank, loses badly on fine-grained interleaved views."""
+
+    def run(self, f, my_runs: List[Tuple[int, int]],
+            data: Optional[bytes]) -> Optional[bytes]:
+        f._coll_seq += 1
+        if data is None:
+            out = f._fbtl.readv(f._fd, my_runs)
+            f.comm.barrier()
+            return out
+        f._fbtl.writev(f._fd, my_runs, data)
+        f.comm.barrier()
+        return None
+
+
+@component("fcoll", "individual", priority=5)
+class IndividualFcoll(Component):
+    name = "individual"
+
+    def query(self, scope):
+        return self.priority, _IndividualFcoll()
+
+
+# ---------------------------------------------------------------------------
+# sharedfp — shared file pointer storage (≙ ompi/mca/sharedfp/sm|lockedfile)
+# ---------------------------------------------------------------------------
+
+class _SmSharedfp:
+    """Shared pointer in an RMA window on rank 0 (≙ sharedfp/sm's shared-
+    memory segment): fetch-add via window atomics."""
+
+    def init(self, f) -> None:          # collective
+        from ..osc import win_allocate
+        self.comm = f.comm
+        self.win = win_allocate(f.comm, 1, np.int64)
+
+    def read_value(self) -> int:        # rank-0 only
+        return int(self.win.local[0])
+
+    def write_value(self, value: int) -> None:   # rank-0 only
+        self.win.local[0] = value
+
+    def fetch_add(self, delta: int) -> int:      # any rank
+        from ..op import SUM
+        res = np.zeros(1, np.int64)
+        self.win.lock(0)
+        self.win.fetch_and_op(np.array([delta], np.int64), res, 0, op=SUM)
+        self.win.unlock(0)
+        return int(res[0])
+
+    def free(self) -> None:             # collective
+        self.win.free()
+
+
+@component("sharedfp", "sm", priority=20)
+class SmSharedfp(Component):
+    name = "sm"
+
+    def query(self, scope):
+        return self.priority, _SmSharedfp()
+
+
+class _LockedfileSharedfp:
+    """Shared pointer as an fcntl-locked sidecar file
+    (≙ sharedfp/lockedfile): works across unrelated processes with no RMA
+    progress dependency on rank 0 — the trade is one filesystem round-trip
+    per bump. A process-wide mutex backs the fcntl lock for threaded ranks
+    (fcntl exclusion is per-process)."""
+
+    def init(self, f) -> None:          # collective
+        self.comm = f.comm
+        self.path = f.path + ".sharedfp"
+        if f.comm.rank == 0:
+            fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o644)
+            os.pwrite(fd, (0).to_bytes(8, "little", signed=True), 0)
+            os.close(fd)
+        f.comm.barrier()
+        self.fd = os.open(self.path, os.O_RDWR)
+
+    def _locked(self, fn):
+        import fcntl
+        with path_mutex(self.path):
+            fcntl.lockf(self.fd, fcntl.LOCK_EX, 8, 0, 0)
+            try:
+                return fn()
+            finally:
+                fcntl.lockf(self.fd, fcntl.LOCK_UN, 8, 0, 0)
+
+    def read_value(self) -> int:
+        return self._locked(lambda: int.from_bytes(
+            os.pread(self.fd, 8, 0), "little", signed=True))
+
+    def write_value(self, value: int) -> None:
+        self._locked(lambda: os.pwrite(
+            self.fd, int(value).to_bytes(8, "little", signed=True), 0))
+
+    def fetch_add(self, delta: int) -> int:
+        def bump():
+            old = int.from_bytes(os.pread(self.fd, 8, 0), "little",
+                                 signed=True)
+            os.pwrite(self.fd, (old + delta).to_bytes(8, "little",
+                                                      signed=True), 0)
+            return old
+        return self._locked(bump)
+
+    def free(self) -> None:             # collective
+        os.close(self.fd)
+        self.comm.barrier()
+        if self.comm.rank == 0:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+@component("sharedfp", "lockedfile", priority=10)
+class LockedfileSharedfp(Component):
+    name = "lockedfile"
+
+    def query(self, scope):
+        return self.priority, _LockedfileSharedfp()

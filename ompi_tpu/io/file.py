@@ -1,11 +1,15 @@
 """MPI-IO file handles (≙ ompi/mca/io/ompio, common_ompio_file_*.c).
 
-See package docstring for the sub-framework mapping. Offsets follow MPI
-semantics: explicit offsets and the individual/shared file pointers count
-*etypes relative to the current view*, and a view (disp, etype, filetype)
-tiles the file with ``filetype`` — only bytes under its segments are
-visible, in segment order (MPI-4 §14.3; the reference walks the same
-description through its convertor, common_ompio_file_view.c).
+The MPI semantics (views, pointers, collectives, atomic mode) live here;
+the mechanics are delegated to one selected module per OMPIO sub-framework
+(components.py: fs=filesystem ops, fbtl=byte transfer, fcoll=collective
+strategy, sharedfp=shared-pointer storage — ≙ ompi/mca/{fs,fbtl,fcoll,
+sharedfp}). Offsets follow MPI semantics: explicit offsets and the
+individual/shared file pointers count *etypes relative to the current
+view*, and a view (disp, etype, filetype) tiles the file with ``filetype``
+— only bytes under its segments are visible, in segment order (MPI-4
+§14.3; the reference walks the same description through its convertor,
+common_ompio_file_view.c).
 """
 
 from __future__ import annotations
@@ -16,9 +20,9 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core import var as _var
 from ..datatype import BYTE, Convertor, Datatype
-from ..op import SUM
+from ..core.component import frameworks
+from . import components as _components  # noqa: F401 — registers fs/fbtl/...
 
 MODE_RDONLY = 0x01
 MODE_WRONLY = 0x02
@@ -28,24 +32,9 @@ MODE_EXCL = 0x10
 MODE_APPEND = 0x20
 MODE_DELETE_ON_CLOSE = 0x40
 
-_TAG_IO = -400000          # collective two-phase internal band
-
-_var.register("io", "ompio", "num_aggregators", 0, type=int, level=4,
-              help="Aggregator count for two-phase collective IO "
-                   "(0 = auto, ≙ OMPIO's aggregator selection).")
-
 _DUMMY = np.zeros(0, np.uint8)
 
-_atomic_mutexes: dict = {}
-_atomic_mutexes_guard = threading.Lock()
-
-
-def _atomic_mutex(path: str) -> threading.Lock:
-    with _atomic_mutexes_guard:
-        m = _atomic_mutexes.get(path)
-        if m is None:
-            m = _atomic_mutexes[path] = threading.Lock()
-        return m
+_atomic_mutex = _components.path_mutex
 
 
 class File:
@@ -59,13 +48,17 @@ class File:
         self._lock = threading.Lock()
         self._pos = 0                   # individual pointer, in etypes
         self._coll_seq = 0
-        self._shared_win = None
         self._io_pool = None            # worker thread for iread/iwrite
         self._split = None              # pending split collective (begin/end)
         self.disp = 0
         self.etype: Datatype = BYTE
         self.filetype: Optional[Datatype] = None    # None = contiguous
         self.atomicity = False
+        # one module per OMPIO sub-framework (see components.py)
+        _, self._fs = frameworks.framework("fs").select(self)
+        _, self._fbtl = frameworks.framework("fbtl").select(self)
+        _, self._fcoll = frameworks.framework("fcoll").select(self)
+        _, self._sfp = frameworks.framework("sharedfp").select(self)
 
     # -- open/close ---------------------------------------------------------
 
@@ -81,8 +74,8 @@ class File:
             flags |= os.O_RDONLY
         if amode & MODE_APPEND:
             flags |= os.O_APPEND
+        f = cls(comm, path, amode, -1)
         err = None
-        fd = -1
         if comm.rank == 0:
             try:
                 cflags = flags
@@ -90,24 +83,22 @@ class File:
                     cflags |= os.O_CREAT
                 if amode & MODE_EXCL:
                     cflags |= os.O_EXCL
-                fd = os.open(path, cflags, 0o644)
+                f._fd = f._fs.open(path, cflags)
             except OSError as exc:
                 err = str(exc)
         state = comm.coll.bcast(comm, np.array(
             [0 if err is None else 1], np.int64))
         if int(state[0]):
-            if fd >= 0:
-                os.close(fd)
+            if f._fd >= 0:
+                f._fs.close(f._fd)
             raise IOError(f"MPI_File_open({path}): {err or 'root failed'}")
         if comm.rank != 0:
-            fd = os.open(path, flags)
-        f = cls(comm, path, amode, fd)
-        # The shared-file-pointer window is created *collectively at open*
+            f._fd = f._fs.open(path, flags)
+        # The shared-file-pointer store is created *collectively at open*
         # (as OMPIO's sharedfp component does at file-open time) — lazy
         # creation deadlocks when only a subset of ranks reaches the lazy
         # path (e.g. the rank-0-only fetch-add in the ordered IO calls).
-        from ..osc import win_allocate
-        f._shared_win = win_allocate(comm, 1, np.int64)
+        f._sfp.init(f)
         f._seed_shared(0)
         return f
 
@@ -118,33 +109,33 @@ class File:
             self._io_pool = None
         self.sync()
         self.comm.barrier()
-        os.close(self._fd)
+        self._fs.close(self._fd)
         self._fd = -1
         if self.amode & MODE_DELETE_ON_CLOSE and self.comm.rank == 0:
             try:
-                os.unlink(self.path)
+                self._fs.delete(self.path)
             except OSError:
                 pass
-        if self._shared_win is not None:
-            self._shared_win.free()
-            self._shared_win = None
+        if self._sfp is not None:
+            self._sfp.free()
+            self._sfp = None
 
     def sync(self) -> None:
         if self._fd >= 0 and (self.amode & (MODE_WRONLY | MODE_RDWR)):
-            os.fsync(self._fd)
+            self._fs.sync(self._fd)
 
     def size(self) -> int:
-        return os.fstat(self._fd).st_size
+        return self._fs.size(self._fd)
 
     def set_size(self, nbytes: int) -> None:
         """Collective truncate/extend (MPI_File_set_size)."""
         if self.comm.rank == 0:
-            os.ftruncate(self._fd, nbytes)
+            self._fs.set_size(self._fd, nbytes)
         self.comm.barrier()
 
     def preallocate(self, nbytes: int) -> None:
         if self.comm.rank == 0 and self.size() < nbytes:
-            os.ftruncate(self._fd, nbytes)
+            self._fs.set_size(self._fd, nbytes)
         self.comm.barrier()
 
     # -- views --------------------------------------------------------------
@@ -159,7 +150,7 @@ class File:
         self.filetype = None if (filetype is None or
                                  filetype.is_contiguous) else filetype
         self._pos = 0
-        if self._shared_win is not None:
+        if self._sfp is not None:
             self._seed_shared(0)
         self.comm.barrier()
 
@@ -207,18 +198,11 @@ class File:
                 raise
         try:
             if data is None:                       # read
-                out = bytearray()
-                for off, n in runs:
-                    out += os.pread(self._fd, n, off)
-                return bytes(out)
+                return self._fbtl.readv(self._fd, runs)
             # (no fsync here: atomicity is inter-process *visibility*, which
             # the shared page cache + the byte-range lock already give;
             # durability is MPI_File_sync's job)
-            done = 0
-            for off, n in runs:
-                os.pwrite(self._fd, data[done:done + n], off)
-                done += n
-            return done
+            return self._fbtl.writev(self._fd, runs, data)
         finally:
             if lock:
                 import fcntl
@@ -317,113 +301,14 @@ class File:
         self._pos += (n_el * arr.itemsize) // self.etype.size
         return self._io_async(lambda: self.write_at(pos, buf, count))
 
-    # -- collective two-phase IO (≙ fcoll/vulcan) ---------------------------
-
-    def _aggregators(self) -> List[int]:
-        n = int(_var.get("io_ompio_num_aggregators", 0))
-        if n <= 0:
-            n = min(self.comm.size, 4)
-        return list(range(min(n, self.comm.size)))
+    # -- collective IO (strategy selected from the fcoll framework) ---------
 
     def _two_phase(self, my_runs: List[Tuple[int, int]],
                    data: Optional[bytes]) -> Optional[bytes]:
-        """Exchange runs with aggregators; write (data given) or read."""
-        comm = self.comm
-        seq = self._coll_seq
-        self._coll_seq += 1
-        aggs = self._aggregators()
-        # file-domain split: global [lo, hi) carved evenly across aggregators
-        my_lo = min((o for o, _n in my_runs), default=np.iinfo(np.int64).max)
-        my_hi = max((o + n for o, n in my_runs), default=0)
-        # global [lo, hi): one MAX allreduce gives both bounds (MIN of the
-        # offsets rides as MAX of their negation)
-        from ..op import MAX as _MAX
-        bounds = comm.coll.allreduce(
-            comm, np.array([-my_lo, my_hi], np.int64), op=_MAX)
-        lo, hi = -int(bounds[0]), int(bounds[1])
-        if hi <= lo:
-            return b"" if data is None else None
-        domain = max((hi - lo + len(aggs) - 1) // len(aggs), 1)
-
-        def agg_of(off: int) -> int:
-            return aggs[min((off - lo) // domain, len(aggs) - 1)]
-
-        # split my runs on domain boundaries, grouped per aggregator
-        per_agg: dict = {a: [] for a in aggs}
-        cursor = 0
-        for off, n in my_runs:
-            while n > 0:
-                a = agg_of(off)
-                dom_end = lo + (((off - lo) // domain) + 1) * domain
-                take = min(n, dom_end - off)
-                per_agg[a].append((off, take, cursor))
-                cursor += take
-                off += take
-                n -= take
-
-        tag_meta = _TAG_IO - (seq % 1000) * 4
-        tag_data = tag_meta - 1
-        tag_reply = tag_meta - 2
-        # send intents (+payload when writing) to each aggregator
-        reqs = []
-        for a in aggs:
-            runs = per_agg[a]
-            meta = np.array([len(runs)] + [v for off, n, _c in runs
-                                           for v in (off, n)], np.int64)
-            reqs.append(comm.isend(meta, a, tag_meta))
-            if data is not None:
-                chunk = b"".join(data[c:c + n] for _o, n, c in runs)
-                reqs.append(comm.isend(
-                    np.frombuffer(chunk, np.uint8) if chunk else
-                    np.zeros(0, np.uint8), a, tag_data))
-
-        # aggregator role: collect, coalesce, hit the filesystem
-        if comm.rank in aggs:
-            gathered = []       # (off, n, src, order)
-            blobs = {}
-            for src in range(comm.size):
-                st = comm.probe(src, tag_meta, timeout=60)
-                meta = np.zeros(st["count"] // 8, np.int64)
-                comm.recv(meta, src, tag_meta)
-                runs = [(int(meta[1 + 2 * i]), int(meta[2 + 2 * i]))
-                        for i in range(int(meta[0]))]
-                if data is not None:
-                    total = sum(n for _o, n in runs)
-                    blob = np.zeros(total, np.uint8)
-                    comm.recv(blob, src, tag_data)
-                    blobs[src] = blob.tobytes()
-                pos = 0
-                for off, n in runs:
-                    gathered.append((off, n, src, pos))
-                    pos += n
-            if data is not None:
-                # merge in offset order → large sequential pwrites
-                for off, n, src, pos in sorted(gathered):
-                    os.pwrite(self._fd, blobs[src][pos:pos + n], off)
-            else:
-                # replies go out as isends so a slow requester never
-                # serializes the others behind a blocking send
-                for off, n, src, pos in sorted(gathered):
-                    piece = os.pread(self._fd, n, off)
-                    reqs.append(comm.isend(
-                        np.frombuffer(piece, np.uint8), src, tag_reply))
-
-        out: Optional[bytes] = None
-        if data is None:
-            # collect replies back into visible-byte order; per-(src,tag)
-            # non-overtaking keeps each aggregator's pieces in offset order,
-            # which is per_agg insertion order (view ranges ascend)
-            chunks = bytearray(cursor)
-            for a in aggs:
-                for off, n, c in per_agg[a]:
-                    piece = np.zeros(n, np.uint8)
-                    comm.recv(piece, a, tag_reply)
-                    chunks[c:c + n] = piece.tobytes()
-            out = bytes(chunks)
-        for r in reqs:
-            r.wait(timeout=60)
-        comm.barrier()
-        return out
+        """Collective write (data given) or read of my view runs; the
+        aggregation strategy is the selected fcoll module (two_phase ≙
+        vulcan, individual ≙ fcoll/individual)."""
+        return self._fcoll.run(self, my_runs, data)
 
     def write_at_all(self, offset: int, buf: np.ndarray,
                      count: Optional[int] = None) -> int:
@@ -505,28 +390,23 @@ class File:
     def write_all_end(self, buf) -> int:
         return self._split_end("write_all", buf)
 
-    # -- shared file pointer (≙ sharedfp/sm) --------------------------------
+    # -- shared file pointer (storage selected from the sharedfp framework) -
 
     def _shared(self):
-        if self._shared_win is None:
-            # The window is created collectively in open(); recreating it
+        if self._sfp is None:
+            # The store is created collectively in open(); recreating it
             # lazily from a non-collective call site is the rank-subset
             # deadlock ADVICE r1 flagged, so refuse instead.
             raise RuntimeError("shared file pointer used after close")
-        return self._shared_win
+        return self._sfp
 
     def _seed_shared(self, value: int) -> None:
-        if self.comm.rank == 0 and self._shared_win is not None:
-            self._shared_win.local[0] = value
+        if self.comm.rank == 0 and self._sfp is not None:
+            self._sfp.write_value(value)
         self.comm.barrier()
 
     def _fetch_add_shared(self, delta: int) -> int:
-        win = self._shared()
-        res = np.zeros(1, np.int64)
-        win.lock(0)
-        win.fetch_and_op(np.array([delta], np.int64), res, 0, op=SUM)
-        win.unlock(0)
-        return int(res[0])
+        return self._shared().fetch_add(delta)
 
     def read_shared(self, buf, count: Optional[int] = None) -> int:
         arr = np.asarray(buf)
@@ -576,16 +456,14 @@ class File:
         return got
 
     def seek_shared(self, offset: int, whence: int = 0) -> None:
+        sfp = self._shared()
         if self.comm.rank == 0:
-            win = self._shared()
             if whence == 0:
-                win.local[0] = offset
+                sfp.write_value(offset)
             elif whence == 1:
-                win.local[0] += offset
+                sfp.write_value(sfp.read_value() + offset)
             else:
-                win.local[0] = self.size() // self.etype.size + offset
-        else:
-            self._shared()
+                sfp.write_value(self.size() // self.etype.size + offset)
         self.comm.barrier()
 
     def set_atomicity(self, flag: bool) -> None:

@@ -311,3 +311,75 @@ def test_delete_on_close_and_size():
 
     assert all(run(2, body))
     assert not os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# OMPIO sub-framework component selection (io/components.py ≙ ompi/mca/
+# {fs,fbtl,fcoll,sharedfp}): the same workloads must pass with the
+# alternative strategies forced via the framework selection vars.
+# ---------------------------------------------------------------------------
+
+def _select(framework, value):
+    from ompi_tpu.core import var
+    var.registry.set_cli(f"{framework}_select", value)
+    var.registry.reset_cache()
+
+
+def test_fcoll_individual_collective_io():
+    _select("fcoll", "individual")
+    path = _tmppath()
+    try:
+        def body(ctx):
+            comm = ctx.comm_world
+            f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+            assert type(f._fcoll).__name__ == "_IndividualFcoll"
+            # interleaved view: rank r owns every size-th int32 block of 4
+            ft = Datatype.vector(count=8, blocklength=4,
+                                 stride=4 * comm.size, base=INT32)
+            f.set_view(disp=comm.rank * 16, etype=INT32, filetype=ft)
+            data = np.arange(32, dtype=np.int32) + 1000 * comm.rank
+            f.write_at_all(0, data)
+            got = np.zeros(32, np.int32)
+            f.read_at_all(0, got)
+            np.testing.assert_array_equal(got, data)
+            f.close()
+            return True
+
+        assert all(run(4, body))
+    finally:
+        _select("fcoll", "")
+        os.unlink(path)
+
+
+def test_sharedfp_lockedfile():
+    _select("sharedfp", "lockedfile")
+    path = _tmppath()
+    try:
+        def body(ctx):
+            comm = ctx.comm_world
+            f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+            assert type(f._sfp).__name__ == "_LockedfileSharedfp"
+            mine = np.full(3, comm.rank, np.int32)
+            f.write_shared(mine)
+            comm.barrier()
+            # 4 ranks × 3 int32 each, disjoint slots in *some* order
+            got = np.zeros(3 * comm.size, np.int32)
+            f.read_at(0, got)
+            counts = {r: int(np.sum(got == r)) for r in range(comm.size)}
+            assert all(v == 3 for v in counts.values()), counts
+            # ordered write then deterministic layout
+            f.seek_shared(0)
+            f.write_ordered(np.full(2, 10 + comm.rank, np.int32))
+            comm.barrier()
+            got2 = np.zeros(2 * comm.size, np.int32)
+            f.read_at(0, got2)
+            expect = np.repeat(np.arange(comm.size) + 10, 2).astype(np.int32)
+            np.testing.assert_array_equal(got2, expect)
+            f.close()
+            assert not os.path.exists(path + ".sharedfp")
+            return True
+
+        assert all(run(4, body))
+    finally:
+        _select("sharedfp", "")
+        os.unlink(path)
