@@ -1,0 +1,345 @@
+"""MPI-style binding layer: argument validation + errhandler dispatch.
+
+≙ the role of the 438 per-function C bindings under ompi/mpi/c/ (SURVEY.md
+§2.3): every MPI entry point first validates its arguments, converts a bad
+one into the right MPI error *class*, routes it through the communicator's
+error handler, and only then dispatches into the frameworks (e.g.
+ompi/mpi/c/allreduce.c:95-118 err checks before :123 dispatch). The
+object-method API (`comm.send(...)`) is the idiomatic surface; this module
+is the strict facade on top for code that wants MPI's error semantics —
+every function takes the communicator first, checks args the way the C
+bindings do, and reports failures as ``MpiError`` with the matching error
+class through ``comm.call_errhandler``.
+
+    from ompi_tpu import api
+    api.send(comm, buf, dest=1, tag=0)
+    api.allreduce(comm, send, recv, op=op.SUM)
+
+Error classes mirror mpi.h's MPI_ERR_* constants (the stable subset this
+stack can actually detect).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .p2p.request import ANY_SOURCE, ANY_TAG
+
+# MPI error classes (mpi.h values where they exist; identity is the name)
+ERR_COMM = "MPI_ERR_COMM"
+ERR_COUNT = "MPI_ERR_COUNT"
+ERR_TYPE = "MPI_ERR_TYPE"
+ERR_TAG = "MPI_ERR_TAG"
+ERR_RANK = "MPI_ERR_RANK"
+ERR_ROOT = "MPI_ERR_ROOT"
+ERR_OP = "MPI_ERR_OP"
+ERR_BUFFER = "MPI_ERR_BUFFER"
+ERR_ARG = "MPI_ERR_ARG"
+
+
+class MpiError(RuntimeError):
+    """An argument/semantic error with its MPI error class attached."""
+
+    def __init__(self, error_class: str, message: str) -> None:
+        super().__init__(f"{error_class}: {message}")
+        self.error_class = error_class
+
+
+class _Handled(Exception):
+    """Internal: the comm's errhandler absorbed the error — the binding
+    must still abandon the call (the C bindings return the handler's code
+    without executing the operation)."""
+
+
+def _fail(comm, error_class: str, message: str):
+    """Route through the communicator's error handler: ERRORS_ARE_FATAL
+    (no handler) raises MpiError to the caller; a user handler runs, then
+    the binding returns None without dispatching."""
+    exc = MpiError(error_class, message)
+    if comm is not None and getattr(comm, "errhandler", None) is not None:
+        comm.call_errhandler(exc)
+        raise _Handled()
+    raise exc
+
+
+def _binding(fn):
+    """Wrap a public entry point: a handler-absorbed error → return None."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except _Handled:
+            return None
+
+    return wrapped
+
+
+def _check_comm(comm):
+    if comm is None or not hasattr(comm, "rank") or not hasattr(comm, "coll"):
+        raise MpiError(ERR_COMM, "null or invalid communicator")
+    return comm
+
+
+def _check_rank(comm, rank: int, what: str, wildcard: bool = False):
+    if wildcard and rank == ANY_SOURCE:
+        return rank
+    if not isinstance(rank, (int, np.integer)) or not \
+            (0 <= int(rank) < comm.size):
+        return _fail(comm, ERR_RANK if what != "root" else ERR_ROOT,
+                     f"{what}={rank!r} outside [0, {comm.size})")
+    return int(rank)
+
+
+def _check_tag(comm, tag: int, wildcard: bool = False):
+    if wildcard and tag == ANY_TAG:
+        return tag
+    if not isinstance(tag, (int, np.integer)) or int(tag) < 0:
+        return _fail(comm, ERR_TAG, f"tag={tag!r} (user tags must be ≥ 0)")
+    return int(tag)
+
+
+def _check_count(comm, count: Optional[int]):
+    if count is not None and (not isinstance(count, (int, np.integer))
+                              or int(count) < 0):
+        return _fail(comm, ERR_COUNT, f"count={count!r} must be ≥ 0")
+    return None if count is None else int(count)
+
+
+def _check_buffer(comm, buf, what: str = "buffer", allow_none: bool = False):
+    if buf is None:
+        if allow_none:
+            return None
+        return _fail(comm, ERR_BUFFER, f"{what} is None")
+    return buf
+
+
+def _check_op(comm, op):
+    if op is not None and not callable(op):
+        return _fail(comm, ERR_OP, f"op={op!r} is not an MPI op")
+    return op
+
+
+def _check_counts_list(comm, counts, what: str):
+    if counts is None:
+        return _fail(comm, ERR_COUNT, f"{what} is required")
+    counts = list(counts)
+    if len(counts) != comm.size:
+        return _fail(comm, ERR_COUNT,
+                     f"{what} has {len(counts)} entries for a "
+                     f"{comm.size}-rank communicator")
+    if any((not isinstance(c, (int, np.integer)) or c < 0) for c in counts):
+        return _fail(comm, ERR_COUNT, f"{what} entries must be ≥ 0")
+    return counts
+
+
+# -- point-to-point ---------------------------------------------------------
+
+@_binding
+def send(comm, buf, dest: int, tag: int = 0, count: Optional[int] = None):
+    """MPI_Send (≙ ompi/mpi/c/send.c arg checks, then pml dispatch)."""
+    _check_comm(comm)
+    _check_buffer(comm, buf)
+    dest = _check_rank(comm, dest, "dest")
+    tag = _check_tag(comm, tag)
+    count = _check_count(comm, count)
+    kw = {} if count is None else {"count": count}
+    return comm.send(buf, dest, tag, **kw)
+
+
+@_binding
+def isend(comm, buf, dest: int, tag: int = 0, count: Optional[int] = None):
+    _check_comm(comm)
+    _check_buffer(comm, buf)
+    dest = _check_rank(comm, dest, "dest")
+    tag = _check_tag(comm, tag)
+    count = _check_count(comm, count)
+    kw = {} if count is None else {"count": count}
+    return comm.isend(buf, dest, tag, **kw)
+
+
+@_binding
+def recv(comm, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+         count: Optional[int] = None):
+    """MPI_Recv — source/tag wildcards allowed (≙ ompi/mpi/c/recv.c)."""
+    _check_comm(comm)
+    _check_buffer(comm, buf)
+    source = _check_rank(comm, source, "source", wildcard=True)
+    tag = _check_tag(comm, tag, wildcard=True)
+    count = _check_count(comm, count)
+    kw = {} if count is None else {"count": count}
+    return comm.recv(buf, source, tag, **kw)
+
+
+@_binding
+def irecv(comm, buf, source: int = ANY_SOURCE, tag: int = ANY_TAG,
+          count: Optional[int] = None):
+    _check_comm(comm)
+    _check_buffer(comm, buf)
+    source = _check_rank(comm, source, "source", wildcard=True)
+    tag = _check_tag(comm, tag, wildcard=True)
+    count = _check_count(comm, count)
+    kw = {} if count is None else {"count": count}
+    return comm.irecv(buf, source, tag, **kw)
+
+
+@_binding
+def sendrecv(comm, sendbuf, dest: int, recvbuf, source: int,
+             sendtag: int = 0, recvtag: int = ANY_TAG):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    _check_buffer(comm, recvbuf, "recvbuf")
+    dest = _check_rank(comm, dest, "dest")
+    source = _check_rank(comm, source, "source", wildcard=True)
+    sendtag = _check_tag(comm, sendtag)
+    recvtag = _check_tag(comm, recvtag, wildcard=True)
+    return comm.sendrecv(sendbuf, dest, recvbuf, source, sendtag, recvtag)
+
+
+@_binding
+def probe(comm, source: int = ANY_SOURCE, tag: int = ANY_TAG, timeout=None):
+    _check_comm(comm)
+    source = _check_rank(comm, source, "source", wildcard=True)
+    tag = _check_tag(comm, tag, wildcard=True)
+    return comm.probe(source, tag, timeout=timeout)
+
+
+# -- collectives ------------------------------------------------------------
+
+@_binding
+def barrier(comm):
+    _check_comm(comm)
+    return comm.coll.barrier(comm)
+
+
+@_binding
+def bcast(comm, buf, root: int = 0):
+    _check_comm(comm)
+    _check_buffer(comm, buf)
+    root = _check_rank(comm, root, "root")
+    return comm.coll.bcast(comm, buf, root=root)
+
+
+@_binding
+def reduce(comm, sendbuf, recvbuf=None, op=None, root: int = 0):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    root = _check_rank(comm, root, "root")
+    op = _check_op(comm, op)
+    return comm.coll.reduce(comm, sendbuf, recvbuf, op=op, root=root)
+
+
+@_binding
+def allreduce(comm, sendbuf, recvbuf=None, op=None):
+    """MPI_Allreduce (≙ ompi/mpi/c/allreduce.c:95-118 checks, :123
+    dispatch)."""
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    op = _check_op(comm, op)
+    if recvbuf is not None:
+        r = np.asarray(recvbuf)
+        s = np.asarray(sendbuf)
+        if r.size < s.size:
+            return _fail(comm, ERR_BUFFER,
+                         f"recvbuf holds {r.size} elements, sendbuf {s.size}")
+    return comm.coll.allreduce(comm, sendbuf, recvbuf, op=op)
+
+
+@_binding
+def gather(comm, sendbuf, recvbuf=None, root: int = 0):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    root = _check_rank(comm, root, "root")
+    return comm.coll.gather(comm, sendbuf, recvbuf, root=root)
+
+
+@_binding
+def scatter(comm, sendbuf, recvbuf=None, root: int = 0):
+    _check_comm(comm)
+    root = _check_rank(comm, root, "root")
+    if comm.rank == root:
+        _check_buffer(comm, sendbuf, "sendbuf")
+    return comm.coll.scatter(comm, sendbuf, recvbuf, root=root)
+
+
+@_binding
+def allgather(comm, sendbuf, recvbuf=None):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    return comm.coll.allgather(comm, sendbuf, recvbuf)
+
+
+@_binding
+def allgatherv(comm, sendbuf, recvbuf=None, counts=None, displs=None):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    counts = _check_counts_list(comm, counts, "counts")
+    return comm.coll.allgatherv(comm, sendbuf, recvbuf, counts, displs)
+
+
+@_binding
+def alltoall(comm, sendbuf, recvbuf=None):
+    _check_comm(comm)
+    sb = np.asarray(_check_buffer(comm, sendbuf, "sendbuf"))
+    if sb.size % comm.size != 0:
+        return _fail(comm, ERR_COUNT,
+                     f"sendbuf size {sb.size} not divisible by comm size "
+                     f"{comm.size}")
+    return comm.coll.alltoall(comm, sendbuf, recvbuf)
+
+
+@_binding
+def alltoallv(comm, sendbuf, recvbuf, sendcounts, recvcounts,
+              sdispls=None, rdispls=None):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    _check_buffer(comm, recvbuf, "recvbuf")
+    sendcounts = _check_counts_list(comm, sendcounts, "sendcounts")
+    recvcounts = _check_counts_list(comm, recvcounts, "recvcounts")
+    return comm.coll.alltoallv(comm, sendbuf, recvbuf, sendcounts,
+                               recvcounts, sdispls, rdispls)
+
+
+@_binding
+def reduce_scatter(comm, sendbuf, recvbuf, counts, op=None):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    counts = _check_counts_list(comm, counts, "counts")
+    op = _check_op(comm, op)
+    sb = np.asarray(sendbuf)
+    if sb.size != int(np.sum(counts)):
+        return _fail(comm, ERR_COUNT,
+                     f"sendbuf size {sb.size} != sum(counts) "
+                     f"{int(np.sum(counts))}")
+    return comm.coll.reduce_scatter(comm, sendbuf, recvbuf, counts, op=op)
+
+
+@_binding
+def reduce_scatter_block(comm, sendbuf, recvbuf=None, op=None):
+    _check_comm(comm)
+    sb = np.asarray(_check_buffer(comm, sendbuf, "sendbuf"))
+    if sb.size % comm.size != 0:
+        return _fail(comm, ERR_COUNT,
+                     f"sendbuf size {sb.size} not divisible by comm size "
+                     f"{comm.size}")
+    op = _check_op(comm, op)
+    return comm.coll.reduce_scatter_block(comm, sendbuf, recvbuf, op=op)
+
+
+@_binding
+def scan(comm, sendbuf, recvbuf=None, op=None):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    op = _check_op(comm, op)
+    return comm.coll.scan(comm, sendbuf, recvbuf, op=op)
+
+
+@_binding
+def exscan(comm, sendbuf, recvbuf=None, op=None):
+    _check_comm(comm)
+    _check_buffer(comm, sendbuf, "sendbuf")
+    op = _check_op(comm, op)
+    return comm.coll.exscan(comm, sendbuf, recvbuf, op=op)

@@ -35,8 +35,16 @@ from .tcp import Coordinator
 
 def build_env(base: Dict[str, str], rank: int, size: int, coord: str,
               job: str, mca: List[str], chips_per_rank: int = 0,
-              device_plane: str = "none") -> Dict[str, str]:
+              device_plane: str = "none",
+              bind_to: str = "none") -> Dict[str, str]:
     env = dict(base)
+    if bind_to != "none":
+        # CPU binding (≙ PRRTE --map-by package --bind-to core): the rank
+        # applies its cpuset at Context init (hwtopo.apply_env_binding)
+        from ..core import hwtopo
+        cpus = hwtopo.bind_plan(size, bind_to)[rank]
+        if cpus:
+            env["OMPI_TPU_BIND_CPUS"] = ",".join(map(str, cpus))
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
     env["OMPI_TPU_COORD"] = coord
@@ -82,6 +90,11 @@ def main(argv: List[str] | None = None) -> int:
     ap.add_argument("--device-plane", choices=["none", "cpu"], default="none",
                     help="'cpu' gives each rank one virtual CPU device "
                          "(multi-process test fabric)")
+    ap.add_argument("--bind-to", choices=["none", "core", "package"],
+                    default="none",
+                    help="bind each rank's CPUs (≙ mpirun --bind-to): "
+                         "'core' spreads ranks across packages then cores, "
+                         "'package' gives each rank a whole package")
     ap.add_argument("--enable-recovery", action="store_true",
                     help="ULFM mode (≙ prte --enable-recovery): a failed "
                          "rank does NOT take the job down; survivors run "
@@ -128,7 +141,8 @@ def main(argv: List[str] | None = None) -> int:
     env_base["PYTHONPATH"] = pkg_root + os.pathsep + env_base.get("PYTHONPATH", "")
     for rank in range(args.np):
         env = build_env(env_base, rank, args.np, coord_str, coord.job_id,
-                        mca, args.chips_per_rank, args.device_plane)
+                        mca, args.chips_per_rank, args.device_plane,
+                        args.bind_to)
         procs.append(subprocess.Popen(cmd, env=env))
 
     def kill_all(sig=signal.SIGTERM):

@@ -1,0 +1,96 @@
+"""Debugger message-queue introspection (MPIR analog).
+
+≙ ompi/debuggers/ — the reference ships a debugger-interface DLL that lets
+TotalView/DDT walk every rank's three message queues (posted receives,
+unexpected messages, pending sends) plus the MPIR attach gate. There is no
+C debugger front-end to attach here, so the same capability is exposed the
+Python-native way:
+
+  * ``message_queues(ctx)``  — structured snapshot of the three queues
+  * ``dump(ctx)``            — human-readable dump (what a debugger shows)
+  * ``install_signal_dump(ctx, signum)`` — dump-on-signal for hung-job
+    triage of live processes: ``kill -USR2 <pid>`` prints every queue, the
+    moral equivalent of attaching the MPIR DLL to a stuck rank
+
+The snapshot walks live matching-engine state from whatever thread calls
+it; like any debugger attach it is a racy read of a running program —
+fine for triage, not a synchronization point.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List
+
+from .p2p.matching import ANY_SOURCE, ANY_TAG
+
+
+def _fmt(v: int, anyv: int) -> str:
+    return "ANY" if v == anyv else str(v)
+
+
+def message_queues(ctx) -> Dict[str, List[Dict[str, Any]]]:
+    """Snapshot the rank's posted-recv / unexpected / pending-send queues."""
+    eng = ctx.p2p.matching
+    posted = [
+        {"cid": cid, "src": p.src, "tag": p.tag}
+        for cid, lst in list(eng._posted.items())
+        for p in list(lst)
+    ]
+    unexpected = [
+        {"cid": cid, "src": u.src, "tag": u.tag, "seq": u.seq,
+         "kind": u.kind, "nbytes": len(u.payload)}
+        for cid, by_src in list(eng._unexpected.items())
+        for _src, q in list(by_src.items())
+        for u in list(q)
+    ]
+    pending_sends = [
+        {"transport": mod.name, "frames": int(mod.pending_count())}
+        for mod in ctx.layer.transports
+        if mod.pending_count() > 0
+    ]
+    return {"posted": posted, "unexpected": unexpected,
+            "pending_sends": pending_sends}
+
+
+def dump(ctx, file=None) -> str:
+    """Format (and optionally print) the queues the way a debugger's
+    message-queue window would."""
+    q = message_queues(ctx)
+    lines = [f"[rank {ctx.rank}] message queues "
+             f"(posted={len(q['posted'])}, "
+             f"unexpected={len(q['unexpected'])}, "
+             f"pending_send_frames="
+             f"{sum(p['frames'] for p in q['pending_sends'])})"]
+    for p in q["posted"]:
+        lines.append(f"  posted recv: cid={p['cid']} "
+                     f"src={_fmt(p['src'], ANY_SOURCE)} "
+                     f"tag={_fmt(p['tag'], ANY_TAG)}")
+    for u in q["unexpected"]:
+        lines.append(f"  unexpected:  cid={u['cid']} src={u['src']} "
+                     f"tag={u['tag']} seq={u['seq']} kind={u['kind']} "
+                     f"{u['nbytes']}B")
+    for s in q["pending_sends"]:
+        lines.append(f"  pending tx:  {s['transport']} "
+                     f"{s['frames']} frame(s) awaiting wire space")
+    text = "\n".join(lines)
+    if file is not None:
+        print(text, file=file, flush=True)
+    return text
+
+
+def install_signal_dump(ctx, signum=None) -> bool:
+    """Dump queues to stderr on ``signum`` (default SIGUSR2). Only the main
+    thread may install handlers; returns False from other threads (threaded
+    run_ranks contexts share the process — use dump() directly there)."""
+    import signal
+    import threading
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    signum = signum if signum is not None else signal.SIGUSR2
+
+    def handler(_sig, _frm):
+        dump(ctx, file=sys.stderr)
+
+    signal.signal(signum, handler)
+    return True

@@ -65,10 +65,12 @@ def spawn(comm: Communicator, command: Sequence[str], maxprocs: int,
             coord = ctx.bootstrap.coord_address
             for i, child in enumerate(children):
                 env = dict(os.environ)
-                # chip binding does NOT inherit: the children are a new job
-                # placement the caller controls via env_extra (≙ the
-                # MPI_Info keys of MPI_Comm_spawn)
+                # chip and CPU binding do NOT inherit: the children are a
+                # new job placement the caller controls via env_extra
+                # (≙ the MPI_Info keys of MPI_Comm_spawn) — inheriting the
+                # parent's cpuset would pile every child onto one core
                 env.pop("TPU_VISIBLE_DEVICES", None)
+                env.pop("OMPI_TPU_BIND_CPUS", None)
                 if env_extra:
                     env.update(env_extra)
                 env.update({

@@ -351,12 +351,15 @@ class _LockedfileSharedfp:
 
     def free(self) -> None:             # collective
         os.close(self.fd)
-        self.comm.barrier()
+        # unlink BEFORE the barrier: peers with the sidecar still open are
+        # unaffected (POSIX), and after the barrier every rank may assume
+        # the name is gone
         if self.comm.rank == 0:
             try:
                 os.unlink(self.path)
             except OSError:
                 pass
+        self.comm.barrier()
 
 
 @component("sharedfp", "lockedfile", priority=10)

@@ -15,6 +15,7 @@ from collections import defaultdict, deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .request import ANY_SOURCE, ANY_TAG
+from .. import peruse
 
 
 def _tag_matches(posted_tag: int, msg_tag: int) -> bool:
@@ -73,10 +74,16 @@ class MatchingEngine:
         """
         match = self._find_unexpected(cid, src, tag)
         if match is not None:
+            if peruse.active:
+                peruse.fire(peruse.REQ_MATCH_UNEX, cid=cid, src=match.src,
+                            tag=match.tag, seq=match.seq)
             on_match(match)
             return None
         p = Posted(src, tag, on_match, req)
         self._posted[cid].append(p)
+        if peruse.active:
+            peruse.fire(peruse.REQ_INSERT_IN_POSTED_Q, cid=cid, src=src,
+                        tag=tag)
         return p
 
     def fail_src(self, src: int, err: Exception,
@@ -153,6 +160,9 @@ class MatchingEngine:
                 return
         if self.spc is not None:
             self.spc.inc("unexpected_arrivals")
+        if peruse.active:
+            peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, cid=cid, src=u.src,
+                        tag=u.tag, seq=u.seq)
         self._unexpected[cid][u.src].append(u)
 
     # -- probe --------------------------------------------------------------

@@ -41,6 +41,7 @@ from ..datatype import Convertor, Datatype, from_numpy
 from . import transport as T
 from .matching import MatchingEngine, Unexpected
 from .request import ANY_SOURCE, ANY_TAG, Request
+from .. import peruse
 
 
 class TruncateError(RuntimeError):
@@ -193,6 +194,9 @@ class P2P:
         seq = self._send_seq[(cid, dst)]
         self._send_seq[(cid, dst)] = seq + 1
         transport = self.layer.for_peer(dst)
+        if peruse.active:           # ≙ PERUSE_COMM_REQ_ACTIVATE from isend
+            peruse.fire(peruse.REQ_ACTIVATE, kind="send", peer=dst,
+                        tag=tag, cid=cid, nbytes=nbytes)
         self.spc.inc("isends")
         self.spc.inc("bytes_sent", nbytes)
         self.spc.peer_traffic("tx", dst, nbytes)
@@ -239,6 +243,9 @@ class P2P:
               cid: int = 0, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
         req, on_match = self._recv_handler(buf, datatype, count)
+        if peruse.active:
+            peruse.fire(peruse.REQ_ACTIVATE, kind="recv", peer=src,
+                        tag=tag, cid=cid)
         posted = self.matching.post_recv(cid, src, tag, on_match, req=req)
         if posted is None:
             self.spc.inc("matches_unexpected")

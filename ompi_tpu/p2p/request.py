@@ -5,6 +5,7 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional
 
 from ..core.progress import get_engine
+from .. import peruse
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -55,6 +56,9 @@ class Request:
             return
         self.error = error
         self.done = True
+        if peruse.active:       # ≙ PERUSE_COMM_REQ_COMPLETE
+            peruse.fire(peruse.REQ_COMPLETE, count=self.status.count,
+                        error=error is not None)
         for cb in self._on_complete:
             cb(self)
         self._on_complete.clear()

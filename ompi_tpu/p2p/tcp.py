@@ -35,21 +35,34 @@ _HDR = struct.Struct("!II")
 
 def _advertised_host() -> str:
     """The address peers should dial: loopback for single-host jobs, the
-    interface routing toward the coordinator for multi-host (DCN) jobs."""
+    best-weighted interface toward the coordinator for multi-host (DCN)
+    jobs (reachable.py ≙ opal/mca/reachable/weighted), falling back to a
+    kernel routing probe when enumeration finds nothing."""
     import os
 
     coord = os.environ.get("OMPI_TPU_COORD", "")
     host = coord.rpartition(":")[0]
     if not host or host.startswith("127.") or host == "localhost":
         return "127.0.0.1"
+    # the kernel routing table is authoritative when it has an answer: a
+    # UDP connect names the source interface that actually routes toward
+    # the coordinator (weighting must never override routing — a private
+    # storage NIC may score high yet be unreachable from the peers)
     probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
     try:
         probe.connect((host, 1))
         return probe.getsockname()[0]
     except OSError:
-        return socket.gethostbyname(socket.gethostname())
+        pass
     finally:
         probe.close()
+    # no route answer (resolver down, UDP filtered): fall back to the
+    # weighted interface ladder, then the hostname
+    from .reachable import best_address
+    picked = best_address(host)
+    if picked is not None and not picked.startswith("127."):
+        return picked
+    return socket.gethostbyname(socket.gethostname())
 
 
 class _Conn:

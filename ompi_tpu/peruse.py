@@ -1,0 +1,72 @@
+"""PERUSE-style per-request event introspection.
+
+≙ ompi/peruse/peruse.h:55 — the (legacy but still-shipped) MPI performance
+revealing extension: tools register callbacks on request-lifecycle events
+and see exactly when a request activates, enters the posted queue, matches
+an unexpected message, and completes (the reference fires these from ob1,
+e.g. pml_ob1_isend.c:322). The monitoring/PMPI hooks (monitoring.py) count
+calls at the API boundary; PERUSE exposes the *protocol* timeline
+underneath — queue residency and match latency, the two quantities
+matching-engine tuning needs.
+
+Events:
+  REQ_ACTIVATE            send/recv request handed to the pml
+  REQ_INSERT_IN_POSTED_Q  recv had no unexpected match; parked in posted q
+  REQ_MATCH_UNEX          recv matched an already-arrived unexpected msg
+  MSG_INSERT_IN_UNEX_Q    arrival found no posted recv; parked unexpected
+  REQ_COMPLETE            request completed
+
+Callbacks run on the rank's progress thread: keep them cheap, do not call
+p2p from inside one. The hot path pays a single truthiness check while no
+subscriber exists (same gating discipline as monitoring.coll_event).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List
+
+REQ_ACTIVATE = "req_activate"
+REQ_INSERT_IN_POSTED_Q = "req_insert_in_posted_q"
+REQ_MATCH_UNEX = "req_match_unex"
+MSG_INSERT_IN_UNEX_Q = "msg_insert_in_unex_q"
+REQ_COMPLETE = "req_complete"
+
+EVENTS = (REQ_ACTIVATE, REQ_INSERT_IN_POSTED_Q, REQ_MATCH_UNEX,
+          MSG_INSERT_IN_UNEX_Q, REQ_COMPLETE)
+
+# event → [callback(event, info_dict)]; `active` mirrors "any subscriber"
+_subscribers: Dict[str, List[Callable]] = {}
+_lock = threading.Lock()
+active = False
+
+
+def subscribe(event: str, cb: Callable) -> Callable:
+    """Register cb(event, info) for an event; returns cb (for unsubscribe).
+    info keys: kind ('send'|'recv'), src/dst, tag, cid, and for arrivals
+    seq — whatever the fire site knows cheaply."""
+    global active
+    if event not in EVENTS:
+        raise ValueError(f"unknown PERUSE event {event!r} (one of {EVENTS})")
+    with _lock:
+        _subscribers.setdefault(event, []).append(cb)
+        active = True
+    return cb
+
+
+def unsubscribe(event: str, cb: Callable) -> None:
+    global active
+    with _lock:
+        subs = _subscribers.get(event, [])
+        if cb in subs:
+            subs.remove(cb)
+        active = any(_subscribers.values())
+
+
+def fire(event: str, **info) -> None:
+    """Call-site entry point; call sites guard with ``if peruse.active``."""
+    for cb in _subscribers.get(event, ()):
+        try:
+            cb(event, info)
+        except Exception:       # a broken tool must not break the app
+            pass

@@ -57,6 +57,12 @@ class Context:
                           else (1 << 43) | int(_os.environ.get(
                               "OMPI_TPU_SPAWN_GROUP", "0")))
         self.size = wsize
+        # CPU binding (≙ PRRTE applying the hwloc cpuset before app start):
+        # the launcher computes per-rank cpusets (--bind-to) and passes
+        # them down; a rank binds itself first thing so every thread it
+        # spawns (progress, io worker) inherits the set
+        from .core import hwtopo
+        self.bound_cpus = hwtopo.apply_env_binding()
         self.engine = ProgressEngine()
         self.am_table: dict = {}
         mods = []

@@ -55,6 +55,10 @@ def main(argv=None) -> int:
             print(f"      {v.help}")
 
     if args.all:
+        from ..core import hwtopo
+        print("\nhost topology (hwloc-lite, core/hwtopo.py):")
+        for line in hwtopo.topology().summary().splitlines():
+            print(f"  {line}")
         try:
             import jax
 

@@ -1,0 +1,84 @@
+"""MPI-style binding layer (api.py ≙ the 438 C bindings' arg-validation +
+errhandler dispatch role, e.g. ompi/mpi/c/allreduce.c:95-118)."""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import api, runtime
+
+
+def test_valid_calls_dispatch():
+    def fn(ctx):
+        c = ctx.comm_world
+        out = api.allreduce(c, np.arange(4.) * (c.rank + 1))
+        api.barrier(c)
+        if c.rank == 0:
+            api.send(c, np.arange(3.), dest=1, tag=5)
+        elif c.rank == 1:
+            buf = np.zeros(3)
+            api.recv(c, buf, source=0, tag=5)
+            np.testing.assert_array_equal(buf, np.arange(3.))
+        return np.asarray(out)
+
+    res = runtime.run_ranks(2, fn)
+    expect = np.arange(4.) * 1 + np.arange(4.) * 2
+    for r in res:
+        np.testing.assert_allclose(r, expect)
+
+
+def test_validation_error_classes():
+    def fn(ctx):
+        c = ctx.comm_world
+        classes = {}
+
+        def grab(name, call):
+            with pytest.raises(api.MpiError) as ei:
+                call()
+            classes[name] = ei.value.error_class
+
+        grab("rank", lambda: api.send(c, np.zeros(1), dest=99))
+        grab("neg_rank", lambda: api.send(c, np.zeros(1), dest=-1))
+        grab("tag", lambda: api.send(c, np.zeros(1), dest=0, tag=-5))
+        grab("count", lambda: api.send(c, np.zeros(1), dest=0, count=-2))
+        grab("buffer", lambda: api.send(c, None, dest=0))
+        grab("root", lambda: api.bcast(c, np.zeros(1), root=5))
+        grab("comm", lambda: api.barrier(None))
+        grab("op", lambda: api.allreduce(c, np.zeros(1), op="max"))
+        grab("counts", lambda: api.allgatherv(c, np.zeros(1), counts=[1]))
+        grab("a2a", lambda: api.alltoall(c, np.zeros(3)))
+        grab("rs", lambda: api.reduce_scatter(
+            c, np.zeros(3), np.zeros(2), counts=[2, 2]))
+        grab("recvbuf", lambda: api.allreduce(c, np.zeros(8), np.zeros(2)))
+        assert classes == {
+            "rank": api.ERR_RANK, "neg_rank": api.ERR_RANK,
+            "tag": api.ERR_TAG, "count": api.ERR_COUNT,
+            "buffer": api.ERR_BUFFER, "root": api.ERR_ROOT,
+            "comm": api.ERR_COMM, "op": api.ERR_OP,
+            "counts": api.ERR_COUNT, "a2a": api.ERR_COUNT,
+            "rs": api.ERR_COUNT, "recvbuf": api.ERR_BUFFER,
+        }
+        return True
+
+    assert all(runtime.run_ranks(2, fn))
+
+
+def test_errhandler_swallows():
+    """A user errhandler (MPI_ERRORS_RETURN analog) absorbs the error; the
+    binding returns None instead of raising (≙ errhandler invocation in
+    every C binding's error path)."""
+    def fn(ctx):
+        c = ctx.comm_world
+        seen = []
+        c.set_errhandler(lambda comm, exc: seen.append(exc))
+        try:
+            out = api.send(c, np.zeros(1), dest=42)
+            assert out is None
+            assert len(seen) == 1 and isinstance(seen[0], api.MpiError)
+            assert seen[0].error_class == api.ERR_RANK
+        finally:
+            c.set_errhandler(None)
+        with pytest.raises(api.MpiError):
+            api.send(c, np.zeros(1), dest=42)
+        return True
+
+    assert all(runtime.run_ranks(2, fn))

@@ -151,3 +151,46 @@ def test_show_help_dedup(capsys):
     sh.show("no-component", "coll", "coll_select", "")
     err = capsys.readouterr().err
     assert err.count("No usable component") == 1
+
+
+# ---------------------------------------------------------------------------
+# hwloc-lite host topology + binding (core/hwtopo.py ≙ opal/mca/hwloc + the
+# PRRTE binding role, SURVEY.md §2.2 row 24 / §3.4)
+# ---------------------------------------------------------------------------
+
+def test_hwtopo_discovery_and_plan():
+    from ompi_tpu.core import hwtopo
+    mach = hwtopo.topology(refresh=True)
+    assert mach.n_pus >= 1
+    assert mach.n_cores >= 1
+    assert len(mach.packages) >= 1
+    assert "machine:" in mach.summary()
+    # every PU appears exactly once in the tree
+    pus = [pu for p in mach.packages for c in p.cores for pu in c.pus]
+    assert len(pus) == len(set(pus))
+    for n in (1, 2, 5):
+        plan = hwtopo.bind_plan(n, "core")
+        assert len(plan) == n and all(cs for cs in plan)
+        plan = hwtopo.bind_plan(n, "package")
+        assert len(plan) == n and all(cs for cs in plan)
+    assert hwtopo.bind_plan(3, "none") == [[], [], []]
+
+
+def test_hwtopo_cpulist_and_env_binding():
+    from ompi_tpu.core import hwtopo
+    assert hwtopo._parse_cpulist("0-3,8,10-11") == [0, 1, 2, 3, 8, 10, 11]
+    assert hwtopo.apply_env_binding({}) is None
+    import os
+    mine = sorted(os.sched_getaffinity(0))
+    got = hwtopo.apply_env_binding(
+        {"OMPI_TPU_BIND_CPUS": ",".join(map(str, mine))})
+    assert got == mine
+
+
+def test_launcher_bind_env():
+    from ompi_tpu.control.launch import build_env
+    env = build_env({}, rank=0, size=2, coord="h:1", job="j", mca=[],
+                    bind_to="core")
+    assert "OMPI_TPU_BIND_CPUS" in env
+    env2 = build_env({}, rank=0, size=2, coord="h:1", job="j", mca=[])
+    assert "OMPI_TPU_BIND_CPUS" not in env2

@@ -283,3 +283,94 @@ def test_hook_framework_comm_method(capsys):
         var.registry.reset_cache()
         from ompi_tpu.core.component import frameworks
         frameworks.framework("hook").components.pop("probe_test", None)
+
+
+# ---------------------------------------------------------------------------
+# PERUSE-style request-lifecycle events (peruse.py ≙ ompi/peruse/peruse.h:55,
+# fired from the pml/matching protocol path like pml_ob1_isend.c:322)
+# ---------------------------------------------------------------------------
+
+def test_peruse_event_timeline():
+    import numpy as np
+    from ompi_tpu import peruse, runtime
+
+    events = []
+
+    def cb(event, info):
+        events.append((event, info.get("kind"), info.get("tag")))
+
+    subs = [(e, peruse.subscribe(e, cb)) for e in peruse.EVENTS]
+    try:
+        def fn(ctx):
+            c = ctx.comm_world
+            if c.rank == 0:
+                c.send(np.arange(4.), 1, tag=7)
+                # unexpected path: send before the recv is posted
+                c.send(np.arange(4.), 1, tag=8)
+                c.barrier()
+            else:
+                buf = np.zeros(4)
+                c.recv(buf, 0, tag=7)
+                c.barrier()          # tag-8 frame has arrived by now
+                c.recv(buf, 0, tag=8)
+            return True
+
+        assert all(runtime.run_ranks(2, fn))
+        kinds = {e for e, _k, _t in events}
+        assert peruse.REQ_ACTIVATE in kinds
+        assert peruse.REQ_COMPLETE in kinds
+        # the tag-7 recv was posted first → posted-queue insert; the tag-8
+        # send arrived before its recv → unexpected-queue insert + match
+        assert peruse.REQ_INSERT_IN_POSTED_Q in kinds
+        assert peruse.MSG_INSERT_IN_UNEX_Q in kinds
+        assert peruse.REQ_MATCH_UNEX in kinds
+        sends = [t for e, k, t in events
+                 if e == peruse.REQ_ACTIVATE and k == "send"]
+        assert 7 in sends and 8 in sends
+    finally:
+        for e, s in subs:
+            peruse.unsubscribe(e, s)
+    assert not peruse.active
+
+
+def test_peruse_inactive_by_default():
+    from ompi_tpu import peruse
+    assert not peruse.active
+    peruse.fire(peruse.REQ_COMPLETE)     # no subscribers: harmless
+
+
+# ---------------------------------------------------------------------------
+# MPIR-style message-queue introspection (debuggers.py ≙ ompi/debuggers/)
+# ---------------------------------------------------------------------------
+
+def test_debugger_message_queue_dump():
+    import numpy as np
+    from ompi_tpu import debuggers, runtime
+
+    def fn(ctx):
+        c = ctx.comm_world
+        if c.rank == 0:
+            # park an unexpected message at rank 1 (no recv posted there)
+            c.send(np.arange(8.), 1, tag=99)
+            # post a recv that will never match → visible in posted queue
+            req = c.irecv(np.zeros(4), 1, tag=123)
+            c.barrier()
+            snap = debuggers.message_queues(ctx)
+            assert any(p["tag"] == 123 for p in snap["posted"]), snap
+            text = debuggers.dump(ctx)
+            assert "posted recv" in text and "tag=123" in text
+            req.cancel() if hasattr(req, "cancel") else None
+            c.barrier()
+            return True
+        c.barrier()          # tag-99 frame arrives, sits unexpected
+        snap = debuggers.message_queues(ctx)
+        assert any(u["tag"] == 99 for u in snap["unexpected"]), snap
+        text = debuggers.dump(ctx)
+        assert "unexpected" in text and "tag=99" in text
+        # drain it so finalize is clean
+        buf = np.zeros(8)
+        c.recv(buf, 0, tag=99)
+        c.barrier()
+        return True
+
+    assert all(runtime.run_ranks(2, fn))

@@ -347,3 +347,34 @@ class TestMatchedProbe:
 
         res = runtime.run_ranks(2, fn, timeout=90)
         assert res[1] is True
+
+
+# ---------------------------------------------------------------------------
+# NIC enumeration + weighted reachability (p2p/reachable.py ≙ opal/mca/if +
+# opal/mca/reachable/weighted)
+# ---------------------------------------------------------------------------
+
+def test_reachable_enumeration_and_localhost():
+    from ompi_tpu.p2p import reachable as R
+    ifs = R.interfaces()
+    assert any(i.loopback for i in ifs), "loopback must enumerate"
+    for i in ifs:
+        assert i.addr.count(".") == 3
+    # single-host target: loopback wins
+    assert R.best_address("localhost") == "127.0.0.1"
+
+
+def test_reachable_weight_ladder():
+    from ompi_tpu.p2p.reachable import Iface, weight
+    lo = Iface("lo", "127.0.0.1", "255.0.0.0", True, True, -1)
+    down = Iface("eth9", "10.0.0.9", "255.255.255.0", False, False, 100000)
+    private = Iface("eth0", "10.1.2.3", "255.255.0.0", True, False, 10000)
+    public = Iface("eth1", "8.8.4.4", "255.255.255.0", True, False, 100000)
+    target = "10.1.9.9"      # same /16 as `private`
+    assert weight(down, target) < 0
+    assert weight(private, target) > weight(public, target)
+    assert weight(public, target) > weight(lo, target)
+    # remote public-only target: private fabric addr still preferred over lo
+    assert weight(private, "93.184.216.34") > weight(lo, "93.184.216.34")
+    # localhost target inverts the ladder
+    assert weight(lo, "127.0.0.1") > weight(private, "127.0.0.1")
