@@ -43,18 +43,25 @@ COLLS = ["allreduce", "bcast", "allgather", "alltoall"]
 
 
 def pick_platform(probe_timeout: float = 120.0) -> str:
-    """Probe TPU availability in a subprocess so a hung plugin init cannot
-    wedge the bench itself."""
+    """Probe accelerator availability in a subprocess so a hung plugin init
+    cannot wedge the bench itself. Returns "accel" when DEFAULT backend
+    selection lands on a non-cpu device, else "cpu". Deliberately does NOT
+    name a platform to force: plugin registration names and device
+    .platform strings disagree (this image's tunneled chip registers its
+    backend as 'axon' while devices report platform 'tpu' — forcing either
+    string picks the wrong plugin; both failure modes happened in round 2).
+    The accel path therefore leaves jax.config untouched and trusts the
+    same default selection the probe validated."""
     forced = os.environ.get("OMPI_TPU_BENCH_PLATFORM")
     if forced:
         return forced
-    code = ("import jax; jax.config.update('jax_platforms','tpu'); "
-            "print(len(jax.devices()))")
+    code = ("import jax; ds = jax.devices(); "
+            "print(sum(d.platform != 'cpu' for d in ds))")
     try:
         r = subprocess.run([sys.executable, "-c", code],
                            capture_output=True, timeout=probe_timeout)
         if r.returncode == 0 and int(r.stdout.strip() or 0) > 0:
-            return "tpu"
+            return "accel"
     except Exception:
         pass
     return "cpu"
@@ -104,7 +111,7 @@ def run_sweep(platform: str) -> dict:
     # only the TUNNELED single-chip case has shown block_until_ready lying;
     # on a real multi-chip pod a one-element read would under-measure (it
     # need not wait for every shard), so keep the true barrier there
-    _PARANOID_BARRIER = platform == "tpu" and ndev == 1
+    _PARANOID_BARRIER = platform != "cpu" and ndev == 1
     # rank-per-chip when we have chips; single-chip bench mode keeps 8
     # logical ranks resident on the one device (local-fold regime)
     rows = ndev if ndev > 1 else 8
@@ -258,7 +265,13 @@ def main() -> None:
                 os.environ["XLA_FLAGS"]:
             os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
         import jax
-        jax.config.update("jax_platforms", platform)
+        if platform == "cpu":
+            jax.config.update("jax_platforms", "cpu")
+        elif platform != "accel":
+            # OMPI_TPU_BENCH_PLATFORM named a specific backend: honor it
+            jax.config.update("jax_platforms", platform)
+        # accel: leave selection alone — see pick_platform
+        platform = jax.devices()[0].platform
 
         sweep = run_sweep(platform)
         here = os.path.dirname(os.path.abspath(__file__))

@@ -1,0 +1,17 @@
+"""Hello world (≙ examples/hello_c.c).
+
+Run:  python -m ompi_tpu.tools.tpurun -np 4 examples/hello.py
+"""
+
+from ompi_tpu import runtime
+
+
+def main() -> int:
+    ctx = runtime.init()
+    print(f"Hello, world, I am {ctx.rank} of {ctx.size}", flush=True)
+    ctx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

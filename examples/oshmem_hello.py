@@ -1,0 +1,34 @@
+"""OpenSHMEM-style PGAS example (≙ examples/oshmem_symmetric_data.c /
+hello_oshmem_c.c): symmetric allocation, one-sided put, fence, verify.
+
+Run:  python -m ompi_tpu.tools.tpurun -np 4 examples/oshmem_hello.py
+"""
+
+import numpy as np
+
+from ompi_tpu import runtime
+from ompi_tpu import shmem
+
+
+def main() -> int:
+    ctx = runtime.init()
+    shmem.init(ctx)
+    me, n = shmem.my_pe(), shmem.n_pes()
+    print(f"Hello, world, I am PE {me} of {n}", flush=True)
+    # symmetric array: every PE writes its id into the NEXT PE's slot 0
+    sym = shmem.smalloc((1,), np.int64)
+    shmem.put(sym, np.array([me], np.int64), (me + 1) % n)
+    shmem.quiet()
+    shmem.barrier_all()
+    got = int(sym.local[0])
+    assert got == (me - 1) % n, f"PE {me}: expected {(me - 1) % n}, got {got}"
+    if me == 0:
+        print(f"symmetric put/verify on {n} PEs PASSED", flush=True)
+    shmem.sfree(sym)
+    shmem.finalize()
+    ctx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,34 @@
+"""Software performance counters example (≙ examples/spc_example.c:
+exercise some traffic, then read the SPC counters through the MPI_T-style
+pvar interface).
+
+Run:  python -m ompi_tpu.tools.tpurun -np 2 examples/spc_counters.py
+"""
+
+import numpy as np
+
+from ompi_tpu import runtime
+from ompi_tpu.mpit import pvar_read_all
+
+
+def main() -> int:
+    ctx = runtime.init()
+    c = ctx.comm_world
+    buf = np.zeros(1024, np.float64)
+    for i in range(10):
+        if ctx.rank == 0:
+            c.send(np.full(1024, float(i)), 1, tag=1)
+        elif ctx.rank == 1:
+            c.recv(buf, 0, tag=1)
+        c.barrier()
+    if ctx.rank == 0:
+        print("SPC pvars after 10 sends + barriers:", flush=True)
+        for name, v in sorted(pvar_read_all(ctx).items()):
+            if v:
+                print(f"  {name} = {v}", flush=True)
+    ctx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -240,11 +240,12 @@ def allreduce(comm, sendbuf, recvbuf=None, op=None):
     _check_buffer(comm, sendbuf, "sendbuf")
     op = _check_op(comm, op)
     if recvbuf is not None:
-        r = np.asarray(recvbuf)
-        s = np.asarray(sendbuf)
-        if r.size < s.size:
+        # np.size reads the duck-typed attribute — no device-to-host copy
+        # for jax arrays (validation must never move the payload)
+        rs, ss = np.size(recvbuf), np.size(sendbuf)
+        if rs < ss:
             return _fail(comm, ERR_BUFFER,
-                         f"recvbuf holds {r.size} elements, sendbuf {s.size}")
+                         f"recvbuf holds {rs} elements, sendbuf {ss}")
     return comm.coll.allreduce(comm, sendbuf, recvbuf, op=op)
 
 
@@ -283,10 +284,10 @@ def allgatherv(comm, sendbuf, recvbuf=None, counts=None, displs=None):
 @_binding
 def alltoall(comm, sendbuf, recvbuf=None):
     _check_comm(comm)
-    sb = np.asarray(_check_buffer(comm, sendbuf, "sendbuf"))
-    if sb.size % comm.size != 0:
+    n = np.size(_check_buffer(comm, sendbuf, "sendbuf"))
+    if n % comm.size != 0:
         return _fail(comm, ERR_COUNT,
-                     f"sendbuf size {sb.size} not divisible by comm size "
+                     f"sendbuf size {n} not divisible by comm size "
                      f"{comm.size}")
     return comm.coll.alltoall(comm, sendbuf, recvbuf)
 
@@ -309,10 +310,10 @@ def reduce_scatter(comm, sendbuf, recvbuf, counts, op=None):
     _check_buffer(comm, sendbuf, "sendbuf")
     counts = _check_counts_list(comm, counts, "counts")
     op = _check_op(comm, op)
-    sb = np.asarray(sendbuf)
-    if sb.size != int(np.sum(counts)):
+    n = np.size(sendbuf)
+    if n != int(np.sum(counts)):
         return _fail(comm, ERR_COUNT,
-                     f"sendbuf size {sb.size} != sum(counts) "
+                     f"sendbuf size {n} != sum(counts) "
                      f"{int(np.sum(counts))}")
     return comm.coll.reduce_scatter(comm, sendbuf, recvbuf, counts, op=op)
 
@@ -320,10 +321,10 @@ def reduce_scatter(comm, sendbuf, recvbuf, counts, op=None):
 @_binding
 def reduce_scatter_block(comm, sendbuf, recvbuf=None, op=None):
     _check_comm(comm)
-    sb = np.asarray(_check_buffer(comm, sendbuf, "sendbuf"))
-    if sb.size % comm.size != 0:
+    n = np.size(_check_buffer(comm, sendbuf, "sendbuf"))
+    if n % comm.size != 0:
         return _fail(comm, ERR_COUNT,
-                     f"sendbuf size {sb.size} not divisible by comm size "
+                     f"sendbuf size {n} not divisible by comm size "
                      f"{comm.size}")
     op = _check_op(comm, op)
     return comm.coll.reduce_scatter_block(comm, sendbuf, recvbuf, op=op)

@@ -84,13 +84,20 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.shmbox_attach.argtypes = [ctypes.c_char_p, ctypes.c_uint32,
                                   ctypes.c_int]
     lib.shmbox_attach.restype = ctypes.c_int
-    lib.shmbox_write.argtypes = [ctypes.c_int, u8p, ctypes.c_uint32, u8p,
+    # c_char_p for the write source pointers: Python bytes pass zero-copy
+    # (the C side only reads) — from_buffer_copy staging was measurable on
+    # the per-message fast path
+    lib.shmbox_write.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                 ctypes.c_uint32, ctypes.c_char_p,
                                  ctypes.c_uint32]
     lib.shmbox_write.restype = ctypes.c_int
     lib.shmbox_peek.argtypes = [ctypes.c_int]
     lib.shmbox_peek.restype = ctypes.c_uint32
     lib.shmbox_read.argtypes = [ctypes.c_int, u8p, ctypes.c_uint32]
     lib.shmbox_read.restype = ctypes.c_int
+    lib.shmbox_read_frame.argtypes = [ctypes.c_int, u8p, ctypes.c_uint32,
+                                      ctypes.POINTER(ctypes.c_uint32)]
+    lib.shmbox_read_frame.restype = ctypes.c_int
     lib.shmbox_close.argtypes = [ctypes.c_int]
     lib.shmbox_close.restype = None
     lib.doorbell_open.argtypes = [ctypes.c_char_p, ctypes.c_int]

@@ -210,6 +210,30 @@ int shmbox_read(int h, uint8_t* buf, uint32_t buflen) {
   return (int)lens[1];
 }
 
+// One-call receive for the Python fast path: pop the next frame into `buf`
+// and report the total body length through `body_out` (header + payload),
+// saving the peek round-trip and the per-frame buffer allocation the
+// two-call protocol forces on the binding side. Returns the header length,
+// -1 when empty, -2 when the frame exceeds `buflen` (callers size `buf` to
+// the ring's max frame, so -2 only flags a protocol bug).
+int shmbox_read_frame(int h, uint8_t* buf, uint32_t buflen,
+                      uint32_t* body_out) {
+  Chan* cp = chan_of(h);
+  if (!cp) return -1;
+  Chan& c = *cp;
+  uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
+  uint64_t head = c.ctl->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint32_t lens[2];
+  ring_read(c, tail, reinterpret_cast<uint8_t*>(lens), 8);
+  uint32_t body = lens[0] - 8;
+  if (body > buflen) return -2;
+  ring_read(c, tail + 8, buf, body);
+  c.ctl->tail.store(tail + round8(lens[0]), std::memory_order_release);
+  *body_out = body;
+  return (int)lens[1];
+}
+
 // ---- doorbells -----------------------------------------------------------
 //
 // Named-semaphore wakeup for idle receivers. Spinning in the progress loop

@@ -22,6 +22,8 @@ import os
 import socket
 import struct
 from dataclasses import dataclass
+
+from ..core.hwtopo import _read  # shared /sys reader
 from typing import List, Optional
 
 SIOCGIFADDR = 0x8915
@@ -46,14 +48,6 @@ class Iface:
     up: bool
     loopback: bool
     speed_mbps: int       # -1 = unknown
-
-
-def _read(path: str) -> Optional[str]:
-    try:
-        with open(path) as fh:
-            return fh.read().strip()
-    except OSError:
-        return None
 
 
 def interfaces() -> List[Iface]:

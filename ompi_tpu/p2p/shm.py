@@ -69,6 +69,15 @@ class ShmTransport(T.Transport):
         self._tx_bells: Dict[int, int] = {}
         # cap fragments so one frame can never exceed half a ring
         self.max_send_size = min(self.max_send_size, self._ring // 4)
+        # reusable rx frame buffer sized to the ring: payloads are capped at
+        # max_send_size but pickled control headers (osc/ft dict headers)
+        # are unbounded, and any frame the writer accepted fits the ring —
+        # so ring-sized is the provably-sufficient choice
+        self._rxbuf = (ctypes.c_uint8 * self._ring)()
+        # cast: a raw ctypes-array view carries format '<B', which
+        # memoryview refuses to index/slice-read; 'B' is the plain bytes view
+        self._rxview = memoryview(self._rxbuf).cast("B")
+        self._rxbody = ctypes.c_uint32(0)
 
     def open(self) -> bool:
         return native.available()
@@ -142,17 +151,15 @@ class ShmTransport(T.Transport):
 
     def _try_write(self, peer: int, hdr: bytes, payload) -> bool:
         h = self._tx_handle(peer)
-        hp = (ctypes.c_uint8 * len(hdr)).from_buffer_copy(hdr)
-        n = len(payload)
-        if n:
-            pp = (ctypes.c_uint8 * n).from_buffer_copy(payload)
-        else:
-            pp = (ctypes.c_uint8 * 1)()
-        rc = self._lib.shmbox_write(h, hp, len(hdr), pp, n)
+        # bytes pass straight through the c_char_p prototypes (zero copy);
+        # other buffer shapes (memoryview/ndarray slices) convert once
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)
+        rc = self._lib.shmbox_write(h, hdr, len(hdr), payload, len(payload))
         if rc == -2:
             raise ValueError(
-                f"frame of {len(hdr)}+{n} bytes exceeds shm ring capacity "
-                f"{self._ring} (raise transport_shm_ring_size)")
+                f"frame of {len(hdr)}+{len(payload)} bytes exceeds shm ring "
+                f"capacity {self._ring} (raise transport_shm_ring_size)")
         if rc == 1:      # ring was empty → peer may be blocked on its bell
             bell = self._tx_bells.get(peer)
             if bell is None:
@@ -183,18 +190,30 @@ class ShmTransport(T.Transport):
                     break
                 q.popleft()
                 n += 1
+        rxbuf, rxview, body = self._rxbuf, self._rxview, self._rxbody
+        read_frame = self._lib.shmbox_read_frame
+        cap = len(rxbuf)
         for peer, h in self._rx.items():
             while True:
-                sz = self._lib.shmbox_peek(h)
-                if sz == 0:
-                    break
-                buf = (ctypes.c_uint8 * sz)()
-                hlen = self._lib.shmbox_read(h, buf, sz)
+                # single-call pop into the reusable buffer (no peek
+                # round-trip, no per-frame allocation)
+                hlen = read_frame(h, rxbuf, cap, body)
+                if hlen == -2:
+                    # frame larger than rxbuf: tail did NOT advance, so
+                    # breaking would re-hit it forever — a protocol bug
+                    # (writers cap frames at max_send_size, headers at the
+                    # rxbuf slack) must be loud, not a silent wedge
+                    raise RuntimeError(
+                        f"shm rx frame from rank {peer} exceeds the "
+                        f"{cap}-byte frame buffer (protocol bug: writer "
+                        f"must respect max_send_size)")
                 if hlen < 0:
                     break
-                raw = bytes(buf)
-                tag, header = wire.decode(memoryview(raw)[:hlen])
-                self.deliver(peer, tag, header, raw[hlen:])
+                total = body.value
+                tag, header = wire.decode(rxview[:hlen])
+                # the payload must outlive the reusable buffer (matching
+                # may park it on the unexpected queue) → one owned copy
+                self.deliver(peer, tag, header, rxview[hlen:total].tobytes())
                 n += 1
         return n
 

@@ -24,10 +24,9 @@ class TestShmbox:
         assert w >= 0 and r >= 0
         hdr = pickle.dumps((7, {"x": 1}))
         payload = b"abcdefgh" * 100
-        hp = (ctypes.c_uint8 * len(hdr)).from_buffer_copy(hdr)
-        pp = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        # bytes pass zero-copy through the c_char_p write prototype
         # 1 = wrote into an empty ring (doorbell-post hint)
-        assert lib.shmbox_write(w, hp, len(hdr), pp, len(payload)) == 1
+        assert lib.shmbox_write(w, hdr, len(hdr), payload, len(payload)) == 1
         sz = lib.shmbox_peek(r)
         assert sz == len(hdr) + len(payload)
         buf = (ctypes.c_uint8 * sz)()
@@ -46,12 +45,10 @@ class TestShmbox:
         w = lib.shmbox_attach(name, 1 << 12, 1)   # small ring forces wrap
         r = lib.shmbox_attach(name, 0, 0)
         hdr = b"h" * 16
-        hp = (ctypes.c_uint8 * 16).from_buffer_copy(hdr)
         total = 0
         for round_ in range(50):
             payload = bytes([round_ % 251]) * 700
-            pp = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
-            rc = lib.shmbox_write(w, hp, 16, pp, len(payload))
+            rc = lib.shmbox_write(w, hdr, 16, payload, len(payload))
             if rc == -1:   # full: drain one and retry
                 sz = lib.shmbox_peek(r)
                 buf = (ctypes.c_uint8 * sz)()
@@ -59,7 +56,7 @@ class TestShmbox:
                 assert hlen == 16
                 assert bytes(buf)[16] == total % 251
                 total += 1
-                rc = lib.shmbox_write(w, hp, 16, pp, len(payload))
+                rc = lib.shmbox_write(w, hdr, 16, payload, len(payload))
             assert rc >= 0
         # drain the rest, checking FIFO order survived the wraparounds
         while True:
@@ -78,7 +75,7 @@ class TestShmbox:
         lib = native.load()
         name = f"/otpu_test_{os.getpid()}_big".encode()
         w = lib.shmbox_attach(name, 1 << 10, 1)
-        big = (ctypes.c_uint8 * 2048)()
+        big = bytes(2048)
         assert lib.shmbox_write(w, big, 16, big, 2048) == -2
         lib.shmbox_close(w)
 
