@@ -1694,11 +1694,13 @@ class TunedModule(CollModule):
         if comm.size == 1:
             return buf
         nbytes = buf.nbytes
-        # sweep-driven (TUNE_SWEEP.json, 4 ranks): chain wins the latency
-        # regime (405µs vs binomial 715µs @64B), pipeline the bandwidth
-        # regime (12.0ms vs binomial 14.0ms @2M); scatter_allgather and
-        # binomial never won but remain selectable
-        default = "chain" if nbytes <= (1 << 13) else "pipeline"
+        # sweep-driven (TUNE_SWEEP.json, 4 ranks): knomial wins the latency
+        # regime on the full-library sweep (shallower tree, no segment
+        # bookkeeping); pipeline keeps the bandwidth regime — its wire/
+        # compute overlap cannot show on the 1-core sweep box (where
+        # knomial also "wins" large) but is the structural choice once
+        # ranks own cores
+        default = "knomial" if nbytes <= (1 << 13) else "pipeline"
         alg = self._pick("bcast", comm, nbytes, default)
         if alg == "scatter_allgather" and buf.size >= comm.size:
             bcast_scatter_allgather(comm, buf, root)
@@ -1729,10 +1731,13 @@ class TunedModule(CollModule):
             # in-order binary tree keeps the canonical fold order at
             # log(p) depth (vs the linear gather fallback)
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
-        # sweep (TUNE_SWEEP.json, 4 ranks, ONE core): binomial wins at all
-        # sizes — the pipeline's wire/fold overlap needs ranks on their own
-        # cores to pay off, so it stays selectable, not default
-        alg = self._pick("reduce", comm, send.nbytes, "binomial")
+        # sweep (TUNE_SWEEP.json, 4 ranks, ONE core): knomial wins small
+        # (shallow tree), binomial the middle; the pipeline/chain overlap
+        # needs ranks on their own cores to pay off, so they stay
+        # selectable, not default
+        alg = self._pick("reduce", comm, send.nbytes,
+                         "knomial" if send.nbytes <= (1 << 11)
+                         else "binomial")
         if alg == "inorder_binary":
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
         if alg == "pipeline":
@@ -1832,9 +1837,15 @@ class TunedModule(CollModule):
         nbytes = sendbuf.nbytes
         pof2 = (comm.size & (comm.size - 1)) == 0
         even = comm.size % 2 == 0
-        default = ("recursive_doubling" if pof2 and nbytes <= (1 << 16)
+        # sweep: direct messaging wins the mid band on small comms (one
+        # round, p-1 concurrent pairs); ring/neighbor-exchange take over
+        # when p grows (port pressure) or payloads exceed the mid band
+        default = ("recursive_doubling" if pof2 and nbytes <= (1 << 10)
                    else ("bruck" if nbytes <= 4096
-                         else ("neighbor_exchange" if even else "ring")))
+                         else ("direct" if comm.size <= 8
+                               and nbytes <= (1 << 18)
+                               else ("neighbor_exchange" if even
+                                     else "ring"))))
         alg = self._pick("allgather", comm, nbytes, default)
         if alg == "recursive_doubling" and pof2:
             allgather_recursive_doubling(comm, sendbuf, recvbuf)
@@ -1865,9 +1876,16 @@ class TunedModule(CollModule):
         if comm.size == 1:
             recvbuf[...] = sendbuf
             return recvbuf
-        nbytes = sendbuf.nbytes // comm.size
-        alg = self._pick("alltoall", comm, nbytes,
-                         "bruck" if nbytes <= 1024 else "pairwise")
+        nbytes = sendbuf.nbytes // comm.size   # per-destination bytes
+        # sweep (TUNE_SWEEP.json, 4 ranks, winners keyed by TOTAL buffer;
+        # per-dest = total/4): bruck wins only the tiny regime (≤16 B/dest),
+        # plain linear the middle (256 B–4 KB/dest), linear_sync the
+        # bandwidth regime (≥64 KB/dest — windowed flow control beats the
+        # lockstep pairwise rounds); pairwise stays selectable for large
+        # rank counts where 2(p-1) outstanding requests oversubscribe
+        default = ("bruck" if nbytes <= 64 else
+                   ("linear" if nbytes <= (1 << 13) else "linear_sync"))
+        alg = self._pick("alltoall", comm, nbytes, default)
         if alg == "bruck":
             alltoall_bruck(comm, sendbuf, recvbuf)
         elif alg == "linear_sync":
@@ -1914,8 +1932,12 @@ class TunedModule(CollModule):
             recvbuf = np.empty(counts[comm.rank], sendbuf.dtype)
         pof2 = (comm.size & (comm.size - 1)) == 0
         nbytes = sendbuf.nbytes
-        default = ("ring" if nbytes > (1 << 18) else
-                   ("recursive_halving" if pof2 else "butterfly"))
+        # sweep (TUNE_SWEEP.json, 4 ranks): recursive-halving wins small,
+        # butterfly wins ≥16K at every size incl. 2M (fewer rounds than the
+        # ring's p-1 for the same O(n) bytes); ring stays selectable for
+        # topologies where only neighbor links are cheap
+        default = ("recursive_halving" if (pof2 and nbytes <= (1 << 13))
+                   else "butterfly")
         alg = self._pick("reduce_scatter", comm, nbytes, default)
         if alg == "nonoverlapping":
             return self.basic.reduce_scatter(comm, sendbuf, recvbuf, counts,
@@ -1972,8 +1994,11 @@ class TunedModule(CollModule):
         send = _inplace(sendbuf, recvbuf)
         if recvbuf is None:
             recvbuf = np.empty_like(send)
+        # sweep: rd wins only the latency regime; the linear chain moves
+        # n bytes per rank once vs rd's n·log p (wins ≥1K on the sweep)
         if self._pick("scan", comm, send.nbytes,
-                      "recursive_doubling") == "linear":
+                      "recursive_doubling" if send.nbytes < 1024
+                      else "linear") == "linear":
             return self.basic.scan(comm, send, recvbuf, op)
         scan_recursive_doubling(comm, send, recvbuf, op, exclusive=False)
         return recvbuf

@@ -25,16 +25,24 @@ import numpy as np
 
 ALGS = {
     "allreduce": ["recursive_doubling", "ring", "segmented_ring",
-                  "rabenseifner"],
+                  "rabenseifner", "nonoverlapping", "allgather_reduce"],
     "bcast": ["binomial", "knomial", "pipeline", "chain",
-              "scatter_allgather"],
-    "allgather": ["recursive_doubling", "ring", "neighbor_exchange", "bruck"],
-    "reduce_scatter_block": ["recursive_halving", "butterfly"],
-    "reduce": ["binomial", "pipeline"],
-    "allgatherv": ["ring", "linear"],
-    "gather": ["binomial", "linear"],
-    "scatter": ["binomial", "linear"],
-    "barrier": ["recursive_doubling", "double_ring"],
+              "scatter_allgather", "split_binary"],
+    "allgather": ["recursive_doubling", "ring", "neighbor_exchange", "bruck",
+                  "sparbit", "k_bruck", "direct"],
+    "alltoall": ["pairwise", "bruck", "linear_sync", "linear"],
+    "reduce_scatter": ["ring", "recursive_halving", "butterfly",
+                       "nonoverlapping"],
+    "reduce_scatter_block": ["recursive_halving", "butterfly",
+                             "recursive_doubling"],
+    "reduce": ["binomial", "pipeline", "chain", "knomial", "rabenseifner",
+               "inorder_binary"],
+    "allgatherv": ["ring", "linear", "bruck", "sparbit",
+                   "neighbor_exchange"],
+    "gather": ["binomial", "linear", "linear_sync"],
+    "scatter": ["binomial", "linear", "linear_nb"],
+    "scan": ["recursive_doubling", "linear"],
+    "barrier": ["recursive_doubling", "double_ring", "tree"],
 }
 
 SIZES = [64, 1024, 16 << 10, 256 << 10, 2 << 20]
@@ -83,6 +91,21 @@ def _run_case(coll: str, alg: str, nbytes: int, ranks: int, iters: int
             call = lambda a: c.coll.allgatherv(   # noqa: E731
                 c, mine, counts=counts)
             args = lambda: None                            # noqa: E731
+        elif coll == "alltoall":
+            big = np.arange(count - count % ranks, dtype=np.float64)
+            call = lambda a: c.coll.alltoall(c, big)       # noqa: E731
+            args = lambda: None                            # noqa: E731
+        elif coll == "reduce_scatter":
+            counts = [max(1, count // ranks + (1 if r < count % ranks else 0))
+                      for r in range(ranks)]
+            big2 = np.arange(sum(counts), dtype=np.float64)
+            out3 = np.zeros(counts[c.rank])
+            call = lambda a: c.coll.reduce_scatter(   # noqa: E731
+                c, big2, out3, counts)
+            args = lambda: None                            # noqa: E731
+        elif coll == "scan":
+            call = lambda a: c.coll.scan(c, send)          # noqa: E731
+            args = lambda: None                            # noqa: E731
         elif coll == "barrier":
             call = lambda a: c.coll.barrier(c)             # noqa: E731
             args = lambda: None                            # noqa: E731
@@ -119,8 +142,15 @@ def main(argv=None) -> int:
         for nbytes in sizes:
             best = (None, float("inf"))
             for alg in algs:
-                if alg == "recursive_doubling" and coll == "allgather" \
-                        and args.ranks & (args.ranks - 1):
+                pof2 = (args.ranks & (args.ranks - 1)) == 0
+                if alg == "recursive_doubling" and not pof2 and \
+                        coll in ("allgather", "reduce_scatter_block"):
+                    continue
+                if alg == "recursive_halving" and not pof2 and \
+                        coll in ("reduce_scatter", "reduce_scatter_block"):
+                    # non-pof2 dispatch substitutes butterfly — measuring
+                    # it under this label would record a winner that can
+                    # never actually run
                     continue
                 if alg == "neighbor_exchange" and args.ranks % 2:
                     continue
