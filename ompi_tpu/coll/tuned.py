@@ -1732,12 +1732,15 @@ class TunedModule(CollModule):
             # log(p) depth (vs the linear gather fallback)
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
         # sweep (TUNE_SWEEP.json, 4 ranks, ONE core): knomial wins small
-        # (shallow tree), binomial the middle; the pipeline/chain overlap
-        # needs ranks on their own cores to pay off, so they stay
-        # selectable, not default
-        alg = self._pick("reduce", comm, send.nbytes,
-                         "knomial" if send.nbytes <= (1 << 11)
-                         else "binomial")
+        # (shallow tree), binomial the middle, in-order binary the large
+        # regime (balanced log-depth tree with one fold per node — valid
+        # for commutative ops too, and the recorded winner ≥256K); the
+        # pipeline/chain overlap needs ranks on their own cores to pay
+        # off, so they stay selectable, not default
+        default = ("knomial" if send.nbytes <= (1 << 11) else
+                   ("binomial" if send.nbytes <= (1 << 17)
+                    else "inorder_binary"))
+        alg = self._pick("reduce", comm, send.nbytes, default)
         if alg == "inorder_binary":
             return reduce_inorder_binary(comm, send, recvbuf, op, root)
         if alg == "pipeline":
@@ -1837,15 +1840,22 @@ class TunedModule(CollModule):
         nbytes = sendbuf.nbytes
         pof2 = (comm.size & (comm.size - 1)) == 0
         even = comm.size % 2 == 0
-        # sweep: direct messaging wins the mid band on small comms (one
-        # round, p-1 concurrent pairs); ring/neighbor-exchange take over
-        # when p grows (port pressure) or payloads exceed the mid band
-        default = ("recursive_doubling" if pof2 and nbytes <= (1 << 10)
-                   else ("bruck" if nbytes <= 4096
+        # sweep (TUNE_SWEEP.json winners: 64B bruck, 1K rd, 16K-256K
+        # direct, 2M k_bruck): bruck tiny, recursive-doubling small-pof2,
+        # direct messaging the mid band on small comms (one round, p-1
+        # concurrent pairs), k-Bruck large on small comms (at p=4,radix=4
+        # it is single-round direct with block coalescing). DEVIATION for
+        # large comms: ring/neighbor-exchange despite never winning the
+        # 4-rank sweep — p-1 concurrent pairs oversubscribe ports as p
+        # grows, and the neighbor schedules are the topology-friendly
+        # structural choice there (coll_base_allgather.c rationale)
+        default = ("bruck" if nbytes <= 256
+                   else ("recursive_doubling" if pof2 and nbytes <= (1 << 11)
                          else ("direct" if comm.size <= 8
                                and nbytes <= (1 << 18)
-                               else ("neighbor_exchange" if even
-                                     else "ring"))))
+                               else ("k_bruck" if comm.size <= 8
+                                     else ("neighbor_exchange" if even
+                                           else "ring")))))
         alg = self._pick("allgather", comm, nbytes, default)
         if alg == "recursive_doubling" and pof2:
             allgather_recursive_doubling(comm, sendbuf, recvbuf)
@@ -1878,11 +1888,13 @@ class TunedModule(CollModule):
             return recvbuf
         nbytes = sendbuf.nbytes // comm.size   # per-destination bytes
         # sweep (TUNE_SWEEP.json, 4 ranks, winners keyed by TOTAL buffer;
-        # per-dest = total/4): bruck wins only the tiny regime (≤16 B/dest),
-        # plain linear the middle (256 B–4 KB/dest), linear_sync the
-        # bandwidth regime (≥64 KB/dest — windowed flow control beats the
-        # lockstep pairwise rounds); pairwise stays selectable for large
-        # rank counts where 2(p-1) outstanding requests oversubscribe
+        # per-dest = total/4): bruck wins the measured tiny point
+        # (16 B/dest), plain linear the middle (256 B–4 KB/dest),
+        # linear_sync the bandwidth regime (≥64 KB/dest — windowed flow
+        # control beats the lockstep pairwise rounds). The bruck/linear
+        # cutoff at 64 B/dest sits mid-gap between the two measured
+        # points; pairwise stays selectable for large rank counts where
+        # 2(p-1) outstanding requests oversubscribe
         default = ("bruck" if nbytes <= 64 else
                    ("linear" if nbytes <= (1 << 13) else "linear_sync"))
         alg = self._pick("alltoall", comm, nbytes, default)

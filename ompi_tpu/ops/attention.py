@@ -39,10 +39,21 @@ def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _auto_block(s: int) -> int:
+    """Largest power-of-two block ≤1024 dividing the sequence: the v5e
+    block sweep (BASELINE.md) shows 1024² blocks run 2.4× faster than 256²
+    (fewer grid steps amortize the VMEM scratch round-trips; ~2 MB VMEM at
+    d=64 stays well under budget)."""
+    for b in (1024, 512, 256, 128):
+        if s % b == 0:
+            return b
+    return s
+
+
 def _block_sizes(s_q: int, s_k: int, block_q: Optional[int],
                  block_k: Optional[int]) -> Tuple[int, int]:
-    bq = min(block_q or 256, s_q)
-    bk = min(block_k or 256, s_k)
+    bq = min(block_q or _auto_block(s_q), s_q)
+    bk = min(block_k or _auto_block(s_k), s_k)
     if s_q % bq or s_k % bk:
         raise ValueError(f"seq lengths ({s_q},{s_k}) must divide into "
                          f"blocks ({bq},{bk})")
@@ -60,30 +71,42 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
         acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    qi = pl.program_id(1)
+    # causal: a kv block fully above the diagonal contributes nothing —
+    # skip its MXU work entirely (the ~2× flop saving causal promises;
+    # the block DMA still happens, which is why the saving shows as ~1.7×)
+    visible = True
     if causal:
-        qi = pl.program_id(1)
-        rows = (q_off + qi * block_q
-                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
-        cols = (kv_off + ki * block_k
-                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-        s = jnp.where(rows >= cols, s, NEG_INF)
+        last_row = q_off + (qi + 1) * block_q - 1
+        first_col = kv_off + ki * block_k
+        visible = last_row >= first_col
 
-    m_prev = m_ref[:, 0]
-    l_prev = l_ref[:, 0]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-    alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.exp(s - m_cur[:, None])
-    l_cur = l_prev * alpha + jnp.sum(p, axis=1)
-    acc_ref[...] = (acc_ref[...] * alpha[:, None]
-                    + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32))
-    m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = (q_off + qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            cols = (kv_off + ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+        acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                        + jax.lax.dot_general(
+                            p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
 
     @pl.when(ki == kv_steps - 1)
     def _finish():
@@ -155,30 +178,42 @@ def _partials_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_out, l_out,
         l_ref[...] = jnp.zeros(l_ref.shape, l_ref.dtype)
         acc_ref[...] = jnp.zeros(acc_ref.shape, acc_ref.dtype)
 
-    q = q_ref[0].astype(jnp.float32)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
+    qi = pl.program_id(1)
+    # same fully-masked-block skip as _flash_kernel, with RUNTIME offsets:
+    # on a ring hop whose kv shard sits entirely in this q block's future,
+    # every block is skipped and the hop costs only its DMA
+    visible = True
     if causal:
-        qi = pl.program_id(1)
-        rows = (off_ref[0] + qi * block_q
-                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
-        cols = (off_ref[1] + ki * block_k
-                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
-        s = jnp.where(rows >= cols, s, NEG_INF)
+        last_row = off_ref[0] + (qi + 1) * block_q - 1
+        first_col = off_ref[1] + ki * block_k
+        visible = last_row >= first_col
 
-    m_prev = m_ref[:, 0]
-    l_prev = l_ref[:, 0]
-    m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
-    alpha = jnp.exp(m_prev - m_cur)
-    p = jnp.exp(s - m_cur[:, None])
-    l_cur = l_prev * alpha + jnp.sum(p, axis=1)
-    acc_ref[...] = (acc_ref[...] * alpha[:, None]
-                    + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32))
-    m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
-    l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = (off_ref[0] + qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            cols = (off_ref[1] + ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur[:, None])
+        l_cur = l_prev * alpha + jnp.sum(p, axis=1)
+        acc_ref[...] = (acc_ref[...] * alpha[:, None]
+                        + jax.lax.dot_general(
+                            p, v, (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32))
+        m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
 
     @pl.when(ki == kv_steps - 1)
     def _finish():
