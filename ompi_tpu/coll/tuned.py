@@ -1854,8 +1854,12 @@ class TunedModule(CollModule):
                          else ("direct" if comm.size <= 8
                                and nbytes <= (1 << 18)
                                else ("k_bruck" if comm.size <= 8
-                                     else ("neighbor_exchange" if even
-                                           else "ring")))))
+                                     else ("bruck" if nbytes <= 4096
+                                           # log p rounds, one msg/round —
+                                           # no port pressure; keeps the
+                                           # latency band off p-1 rings
+                                           else ("neighbor_exchange" if even
+                                                 else "ring"))))))
         alg = self._pick("allgather", comm, nbytes, default)
         if alg == "recursive_doubling" and pof2:
             allgather_recursive_doubling(comm, sendbuf, recvbuf)

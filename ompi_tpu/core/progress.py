@@ -31,6 +31,14 @@ class ProgressEngine:
         self.idle_wait: Callable[[float], None] | None = None
         # blocking idle hook (e.g. the shm transport's doorbell): when a
         # wait loop goes idle, block here instead of sleeping blind
+        #
+        # guard: None under the default FUNNELED contract (exactly one
+        # thread drives the engine, unlocked). With async progress
+        # (runtime_async_progress, ≙ the reference's opt-in progress
+        # thread) this is an RLock serializing the progress thread against
+        # the owner thread's library entry points — progress() takes it,
+        # and the pml/TransportLayer entry points take it too.
+        self.guard: threading.RLock | None = None
 
     def register(self, fn: Callable[[], int], low_priority: bool = False) -> None:
         with self._lock:
@@ -49,10 +57,18 @@ class ProgressEngine:
             high = list(self._high)
             self.polls += 1
             low = list(self._low) if self.polls % _LOW_PRIORITY_INTERVAL == 0 else []
-        for fn in high:
-            events += fn() or 0
-        for fn in low:
-            events += fn() or 0
+        g = self.guard
+        if g is None:
+            for fn in high:
+                events += fn() or 0
+            for fn in low:
+                events += fn() or 0
+            return events
+        with g:
+            for fn in high:
+                events += fn() or 0
+            for fn in low:
+                events += fn() or 0
         return events
 
     def wait_until(self, cond: Callable[[], bool], timeout: float | None = None) -> bool:

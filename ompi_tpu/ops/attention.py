@@ -44,10 +44,13 @@ def _auto_block(s: int) -> int:
     block sweep (BASELINE.md) shows 1024² blocks run 2.4× faster than 256²
     (fewer grid steps amortize the VMEM scratch round-trips; ~2 MB VMEM at
     d=64 stays well under budget)."""
-    for b in (1024, 512, 256, 128):
+    for b in (1024, 512, 256, 128, 64, 32):
         if s % b == 0:
             return b
-    return s
+    # no usable divisor: fall back to the old default so _block_sizes
+    # raises its informative must-divide error (never a full-seq block —
+    # a seq² fp32 score tile would blow VMEM silently)
+    return 256
 
 
 def _block_sizes(s_q: int, s_k: int, block_q: Optional[int],

@@ -48,6 +48,23 @@ class TruncateError(RuntimeError):
     pass
 
 
+def _guarded(fn):
+    """Serialize a pml entry point against the async progress thread when
+    runtime_async_progress is on (engine.guard set); free when off — the
+    default FUNNELED contract stays unlocked."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(self, *a, **kw):
+        g = self._g
+        if g is None:
+            return fn(self, *a, **kw)
+        with g:
+            return fn(self, *a, **kw)
+
+    return wrapped
+
+
 _var.register("smsc", "", "enabled", True, type=bool, level=4,
               help="Allow CMA single-copy rendezvous over shared memory "
                    "(≙ the smsc/cma component; disable to force the "
@@ -157,6 +174,7 @@ class P2P:
         self.spc = spc if spc is not None else Counters()
         self.matching = MatchingEngine()
         self.matching.spc = self.spc
+        self._g = engine.guard          # async-progress serialization
         self._send_seq: Dict[Tuple[int, int], int] = defaultdict(int)
         self._sreq = itertools.count(1)
         self._rreq = itertools.count(1)
@@ -168,6 +186,7 @@ class P2P:
 
     # -- send ---------------------------------------------------------------
 
+    @_guarded
     def isend(self, buf, dst: int, tag: int = 0, cid: int = 0,
               datatype: Optional[Datatype] = None, count: Optional[int] = None,
               sync: bool = False) -> Request:
@@ -239,6 +258,7 @@ class P2P:
 
     # -- recv ---------------------------------------------------------------
 
+    @_guarded
     def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
               cid: int = 0, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
@@ -342,6 +362,7 @@ class P2P:
 
     # -- matched probe (≙ MPI_Mprobe/Mrecv, ompi/message/) ------------------
 
+    @_guarded
     def improbe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG,
                 cid: int = 0) -> Optional["Message"]:
         """Match-and-dequeue: the returned Message can no longer match any
@@ -367,6 +388,7 @@ class P2P:
             raise TimeoutError("mprobe: no matching message")
         return box[0]
 
+    @_guarded
     def imrecv(self, msg: "Message", buf,
                datatype: Optional[Datatype] = None,
                count: Optional[int] = None) -> Request:
@@ -381,6 +403,7 @@ class P2P:
               count: Optional[int] = None):
         return self.imrecv(msg, buf, datatype, count).wait()
 
+    @_guarded
     def cancel_recv(self, req: Request) -> bool:
         """Withdraw a still-posted receive (MPI_Cancel for recvs; used by
         blocking ANY_SOURCE recv to avoid leaking a zombie post when it
@@ -416,6 +439,7 @@ class P2P:
 
     # -- probe --------------------------------------------------------------
 
+    @_guarded
     def iprobe(self, src: int = ANY_SOURCE, tag: int = ANY_TAG, cid: int = 0):
         self.spc.inc("probes")
         self.engine.progress()

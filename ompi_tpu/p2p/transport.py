@@ -97,6 +97,7 @@ class TransportLayer:
         self.transports = sorted(transports, key=lambda t: -t.priority)
         self._by_peer: Dict[int, Transport] = {}
         self._lock = threading.Lock()
+        self.guard = None     # async-progress RLock (Context wires it)
 
     def for_peer(self, peer: int) -> Transport:
         with self._lock:
@@ -112,13 +113,27 @@ class TransportLayer:
             return t
 
     def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes = b"") -> None:
-        self.for_peer(peer).send(peer, tag, header, payload)
+        g = self.guard
+        if g is None:
+            self.for_peer(peer).send(peer, tag, header, payload)
+        else:     # async progress on: serialize against the progress thread
+            with g:
+                self.for_peer(peer).send(peer, tag, header, payload)
 
     def add_peers(self, new_size: int) -> None:
-        """Propagate a dynamic-spawn growth of the global rank space."""
-        for t in self.transports:
-            if hasattr(t, "add_peers"):
-                t.add_peers(new_size)
+        """Propagate a dynamic-spawn growth of the global rank space
+        (serialized against the async progress thread like every other
+        owner-thread transport mutation)."""
+        g = self.guard
+        if g is None:
+            for t in self.transports:
+                if hasattr(t, "add_peers"):
+                    t.add_peers(new_size)
+            return
+        with g:
+            for t in self.transports:
+                if hasattr(t, "add_peers"):
+                    t.add_peers(new_size)
 
     def transport_matrix(self) -> Dict[int, str]:
         """Which transport serves each wired peer (≙ hook/comm_method's

@@ -33,9 +33,18 @@ from .control import Bootstrap, from_environment
 from .core.component import frameworks
 from .core.output import output
 from .core.progress import ProgressEngine, set_engine
+from .core import var as _rtvar
 from .p2p import selftrans, shm, tcp  # noqa: F401  (register transports)
 from .p2p.pml import P2P
 from .p2p.transport import TransportLayer
+
+_rtvar.register(
+    "runtime", "", "async_progress", False, type=bool, level=3,
+    help="Run a per-rank progress thread (≙ the reference's opt-in "
+         "progress threads): passive-target RMA and rendezvous service "
+         "keep moving while the application thread computes. Library "
+         "entry points then serialize on the engine guard (small "
+         "per-call cost); default off = FUNNELED, unlocked.")
 
 
 class Context:
@@ -64,6 +73,17 @@ class Context:
         from .core import hwtopo
         self.bound_cpus = hwtopo.apply_env_binding()
         self.engine = ProgressEngine()
+        from .core import var as _var0
+        self._async_progress = bool(_var0.get("runtime_async_progress",
+                                              False))
+        if self._async_progress:
+            # opt-in progress thread (≙ the reference's opal progress/btl
+            # progress threads): passive-target RMA and rendezvous service
+            # keep moving while the owner thread sits in long user compute.
+            # The engine guard serializes the thread against the owner's
+            # pml/TransportLayer entry points (FUNNELED otherwise).
+            self.engine.guard = threading.RLock()
+        self._prog_thread = None
         self.am_table: dict = {}
         mods = []
         for pri, comp, mod in frameworks.framework("transport").select_all(self):
@@ -74,6 +94,7 @@ class Context:
             raise RuntimeError("no transport components available")
         self.bootstrap.fence()
         self.layer = TransportLayer(mods)
+        self.layer.guard = self.engine.guard
         self._install_idle_hook(mods)
         from .spc import Counters
         self.spc = Counters()
@@ -90,6 +111,19 @@ class Context:
             memchecker.install(self)    # --mca memchecker_enabled 1
         from . import hook
         hook.fire("init_bottom", self)   # ≙ mca/hook mpi_init hooks
+        if self._async_progress:
+            import time as _time
+
+            def _pump() -> None:
+                while not self.finalized:
+                    n = self.engine.progress()
+                    # back off when idle: on oversubscribed hosts a hot
+                    # spinner starves the app thread it exists to serve
+                    _time.sleep(0 if n else 0.001)
+
+            self._prog_thread = threading.Thread(
+                target=_pump, name=f"ompi-tpu-prog-{self.rank}", daemon=True)
+            self._prog_thread.start()
 
     def _install_idle_hook(self, mods) -> None:
         """Wire the engine's blocking idle hook: block on the shm doorbell
@@ -121,6 +155,11 @@ class Context:
         if self.finalized:
             return
         self.finalized = True
+        if self._prog_thread is not None:
+            # pump loop exits on the finalized flag; rejoin so the rest of
+            # finalize (drain, fence) runs back under the FUNNELED contract
+            self._prog_thread.join(timeout=5)
+            self._prog_thread = None
         from .core import var as _var
         self.spc._v["progress_polls"] = self.engine.polls
         self.spc._v["time_in_wait"] = self.engine.time_waiting

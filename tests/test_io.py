@@ -321,7 +321,10 @@ def test_delete_on_close_and_size():
 
 def _select(framework, value):
     from ompi_tpu.core import var
-    var.registry.set_cli(f"{framework}_select", value)
+    if value:
+        var.registry.set_cli(f"{framework}_select", value)
+    else:
+        var.registry.clear_cli(f"{framework}_select")
     var.registry.reset_cache()
 
 
