@@ -19,6 +19,15 @@ one virtual CPU device (JAX_PLATFORMS=cpu + 1 host device) — the test
 fabric. Ranks then call ``parallel.device_plane.init_device_plane(ctx)`` to
 wire ``jax.distributed`` across the job (the coordination-service address
 travels through the modex).
+
+Multi-host (the DVM-less pattern): run one tpurun per host —
+``tpurun -np 8 --num-hosts 2 --host-index 0 app.py`` on the head (hosts
+the coordinator, prints its address) and ``... --host-index 1
+--coordinator HEAD:PORT app.py`` on each worker. Ranks split into
+contiguous per-host spans; the head's coordinator stays up until every
+rank (local and remote) reports finished. Inter-host rank traffic takes
+the tcp transport automatically (shm's host-key reachability declines
+cross-host peers).
 """
 
 from __future__ import annotations
@@ -35,23 +44,26 @@ from .tcp import Coordinator
 
 def build_env(base: Dict[str, str], rank: int, size: int, coord: str,
               job: str, mca: List[str], chips_per_rank: int = 0,
-              device_plane: str = "none",
-              bind_to: str = "none") -> Dict[str, str]:
+              device_plane: str = "none", bind_to: str = "none",
+              local_rank: int | None = None,
+              num_local: int | None = None) -> Dict[str, str]:
     env = dict(base)
+    local_rank = rank if local_rank is None else local_rank
+    num_local = size if num_local is None else num_local
     if bind_to != "none":
         # CPU binding (≙ PRRTE --map-by package --bind-to core): the rank
-        # applies its cpuset at Context init (hwtopo.apply_env_binding)
+        # applies its cpuset at Context init (hwtopo.apply_env_binding);
+        # the plan is over THIS HOST's local ranks
         from ..core import hwtopo
-        cpus = hwtopo.bind_plan(size, bind_to)[rank]
+        cpus = hwtopo.bind_plan(num_local, bind_to)[local_rank]
         if cpus:
             env["OMPI_TPU_BIND_CPUS"] = ",".join(map(str, cpus))
     env["OMPI_TPU_RANK"] = str(rank)
     env["OMPI_TPU_SIZE"] = str(size)
     env["OMPI_TPU_COORD"] = coord
     env["OMPI_TPU_JOB"] = job
-    local_rank = rank                         # single-host launcher
     env["OMPI_TPU_LOCAL_RANK"] = str(local_rank)
-    env["OMPI_TPU_NUM_LOCAL"] = str(size)
+    env["OMPI_TPU_NUM_LOCAL"] = str(num_local)
     if device_plane == "cpu":
         # test fabric: one virtual CPU device per rank process. The env var
         # alone is NOT enough — a sitecustomize-registered TPU plugin can
@@ -72,6 +84,52 @@ def build_env(base: Dict[str, str], rank: int, size: int, coord: str,
         name, _, value = assign.partition("=")
         env[f"OMPI_TPU_{name}"] = value
     return env
+
+
+def _notify_coordinator(coord_str: str, abort: bool, rank: int, code: int,
+                        fins: int) -> None:
+    """Worker-launcher side of failure propagation: ABORT wakes every
+    blocked fence/get job-wide (non-recovery — mpirun semantics), FIN per
+    dead rank lets the head's wait_finished converge (recovery mode).
+    Best-effort: the coordinator may already be gone."""
+    import socket as _socket
+
+    from .tcp import recv_msg, send_msg
+
+    host, _, port = coord_str.rpartition(":")
+
+    def _one(msg) -> None:
+        try:
+            with _socket.create_connection((host, int(port)),
+                                           timeout=5) as conn:
+                send_msg(conn, msg)
+                recv_msg(conn)
+        except OSError:
+            pass
+
+    if abort:
+        _one(("ABORT", rank, code, "rank failed on worker host"))
+    else:
+        for _ in range(fins):
+            _one(("FIN",))
+
+
+def _query_abort(coord_str: str):
+    """Poll the coordinator's abort state (worker launchers); None when the
+    job is healthy or the coordinator is unreachable."""
+    import socket as _socket
+
+    from .tcp import recv_msg, send_msg
+
+    host, _, port = coord_str.rpartition(":")
+    try:
+        with _socket.create_connection((host, int(port)), timeout=5) as conn:
+            send_msg(conn, ("ABORTQ",))
+            reply = recv_msg(conn)
+            return reply[1] if reply and reply[0] == "OK" else None
+    except OSError:
+        # coordinator gone = head tore the job down; treat as aborted
+        return (-1, 1, "coordinator unreachable")
 
 
 def main(argv: List[str] | None = None) -> int:
@@ -95,6 +153,15 @@ def main(argv: List[str] | None = None) -> int:
                     help="bind each rank's CPUs (≙ mpirun --bind-to): "
                          "'core' spreads ranks across packages then cores, "
                          "'package' gives each rank a whole package")
+    ap.add_argument("--num-hosts", type=int, default=1,
+                    help="multi-host job: total participating hosts; ranks "
+                         "are split into contiguous per-host spans (run one "
+                         "tpurun per host — the DVM-less pattern)")
+    ap.add_argument("--host-index", type=int, default=0,
+                    help="this host's index in [0, num_hosts)")
+    ap.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="join an existing coordinator (worker launchers; "
+                         "host 0 prints its address at startup)")
     ap.add_argument("--enable-recovery", action="store_true",
                     help="ULFM mode (≙ prte --enable-recovery): a failed "
                          "rank does NOT take the job down; survivors run "
@@ -123,9 +190,43 @@ def main(argv: List[str] | None = None) -> int:
         ap.error("--device-plane cpu and --chips-per-rank conflict "
                  "(the CPU fabric has no chips to pin)")
 
-    coord = Coordinator(size=args.np, job_id=f"tpurun-{os.getpid()}")
-    host, port = coord.address
-    coord_str = f"{host}:{port}"
+    if not (0 <= args.host_index < args.num_hosts):
+        ap.error("--host-index must be in [0, num_hosts)")
+    if args.coordinator is None and args.host_index != 0:
+        ap.error("worker launchers (host-index > 0) need --coordinator")
+
+    # contiguous per-host rank spans (≙ PRRTE's by-node mapping): host i
+    # owns [base, base+span) where the first np%num_hosts hosts get one
+    # extra rank
+    per, extra = divmod(args.np, args.num_hosts)
+    span = per + (1 if args.host_index < extra else 0)
+    base = args.host_index * per + min(args.host_index, extra)
+    if span == 0:
+        ap.error(f"host {args.host_index} has no ranks (np={args.np}, "
+                 f"num_hosts={args.num_hosts})")
+
+    coord = None
+    if args.coordinator is None:
+        # head launcher hosts the coordinator; bind wide + advertise a
+        # routable address for multi-host jobs. The job id derives from
+        # the coordinator port on BOTH sides so worker launchers agree
+        # without extra plumbing.
+        bind = "0.0.0.0" if args.num_hosts > 1 else "127.0.0.1"
+        coord = Coordinator(size=args.np, job_id="pending", host=bind)
+        port = coord.address[1]
+        coord.job_id = f"tpurun-{port}"
+        if args.num_hosts > 1:
+            from ..p2p.reachable import best_address
+            adv = best_address(None) or "127.0.0.1"
+            print(f"tpurun: coordinator at {adv}:{port} "
+                  f"(workers: --coordinator {adv}:{port})", flush=True)
+        else:
+            adv = "127.0.0.1"
+        coord_str = f"{adv}:{port}"
+        job_id = coord.job_id
+    else:
+        coord_str = args.coordinator
+        job_id = f"tpurun-{coord_str.rpartition(':')[2]}"
     mca = [f"{n}={v}" for n, v in args.mca]
 
     cmd = args.command
@@ -139,10 +240,11 @@ def main(argv: List[str] | None = None) -> int:
     # children import ompi_tpu from this checkout
     pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     env_base["PYTHONPATH"] = pkg_root + os.pathsep + env_base.get("PYTHONPATH", "")
-    for rank in range(args.np):
-        env = build_env(env_base, rank, args.np, coord_str, coord.job_id,
+    for rank in range(base, base + span):
+        env = build_env(env_base, rank, args.np, coord_str, job_id,
                         mca, args.chips_per_rank, args.device_plane,
-                        args.bind_to)
+                        args.bind_to, local_rank=rank - base,
+                        num_local=span)
         procs.append(subprocess.Popen(cmd, env=env))
 
     def kill_all(sig=signal.SIGTERM):
@@ -160,7 +262,24 @@ def main(argv: List[str] | None = None) -> int:
         import time
         deadline = None if args.timeout is None else time.monotonic() + args.timeout
         term_at = None          # when SIGTERM went out (escalate to KILL)
+        abort_check_at = time.monotonic()
         while remaining:
+            # cross-launcher abort watch (multi-host): another host's rank
+            # failed → kill our local ranks too, like mpirun taking the
+            # whole job down. Head checks its coordinator object; workers
+            # poll over the wire every ~0.5 s.
+            if args.num_hosts > 1 and not args.enable_recovery \
+                    and term_at is None \
+                    and time.monotonic() - abort_check_at > 0.5:
+                abort_check_at = time.monotonic()
+                ab = (coord.aborted if coord is not None
+                      else _query_abort(coord_str))
+                if ab is not None:
+                    print(f"tpurun: job aborted by rank {ab[0]} "
+                          f"(code {ab[1]}): {ab[2]}", file=sys.stderr)
+                    exit_code = exit_code or int(ab[1]) or 1
+                    kill_all()
+                    term_at = time.monotonic()
             for p in list(remaining):
                 rc = p.poll()
                 if rc is None:
@@ -172,6 +291,13 @@ def main(argv: List[str] | None = None) -> int:
                         # a failed rank takes the job down, like mpirun
                         kill_all()
                         term_at = time.monotonic()
+                        if coord is not None:
+                            # head's own rank failed: mark the job aborted
+                            # so worker launchers' polls see it
+                            with coord.cond:
+                                if coord.aborted is None:
+                                    coord.aborted = (base, rc, "rank failed")
+                                coord.cond.notify_all()
             if term_at is not None and time.monotonic() - term_at > 5.0:
                 # a rank ignored SIGTERM (e.g. wedged in a native collective
                 # init) — escalate so the job always terminates
@@ -188,7 +314,34 @@ def main(argv: List[str] | None = None) -> int:
         kill_all(signal.SIGKILL)
         exit_code = 130
     finally:
-        coord.close()
+        # cross-launcher failure propagation: without this, a rank crash on
+        # one host leaves the other hosts' ranks asleep in fence/get
+        # forever (single-host never has the gap — one launcher sees every
+        # exit). Dead ranks also count as finished so the head's grace
+        # wait converges under --enable-recovery.
+        n_failed = sum(1 for p in procs
+                       if p.returncode not in (None, 0))
+        if coord is not None:
+            if n_failed and not args.enable_recovery:
+                with coord.cond:
+                    if coord.aborted is None:
+                        coord.aborted = (base, exit_code, "rank failed")
+                    coord.cond.notify_all()
+            elif n_failed:
+                with coord.cond:
+                    coord.finished += n_failed
+                    coord.cond.notify_all()
+            if args.num_hosts > 1 and not timed_out:
+                # local ranks are done but remote hosts' ranks may still be
+                # finalizing through this coordinator — hold it open until
+                # every rank reports (or a grace timeout)
+                coord.wait_finished(timeout=60)
+            coord.close()
+        elif n_failed:
+            _notify_coordinator(coord_str,
+                                abort=not args.enable_recovery,
+                                rank=base, code=exit_code or 1,
+                                fins=n_failed)
     if args.enable_recovery and not timed_out and exit_code != 130 \
             and any(p.returncode == 0 for p in procs):
         exit_code = 0          # survivors recovered; that IS success

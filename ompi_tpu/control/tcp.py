@@ -161,6 +161,12 @@ class Coordinator:
                         self.aborted = (r, code, text)
                         self.cond.notify_all()
                     send_msg(conn, ("OK",))
+                elif op == "ABORTQ":
+                    # launcher-side poll: has anyone aborted the job? (the
+                    # cross-launcher propagation path — remote launchers
+                    # kill their local ranks when this turns non-None)
+                    with self.cond:
+                        send_msg(conn, ("OK", self.aborted))
                 elif op == "FIN":
                     with self.cond:
                         self.finished += 1

@@ -14,11 +14,14 @@ the ``wait_sync`` bridge lets a caller block on either.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, List
 
 _LOW_PRIORITY_INTERVAL = 8
+
+_NULL_GUARD = contextlib.nullcontext()   # reusable, reentrant no-op guard
 
 
 class ProgressEngine:
@@ -57,14 +60,7 @@ class ProgressEngine:
             high = list(self._high)
             self.polls += 1
             low = list(self._low) if self.polls % _LOW_PRIORITY_INTERVAL == 0 else []
-        g = self.guard
-        if g is None:
-            for fn in high:
-                events += fn() or 0
-            for fn in low:
-                events += fn() or 0
-            return events
-        with g:
+        with self.guard or _NULL_GUARD:
             for fn in high:
                 events += fn() or 0
             for fn in low:

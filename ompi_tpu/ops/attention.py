@@ -47,10 +47,13 @@ def _auto_block(s: int) -> int:
     for b in (1024, 512, 256, 128, 64, 32):
         if s % b == 0:
             return b
-    # no usable divisor: fall back to the old default so _block_sizes
-    # raises its informative must-divide error (never a full-seq block —
-    # a seq² fp32 score tile would blow VMEM silently)
-    return 256
+    if s <= 1024:
+        return s       # odd short sequence: one full-seq block fits VMEM
+    # long and no usable divisor: never auto-pick a full-seq block (a
+    # seq² fp32 score tile would blow VMEM) — the caller must choose
+    raise ValueError(
+        f"no power-of-two block ≤1024 divides sequence length {s}; pass "
+        f"block_q/block_k explicitly")
 
 
 def _block_sizes(s_q: int, s_k: int, block_q: Optional[int],

@@ -17,6 +17,7 @@ Transports in-tree: ``self`` (loopback), ``tcp`` (DCN analog), ``shm``
 
 from __future__ import annotations
 
+import contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
@@ -85,6 +86,9 @@ class Transport(Component):
         pass
 
 
+_null_guard = contextlib.nullcontext()   # reentrant no-op
+
+
 class TransportLayer:
     """Per-peer transport choice (≙ BML r2's per-peer BTL arrays).
 
@@ -113,24 +117,15 @@ class TransportLayer:
             return t
 
     def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes = b"") -> None:
-        g = self.guard
-        if g is None:
+        # guard: serialize against the async progress thread when enabled
+        with self.guard or _null_guard:
             self.for_peer(peer).send(peer, tag, header, payload)
-        else:     # async progress on: serialize against the progress thread
-            with g:
-                self.for_peer(peer).send(peer, tag, header, payload)
 
     def add_peers(self, new_size: int) -> None:
         """Propagate a dynamic-spawn growth of the global rank space
         (serialized against the async progress thread like every other
         owner-thread transport mutation)."""
-        g = self.guard
-        if g is None:
-            for t in self.transports:
-                if hasattr(t, "add_peers"):
-                    t.add_peers(new_size)
-            return
-        with g:
+        with self.guard or _null_guard:
             for t in self.transports:
                 if hasattr(t, "add_peers"):
                     t.add_peers(new_size)

@@ -76,3 +76,61 @@ def test_get_parent_none_in_plain_process():
         return dpm.get_parent(ctx) is None
 
     assert all(runtime.run_ranks(2, body))
+
+
+# ---------------------------------------------------------------------------
+# multi-host (DVM-less) launch: one tpurun per host, workers join the head's
+# coordinator (≙ the PRRTE DVM role, SURVEY.md §3.4) — simulated here with
+# two launcher processes on one machine
+# ---------------------------------------------------------------------------
+
+def test_multihost_two_launchers():
+    import os
+    import re
+    import subprocess
+    import sys
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    script = os.path.join(repo, "examples", "connectivity.py")
+    import queue
+    import threading
+
+    head = subprocess.Popen(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
+         "--num-hosts", "2", "--host-index", "0", "--timeout", "80",
+         script],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    # drain head stdout on a thread: readline must not block the suite
+    # forever, and an undrained pipe can block the head's ranks on write
+    lines: "queue.Queue[str]" = queue.Queue()
+    out_acc = []
+
+    def _drain():
+        for line in head.stdout:
+            out_acc.append(line)
+            lines.put(line)
+
+    t = threading.Thread(target=_drain, daemon=True)
+    t.start()
+    try:
+        line1 = lines.get(timeout=60)
+    except queue.Empty:
+        head.kill()
+        raise AssertionError("head never printed the coordinator line")
+    m = re.search(r"coordinator at ([0-9.]+:\d+)", line1)
+    assert m, f"no coordinator line: {line1!r}"
+    addr = m.group(1)
+    worker = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
+         "--num-hosts", "2", "--host-index", "1", "--coordinator", addr,
+         script],
+        env=env, capture_output=True, text=True, timeout=90)
+    assert head.wait(timeout=90) == 0, "".join(out_acc)
+    t.join(timeout=10)
+    out = "".join(out_acc)
+    assert worker.returncode == 0, worker.stdout + worker.stderr
+    assert "Connectivity test on 4 processes PASSED" in out \
+        or "PASSED" in worker.stdout
