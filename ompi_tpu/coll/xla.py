@@ -95,6 +95,55 @@ class XlaModule(CollModule):
         self.host.barrier(comm)
         self.dc.barrier()
 
+    # -- long-tail entries without a native ICI program (v-variants,
+    # rooted gathers/scatters): the coll/accelerator staging discipline
+    # (coll_accelerator_allreduce.c:31-60) — stage device buffers to host
+    # EXPLICITLY (SPC-accounted, never an implicit np.asarray deep in a
+    # host algorithm), then run the host algorithm chain. Native ICI
+    # versions can supersede these entry-by-entry later.
+
+    def _to_host(self, x):
+        from .. import accelerator
+
+        info = accelerator.check_addr(x)
+        if info is None:
+            return x
+        spc = self.dc.spc
+        if spc is not None:
+            spc.inc("device_stage_out_bytes", info.nbytes)
+            spc.inc("coll_staged_fallbacks")
+        return np.asarray(x)
+
+    def allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
+                   displs=None):
+        return self.host.allgatherv(comm, self._to_host(sendbuf), recvbuf,
+                                    counts, displs)
+
+    def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        return self.host.gather(comm, self._to_host(sendbuf), recvbuf, root)
+
+    def gatherv(self, comm, sendbuf, recvbuf=None, counts=None, displs=None,
+                root: int = 0):
+        return self.host.basic.gatherv(comm, self._to_host(sendbuf), recvbuf,
+                                       counts, displs, root)
+
+    def scatter(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        return self.host.scatter(comm, self._to_host(sendbuf), recvbuf, root)
+
+    def scatterv(self, comm, sendbuf, recvbuf, counts, displs=None,
+                 root: int = 0):
+        return self.host.basic.scatterv(comm, self._to_host(sendbuf),
+                                        recvbuf, counts, displs, root)
+
+    def alltoallv(self, comm, sendbuf, recvbuf, sendcounts, recvcounts,
+                  sdispls=None, rdispls=None):
+        return self.host.alltoallv(comm, self._to_host(sendbuf), recvbuf,
+                                   sendcounts, recvcounts, sdispls, rdispls)
+
+    def reduce_scatter(self, comm, sendbuf, recvbuf, counts, op: Op = None):
+        return self.host.reduce_scatter(comm, self._to_host(sendbuf),
+                                        recvbuf, counts, op)
+
 
 @component("coll", "xla", priority=80)
 class XlaColl(Component):

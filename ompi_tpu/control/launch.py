@@ -114,22 +114,53 @@ def _notify_coordinator(coord_str: str, abort: bool, rank: int, code: int,
             _one(("FIN",))
 
 
-def _query_abort(coord_str: str):
-    """Poll the coordinator's abort state (worker launchers); None when the
-    job is healthy or the coordinator is unreachable."""
-    import socket as _socket
+class _AbortPoller:
+    """Worker-launcher watch on the coordinator's abort state over ONE
+    persistent connection (ABORTQ does not terminate the server's per-
+    connection loop, so a single connection serves the whole job — no
+    per-poll connect/thread churn on the head). A vanished coordinator is
+    NOT an abort: the head closes it after a healthy job too, and ranks
+    learn of a dead coordinator through their own bootstrap connections."""
 
-    from .tcp import recv_msg, send_msg
+    def __init__(self, coord_str: str) -> None:
+        host, _, port = coord_str.rpartition(":")
+        self._addr = (host, int(port))
+        self._conn = None
 
-    host, _, port = coord_str.rpartition(":")
-    try:
-        with _socket.create_connection((host, int(port)), timeout=5) as conn:
-            send_msg(conn, ("ABORTQ",))
-            reply = recv_msg(conn)
+    def query(self):
+        import socket as _socket
+
+        from .tcp import recv_msg, send_msg
+
+        try:
+            if self._conn is None:
+                self._conn = _socket.create_connection(self._addr, timeout=2)
+            send_msg(self._conn, ("ABORTQ",))
+            reply = recv_msg(self._conn)
+            self.unreachable = 0
             return reply[1] if reply and reply[0] == "OK" else None
-    except OSError:
-        # coordinator gone = head tore the job down; treat as aborted
-        return (-1, 1, "coordinator unreachable")
+        except OSError:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except OSError:
+                    pass
+                self._conn = None
+            # a vanished coordinator is ambiguous: healthy jobs end with
+            # the head closing it too. One miss is not an abort; SUSTAINED
+            # unreachability while our ranks still run means the head died
+            # hard (launcher SIGKILL) and the job is lost — the caller
+            # checks this counter.
+            self.unreachable = getattr(self, "unreachable", 0) + 1
+            return None
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
 
 
 def main(argv: List[str] | None = None) -> int:
@@ -257,6 +288,9 @@ def main(argv: List[str] | None = None) -> int:
 
     exit_code = 0
     timed_out = False
+    poller = (None if coord is not None or args.num_hosts <= 1
+              else _AbortPoller(coord_str))
+    first_failed_rank = None
     try:
         remaining = list(procs)
         import time
@@ -267,13 +301,17 @@ def main(argv: List[str] | None = None) -> int:
             # cross-launcher abort watch (multi-host): another host's rank
             # failed → kill our local ranks too, like mpirun taking the
             # whole job down. Head checks its coordinator object; workers
-            # poll over the wire every ~0.5 s.
+            # poll over a persistent connection every ~0.5 s.
             if args.num_hosts > 1 and not args.enable_recovery \
                     and term_at is None \
                     and time.monotonic() - abort_check_at > 0.5:
                 abort_check_at = time.monotonic()
                 ab = (coord.aborted if coord is not None
-                      else _query_abort(coord_str))
+                      else poller.query())
+                if ab is None and poller is not None \
+                        and getattr(poller, "unreachable", 0) >= 10:
+                    ab = (-1, 1, "coordinator unreachable for 5s with "
+                          "local ranks still running (head died?)")
                 if ab is not None:
                     print(f"tpurun: job aborted by rank {ab[0]} "
                           f"(code {ab[1]}): {ab[2]}", file=sys.stderr)
@@ -287,6 +325,7 @@ def main(argv: List[str] | None = None) -> int:
                 remaining.remove(p)
                 if rc != 0 and exit_code == 0:
                     exit_code = rc
+                    first_failed_rank = base + procs.index(p)
                     if not args.enable_recovery:
                         # a failed rank takes the job down, like mpirun
                         kill_all()
@@ -296,7 +335,8 @@ def main(argv: List[str] | None = None) -> int:
                             # so worker launchers' polls see it
                             with coord.cond:
                                 if coord.aborted is None:
-                                    coord.aborted = (base, rc, "rank failed")
+                                    coord.aborted = (first_failed_rank, rc,
+                                                     "rank failed")
                                 coord.cond.notify_all()
             if term_at is not None and time.monotonic() - term_at > 5.0:
                 # a rank ignored SIGTERM (e.g. wedged in a native collective
@@ -321,11 +361,13 @@ def main(argv: List[str] | None = None) -> int:
         # wait converges under --enable-recovery.
         n_failed = sum(1 for p in procs
                        if p.returncode not in (None, 0))
+        fail_rank = first_failed_rank if first_failed_rank is not None \
+            else base
         if coord is not None:
             if n_failed and not args.enable_recovery:
                 with coord.cond:
                     if coord.aborted is None:
-                        coord.aborted = (base, exit_code, "rank failed")
+                        coord.aborted = (fail_rank, exit_code, "rank failed")
                     coord.cond.notify_all()
             elif n_failed:
                 with coord.cond:
@@ -336,12 +378,29 @@ def main(argv: List[str] | None = None) -> int:
                 # finalizing through this coordinator — hold it open until
                 # every rank reports (or a grace timeout)
                 coord.wait_finished(timeout=60)
+                if coord.aborted is not None:
+                    # hold the abort state visible for at least one worker
+                    # poll interval so remote launchers learn WHY before
+                    # the port disappears
+                    import time as _t
+                    _t.sleep(1.5)
+                # a remote-host failure discovered during the grace wait
+                # must reach the head's exit status (the mpirun analog)
+                if coord.aborted is not None and exit_code == 0 \
+                        and not args.enable_recovery:
+                    exit_code = int(coord.aborted[1]) or 1
+                    print(f"tpurun: job aborted by rank "
+                          f"{coord.aborted[0]} (code {coord.aborted[1]}): "
+                          f"{coord.aborted[2]}", file=sys.stderr)
             coord.close()
-        elif n_failed:
-            _notify_coordinator(coord_str,
-                                abort=not args.enable_recovery,
-                                rank=base, code=exit_code or 1,
-                                fins=n_failed)
+        else:
+            if poller is not None:
+                poller.close()
+            if n_failed:
+                _notify_coordinator(coord_str,
+                                    abort=not args.enable_recovery,
+                                    rank=fail_rank, code=exit_code or 1,
+                                    fins=n_failed)
     if args.enable_recovery and not timed_out and exit_code != 130 \
             and any(p.returncode == 0 for p in procs):
         exit_code = 0          # survivors recovered; that IS success

@@ -76,6 +76,9 @@ class Coordinator:
                 conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 t = threading.Thread(target=self._serve, args=(conn,), daemon=True)
                 t.start()
+                # prune finished handlers so long jobs with transient
+                # connections don't accumulate dead Thread objects
+                self._threads = [x for x in self._threads if x.is_alive()]
                 self._threads.append(t)
         except OSError:
             return  # server closed

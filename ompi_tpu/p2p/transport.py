@@ -17,12 +17,12 @@ Transports in-tree: ``self`` (loopback), ``tcp`` (DCN analog), ``shm``
 
 from __future__ import annotations
 
-import contextlib
 import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core import var as _var
 from ..core.component import Component
+from ..core.progress import _NULL_GUARD as _null_guard
 
 # Active-message tags (≙ mca_btl_base_active_message_trigger indices)
 AM_P2P = 1          # matched point-to-point protocol (p2p/pml.py)
@@ -84,9 +84,6 @@ class Transport(Component):
 
     def finalize(self) -> None:
         pass
-
-
-_null_guard = contextlib.nullcontext()   # reentrant no-op
 
 
 class TransportLayer:

@@ -150,3 +150,30 @@ def test_bfloat16_allreduce(dc):
     np.testing.assert_allclose(
         np.asarray(dc.to_ranks(out)[0]).astype(np.float32),
         np.full(128, 36.0), rtol=1e-2)
+
+
+def test_staged_fallback_entries_account_and_work():
+    """Long-tail entries without native ICI programs take the explicit
+    coll/accelerator staging shim on mesh comms (xla.py _to_host —
+    coll_accelerator_allreduce.c:31-60 discipline): device inputs stage
+    once, SPC-counted, then the host algorithm runs."""
+    def fn(ctx):
+        c = ctx.comm_world
+        mesh = make_mesh({"x": 2}, devices=jax.devices()[:2])
+        attach_mesh(c, mesh, "x")
+        before = ctx.spc._v.get("coll_staged_fallbacks", 0)
+        dev = jnp.full(3, float(c.rank))
+        counts = [3] * c.size
+        out = np.asarray(c.coll.allgatherv(c, dev, counts=counts))
+        g = c.coll.gather(c, jnp.arange(2.0) + c.rank, root=0)
+        after = ctx.spc._v.get("coll_staged_fallbacks", 0)
+        assert after >= before + 2, (before, after)
+        return out, None if g is None else np.asarray(g)
+
+    res = runtime.run_ranks(2, fn)
+    expect = np.concatenate([np.full(3, float(r)) for r in range(2)])
+    for out, _g in res:
+        np.testing.assert_allclose(out, expect)
+    np.testing.assert_allclose(
+        np.asarray(res[0][1]).reshape(2, -1),
+        np.stack([np.arange(2.0) + r for r in range(2)]))
