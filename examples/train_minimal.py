@@ -14,6 +14,14 @@ import os
 import tempfile
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # Re-assert the env choice through jax.config: observed on this image,
+    # leaving selection to the ENV-sourced default stalls in TPU-plugin
+    # discovery when the tunneled plugin wedges, while an explicitly-SET
+    # config value initializes cpu directly (A/B-verified; same stance as
+    # tests/conftest.py). No-op guard when the user didn't ask for cpu.
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 
