@@ -1,0 +1,17 @@
+"""Shared example helper: honor JAX_PLATFORMS=cpu via jax.config.
+
+Observed on this image: leaving platform selection to the ENV-sourced
+default stalls in TPU-plugin discovery when the tunneled plugin wedges,
+while an explicitly-SET config value initializes cpu directly
+(A/B-verified; same stance as tests/conftest.py). No-op when the user
+didn't ask for cpu.
+"""
+
+import os
+
+
+def force_cpu_if_requested() -> None:
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

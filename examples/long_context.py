@@ -17,15 +17,11 @@ Run (virtual 8-device mesh):
 import os
 import time
 
-import jax
+import jax  # noqa: F401  (imported before any op)
 
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    # Re-assert the env choice through jax.config: observed on this image,
-    # leaving selection to the ENV-sourced default stalls in TPU-plugin
-    # discovery when the tunneled plugin wedges, while an explicitly-SET
-    # config value initializes cpu directly (A/B-verified; same stance as
-    # tests/conftest.py). No-op guard when the user didn't ask for cpu.
-    jax.config.update("jax_platforms", "cpu")
+from _platform import force_cpu_if_requested
+
+force_cpu_if_requested()
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P

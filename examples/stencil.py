@@ -76,11 +76,8 @@ def cg_solver(mesh: Mesh, n: int, iters: int):
 
 
 def main() -> int:
-    import os
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # env alone can be ignored by sitecustomize-registered TPU plugins;
-        # config wins while no backend is initialized (conftest.py stance)
-        jax.config.update("jax_platforms", "cpu")
+    from _platform import force_cpu_if_requested
+    force_cpu_if_requested()
     n = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
     iters = int(sys.argv[2]) if len(sys.argv) > 2 else 50
     devs = jax.devices()

@@ -9,6 +9,17 @@ the ICI instead: see ``ompi_tpu.parallel`` (ppermute/all_to_all are the
 TPU-native remote-memory primitives).
 """
 
-from .window import LOCK_EXCLUSIVE, LOCK_SHARED, Window, win_allocate
+from .window import (
+    LOCK_EXCLUSIVE,
+    LOCK_SHARED,
+    DynamicWindow,
+    Window,
+    win_allocate,
+    win_allocate_shared,
+    win_create,
+    win_create_dynamic,
+)
 
-__all__ = ["Window", "win_allocate", "LOCK_SHARED", "LOCK_EXCLUSIVE"]
+__all__ = ["Window", "DynamicWindow", "win_allocate", "win_create",
+           "win_create_dynamic", "win_allocate_shared",
+           "LOCK_SHARED", "LOCK_EXCLUSIVE"]

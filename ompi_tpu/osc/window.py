@@ -56,6 +56,12 @@ def _ensure_ops():
 _ensure_ops()
 
 
+class _TargetAccessError(RuntimeError):
+    """A target-side window access fault that must travel back to the
+    origin as the operation's error (MPI's erroneous-RMA outcome) instead
+    of crashing the target's progress loop."""
+
+
 class _OscEngine:
     """Per-rank singleton: owns the AM_OSC dispatch slot and the window
     registry (window ids are collectively deterministic: every rank creates
@@ -83,12 +89,31 @@ class _OscEngine:
         k = h["k"]
         if k in ("ack", "getdata", "fetched"):
             req, sink = self.pending.pop(h["oreq"])
+            if "err" in h:
+                # target-side access error (e.g. a dynamic window's
+                # detached region): surface on the ORIGIN's request —
+                # never raise inside the target's progress pass
+                req.complete(RuntimeError(h["err"]))
+                return
             if k != "ack" and sink is not None:
                 sink(payload)
             req.complete()
             return
         win = self.windows[h["win"]]
-        win._serve(src, h, payload)
+        try:
+            win._serve(src, h, payload)
+        except Exception as exc:
+            # ANY target-side access fault (detached region, out-of-bounds
+            # displacement, shape/dtype mismatch) is the ORIGIN's error —
+            # MPI's erroneous-RMA outcome — never a crash of the target's
+            # progress loop. Frames without an oreq (post/complete) have no
+            # origin request to fail, so those faults stay fatal.
+            if "oreq" not in h:
+                raise
+            self.ctx.layer.send(src, T.AM_OSC,
+                                {"k": "ack", "oreq": h["oreq"],
+                                 "err": f"{type(exc).__name__}: {exc}"},
+                                b"")
 
 
 def _engine(ctx) -> _OscEngine:
@@ -146,13 +171,16 @@ class Window:
     # -- origin-side operations --------------------------------------------
 
     def put(self, origin: np.ndarray, target_rank: int,
-            target_disp: int = 0) -> Request:
-        """Nonblocking put; completion = accepted+applied at target."""
+            target_disp: int = 0, region: int = None) -> Request:
+        """Nonblocking put; completion = accepted+applied at target.
+        ``region`` addresses a dynamic window's attached buffer."""
         a = np.ascontiguousarray(origin)
         req = Request()
         oreq = self.eng.next_oreq(req)
         h = {"k": "put", "win": self.win_id, "disp": int(target_disp),
              "dt": a.dtype.str, "shape": list(a.shape), "oreq": oreq}
+        if region is not None:
+            h["reg"] = int(region)
         from .. import monitoring
         monitoring.osc_event(self.comm.ctx, "put",
                              self._target_world(target_rank), a.nbytes)
@@ -161,7 +189,7 @@ class Window:
         return self._track(target_rank, req)
 
     def get(self, origin: np.ndarray, target_rank: int,
-            target_disp: int = 0) -> Request:
+            target_disp: int = 0, region: int = None) -> Request:
         """Nonblocking get into ``origin`` (shape/dtype define the request)."""
         req = Request()
 
@@ -170,6 +198,8 @@ class Window:
         oreq = self.eng.next_oreq(req, sink=land)
         h = {"k": "get", "win": self.win_id, "disp": int(target_disp),
              "dt": origin.dtype.str, "count": int(origin.size), "oreq": oreq}
+        if region is not None:
+            h["reg"] = int(region)
         from .. import monitoring
         monitoring.osc_event(self.comm.ctx, "get",
                              self._target_world(target_rank), origin.nbytes)
@@ -178,13 +208,16 @@ class Window:
         return self._track(target_rank, req)
 
     def accumulate(self, origin: np.ndarray, target_rank: int,
-                   target_disp: int = 0, op: Op = SUM) -> Request:
+                   target_disp: int = 0, op: Op = SUM,
+                   region: int = None) -> Request:
         a = np.ascontiguousarray(origin)
         req = Request()
         oreq = self.eng.next_oreq(req)
         h = {"k": "acc", "win": self.win_id, "disp": int(target_disp),
              "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
              "oreq": oreq}
+        if region is not None:
+            h["reg"] = int(region)
         from .. import monitoring
         monitoring.osc_event(self.comm.ctx, "accumulate",
                              self._target_world(target_rank), a.nbytes)
@@ -196,7 +229,7 @@ class Window:
 
     def get_accumulate(self, origin: np.ndarray, result: np.ndarray,
                        target_rank: int, target_disp: int = 0,
-                       op: Op = SUM) -> Request:
+                       op: Op = SUM, region: int = None) -> Request:
         """Atomically fetch target data into ``result`` and combine origin
         into the target (MPI_Get_accumulate; op=NO_OP → pure atomic fetch)."""
         a = np.ascontiguousarray(origin)
@@ -209,19 +242,24 @@ class Window:
         h = {"k": "getacc", "win": self.win_id, "disp": int(target_disp),
              "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
              "oreq": oreq}
+        if region is not None:
+            h["reg"] = int(region)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
                                  h, a.tobytes())
         return self._track(target_rank, req)
 
     def fetch_and_op(self, value, result: np.ndarray, target_rank: int,
-                     target_disp: int = 0, op: Op = SUM) -> Request:
+                     target_disp: int = 0, op: Op = SUM,
+                     region: int = None) -> Request:
         """Single-element get_accumulate (MPI_Fetch_and_op)."""
         origin = np.asarray([value], dtype=result.dtype) \
             if np.ndim(value) == 0 else np.asarray(value, dtype=result.dtype)
-        return self.get_accumulate(origin, result, target_rank, target_disp, op)
+        return self.get_accumulate(origin, result, target_rank, target_disp,
+                                   op, region=region)
 
     def compare_and_swap(self, compare, origin, result: np.ndarray,
-                         target_rank: int, target_disp: int = 0) -> Request:
+                         target_rank: int, target_disp: int = 0,
+                         region: int = None) -> Request:
         dt = result.dtype
         payload = (np.asarray([compare], dt).tobytes()
                    + np.asarray([origin], dt).tobytes())
@@ -232,13 +270,21 @@ class Window:
         oreq = self.eng.next_oreq(req, sink=land)
         h = {"k": "cas", "win": self.win_id, "disp": int(target_disp),
              "dt": dt.str, "oreq": oreq}
+        if region is not None:
+            h["reg"] = int(region)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
                                  h, payload)
         return self._track(target_rank, req)
 
     # -- target-side service ------------------------------------------------
 
-    def _flat(self) -> np.ndarray:
+    def _flat(self, h: dict = None) -> np.ndarray:
+        """Target-side buffer resolution; DynamicWindow overrides to map
+        the header's region handle onto an attached buffer."""
+        if h is not None and "reg" in h:
+            raise _TargetAccessError(
+                f"window {self.name} is not dynamic: region handles are "
+                f"only valid on win_create_dynamic windows")
         return self.local.reshape(-1).view(self.local.dtype)
 
     def _serve(self, src: int, h: Dict[str, Any], payload: bytes) -> None:
@@ -247,17 +293,17 @@ class Window:
         if k == "put":
             arr = np.frombuffer(payload, dtype=np.dtype(h["dt"]))
             with self._apply_lock:
-                self._flat()[h["disp"]:h["disp"] + arr.size] = arr
+                self._flat(h)[h["disp"]:h["disp"] + arr.size] = arr
             layer.send(src, T.AM_OSC, {"k": "ack", "oreq": h["oreq"]}, b"")
         elif k == "get":
             with self._apply_lock:
-                data = self._flat()[h["disp"]:h["disp"] + h["count"]].tobytes()
+                data = self._flat(h)[h["disp"]:h["disp"] + h["count"]].tobytes()
             layer.send(src, T.AM_OSC, {"k": "getdata", "oreq": h["oreq"]}, data)
         elif k in ("acc", "getacc"):
             arr = np.frombuffer(payload, dtype=np.dtype(h["dt"]))
             op = _OPS[h["op"]]
             with self._apply_lock:
-                view = self._flat()[h["disp"]:h["disp"] + arr.size]
+                view = self._flat(h)[h["disp"]:h["disp"] + arr.size]
                 if k == "getacc":
                     fetched = view.tobytes()
                 view[...] = op(arr, view.copy())
@@ -271,7 +317,7 @@ class Window:
             cmp_v = np.frombuffer(payload[:dt.itemsize], dt)[0]
             new_v = np.frombuffer(payload[dt.itemsize:], dt)[0]
             with self._apply_lock:
-                view = self._flat()
+                view = self._flat(h)
                 old = view[h["disp"]]
                 if old == cmp_v:
                     view[h["disp"]] = new_v
@@ -329,20 +375,42 @@ class Window:
     # -- synchronization ----------------------------------------------------
 
     def flush(self, rank: int) -> None:
-        """Complete all outstanding ops to ``rank`` (MPI_Win_flush)."""
+        """Complete all outstanding ops to ``rank`` (MPI_Win_flush).
+        Raises the FIRST failed op's error, after draining every op —
+        leaving later acks in flight would corrupt the next epoch."""
+        first_err = None
         for r in self._outstanding.pop(rank, []):
-            r.wait()
+            try:
+                r.wait()
+            except Exception as exc:
+                first_err = first_err or exc
+        if first_err is not None:
+            raise first_err
 
     def flush_all(self) -> None:
+        first_err = None
         for rank in list(self._outstanding):
-            self.flush(rank)
+            try:
+                self.flush(rank)
+            except Exception as exc:
+                first_err = first_err or exc
+        if first_err is not None:
+            raise first_err
 
     def fence(self, assert_: int = 0) -> None:
         """MPI_Win_fence: ends+starts an active-target epoch. Local ops are
         acked-after-apply, so flush_all + barrier ⇒ all ops in the epoch are
-        complete everywhere (the osc/rdma fence recipe)."""
-        self.flush_all()
+        complete everywhere (the osc/rdma fence recipe). A failed op's
+        error surfaces AFTER the barrier — skipping it would desynchronize
+        the epoch across ranks."""
+        err = None
+        try:
+            self.flush_all()
+        except Exception as exc:
+            err = exc
         self.comm.barrier()
+        if err is not None:
+            raise err
 
     # PSCW (MPI_Win_post/start/complete/wait)
 
@@ -393,7 +461,13 @@ class Window:
         self._held_locks[rank] = lock_type
 
     def unlock(self, rank: int) -> None:
-        self.flush(rank)
+        # a failed op in the epoch must NOT leak the target's lock: drain,
+        # remember the first error, release the lock, then raise
+        err = None
+        try:
+            self.flush(rank)
+        except Exception as exc:
+            err = exc
         typ = self._held_locks.pop(rank)
         req = Request()
         oreq = self.eng.next_oreq(req)
@@ -401,6 +475,8 @@ class Window:
                                  {"k": "unlock", "win": self.win_id,
                                   "type": typ, "oreq": oreq}, b"")
         req.wait(timeout=60)
+        if err is not None:
+            raise err
 
     def lock_all(self) -> None:
         for r in range(self.comm.size):
@@ -419,3 +495,117 @@ def win_allocate(comm, count: int, dtype=np.float64,
                  name: str = "win") -> Window:
     """MPI_Win_allocate: the window owns its buffer (``win.local``)."""
     return Window(comm, np.zeros(count, dtype=np.dtype(dtype)), name=name)
+
+
+def win_create(comm, buffer: np.ndarray, name: str = "win") -> Window:
+    """MPI_Win_create: expose a USER-owned buffer — remote operations land
+    directly in the caller's array (no copy; must be C-contiguous)."""
+    return Window(comm, buffer, name=name)
+
+
+class DynamicWindow(Window):
+    """MPI_Win_create_dynamic: a window with no initial buffer; local
+    memory is exposed later with attach() and withdrawn with detach()
+    (≙ osc_rdma dynamic windows). Remote operations address
+    (region handle, displacement) — handles are LOCAL (attach is a local
+    call, like MPI, where the app exchanges addresses itself); ship them
+    to origins with any communication you like."""
+
+    def __init__(self, comm, name: str = "dynwin") -> None:
+        super().__init__(comm, np.zeros(0, np.uint8), name=name)
+        self._regions: Dict[int, np.ndarray] = {}
+        self._next_region = 0
+
+    def attach(self, buffer: np.ndarray) -> int:
+        """Expose ``buffer`` (local call); returns the region handle remote
+        ranks pass as ``region=`` to put/get/accumulate."""
+        if not buffer.flags["C_CONTIGUOUS"]:
+            raise ValueError("attached buffer must be C-contiguous")
+        with self._apply_lock:
+            handle = self._next_region
+            self._next_region += 1
+            self._regions[handle] = buffer
+        return handle
+
+    def detach(self, handle: int) -> None:
+        """Withdraw a region (local call); in-flight operations naming it
+        afterwards fail at the target like MPI's erroneous access."""
+        with self._apply_lock:
+            self._regions.pop(handle, None)
+
+    def _flat(self, h: dict = None) -> np.ndarray:
+        if h is None or "reg" not in h:
+            return super()._flat(h)
+        region = self._regions.get(h["reg"])
+        if region is None:
+            raise _TargetAccessError(
+                f"dynamic window {self.name}: operation names detached/"
+                f"unknown region {h['reg']}")
+        return region.reshape(-1).view(region.dtype)
+
+
+def win_create_dynamic(comm, name: str = "dynwin") -> DynamicWindow:
+    return DynamicWindow(comm, name=name)
+
+
+class SharedWindow(Window):
+    """MPI_Win_allocate_shared: same-host ranks back their windows with ONE
+    /dev/shm segment so peers can load/store each other's slices directly
+    (``shared_query``) — the RMA AM path still works too. Counts may
+    differ per rank (the MPI contract); slices are laid out in rank order.
+    Caller responsibility (as in MPI): all ranks of ``comm`` share a host."""
+
+    def __init__(self, comm, count: int, dtype=np.float64,
+                 name: str = "shwin") -> None:
+        import mmap
+        import os
+
+        dt = np.dtype(dtype)
+        counts = [int(v) for v in np.asarray(comm.coll.allgather(
+            comm, np.array([count], np.int64))).reshape(-1)]
+        offsets = np.concatenate([[0], np.cumsum(counts)[:-1]]).astype(int)
+        total = int(sum(counts)) * dt.itemsize
+        seq = getattr(comm, "_shwin_seq", 0)
+        comm._shwin_seq = seq + 1
+        path = (f"/dev/shm/ompi_tpu_{comm.ctx.bootstrap.job_id}_"
+                f"{comm.cid}_{name}_{seq}")
+        if comm.rank == 0:
+            with open(path, "wb") as fh:
+                fh.truncate(max(total, 1))
+        comm.barrier()
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._sh_mmap = mmap.mmap(fd, max(total, 1))
+        finally:
+            os.close(fd)
+        self._sh_segment = np.frombuffer(
+            self._sh_mmap, dtype=dt, count=int(sum(counts))) if total \
+            else np.zeros(0, dt)
+        self._sh_counts = counts
+        self._sh_offsets = offsets
+        self._sh_path = path
+        me = comm.rank
+        super().__init__(
+            comm, self._sh_segment[offsets[me]:offsets[me] + counts[me]],
+            name=name)
+
+    def shared_query(self, rank: int) -> np.ndarray:
+        """Direct load/store view of rank's slice (MPI_Win_shared_query)."""
+        o, c = self._sh_offsets[rank], self._sh_counts[rank]
+        return self._sh_segment[o:o + c]
+
+    def free(self) -> None:
+        import os
+
+        super().free()            # collective (barriers)
+        self.comm.barrier()       # no rank still loads before the unlink
+        if self.comm.rank == 0:
+            try:
+                os.unlink(self._sh_path)
+            except OSError:
+                pass
+
+
+def win_allocate_shared(comm, count: int, dtype=np.float64,
+                        name: str = "shwin") -> SharedWindow:
+    return SharedWindow(comm, count, dtype, name=name)

@@ -222,3 +222,94 @@ def test_passive_target_progress_while_target_computes():
     finally:
         var.registry.clear_cli("runtime_async_progress")
         var.registry.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# window flavors (≙ MPI_Win_create / create_dynamic+attach / allocate_shared;
+# reference osc_rdma window types)
+# ---------------------------------------------------------------------------
+
+def test_win_create_exposes_user_buffer():
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.osc import win_create
+
+    def fn(ctx):
+        c = ctx.comm_world
+        mine = np.zeros(4, np.float64)          # USER-owned
+        win = win_create(c, mine)
+        win.fence()
+        peer = (c.rank + 1) % c.size
+        win.put(np.full(2, 10.0 + c.rank), peer, target_disp=1).wait()
+        win.fence()
+        got = mine.copy()                       # remote write visible HERE
+        win.free()
+        src = (c.rank - 1) % c.size
+        np.testing.assert_allclose(got, [0, 10.0 + src, 10.0 + src, 0])
+        return True
+
+    assert all(runtime.run_ranks(3, fn))
+
+
+def test_win_create_dynamic_attach_detach():
+    import numpy as np
+    import pytest
+    from ompi_tpu import runtime
+    from ompi_tpu.osc import win_create_dynamic
+
+    def fn(ctx):
+        c = ctx.comm_world
+        win = win_create_dynamic(c)
+        a = np.zeros(4, np.float64)
+        b = np.zeros(2, np.int64)
+        ha, hb = win.attach(a), win.attach(b)
+        # exchange handles the MPI way: the app ships them itself
+        handles = np.asarray(c.coll.allgather(
+            c, np.array([ha, hb], np.int64))).reshape(c.size, 2)
+        c.barrier()
+        peer = (c.rank + 1) % c.size
+        win.lock(peer)
+        win.put(np.full(4, 5.0 + c.rank), peer,
+                region=int(handles[peer][0])).wait()
+        win.accumulate(np.array([3, 4], np.int64), peer,
+                       region=int(handles[peer][1])).wait()
+        win.unlock(peer)
+        c.barrier()
+        src = (c.rank - 1) % c.size
+        np.testing.assert_allclose(a, np.full(4, 5.0 + src))
+        np.testing.assert_array_equal(b, [3, 4])
+        # detach: later remote access fails CLEANLY at the origin (the
+        # target replies an error ack instead of crashing its progress)
+        win.detach(ha)
+        c.barrier()
+        with pytest.raises(RuntimeError, match="detached/unknown region"):
+            win.put(np.ones(1), peer,
+                    region=int(handles[peer][0])).wait(timeout=10)
+        c.barrier()
+        win.free()
+        return True
+
+    assert all(runtime.run_ranks(3, fn, timeout=60))
+
+
+def test_win_allocate_shared_direct_loads():
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.osc import win_allocate_shared
+
+    def fn(ctx):
+        c = ctx.comm_world
+        # per-rank counts DIFFER (the MPI contract)
+        win = win_allocate_shared(c, 2 + c.rank, np.float64)
+        win.local[:] = 100.0 + c.rank
+        c.barrier()
+        # direct load/store of a PEER's slice — no RMA call
+        peer = (c.rank + 1) % c.size
+        view = win.shared_query(peer)
+        assert view.size == 2 + peer
+        np.testing.assert_allclose(view, np.full(2 + peer, 100.0 + peer))
+        c.barrier()
+        win.free()
+        return True
+
+    assert all(runtime.run_ranks(3, fn))
