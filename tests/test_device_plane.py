@@ -93,3 +93,81 @@ def test_multiprocess_coll_xla_component_path():
     """)
     for r in range(2):
         assert f"RANK{r}_COLL_OK" in out
+
+
+def test_multihost_launchers_device_plane():
+    """The north-star composition on this box: TWO launcher processes
+    (simulated hosts) × their rank spans, jax.distributed wired through the
+    modex, one global device mesh, allreduce across all processes'
+    devices (≙ rank-per-chip across hosts, PRRTE's role end-to-end)."""
+    import os
+    import re
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    prog = tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False, prefix="mh_devplane_")
+    prog.write("""
+import numpy as np
+from ompi_tpu import runtime
+from ompi_tpu.parallel import DeviceComm, init_device_plane, make_mesh
+ctx = runtime.init()
+c = ctx.comm_world
+init_device_plane(ctx)
+mesh = make_mesh({"x": c.size})
+dc = DeviceComm(mesh, "x")
+x = dc.from_local(np.full((1, 8), float(ctx.rank + 1), np.float32))
+np.testing.assert_allclose(
+    dc.to_local(dc.allreduce(x)),
+    np.full((1, 8), sum(range(1, c.size + 1)), np.float32))
+if ctx.rank == 0:
+    print("MH-DEVPLANE-OK", flush=True)
+ctx.finalize()
+""")
+    prog.close()
+    try:
+        head = subprocess.Popen(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
+             "--num-hosts", "2", "--host-index", "0", "--device-plane",
+             "cpu", "--timeout", "220", prog.name],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        import queue
+        import threading
+        lines: "queue.Queue[str]" = queue.Queue()
+        acc = []
+
+        def drain():
+            for ln in head.stdout:
+                acc.append(ln)
+                lines.put(ln)
+
+        threading.Thread(target=drain, daemon=True).start()
+        addr = None
+        import time
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                ln = lines.get(timeout=5)
+            except queue.Empty:
+                continue
+            m = re.search(r"coordinator at ([0-9.]+:\d+)", ln)
+            if m:
+                addr = m.group(1)
+                break
+        assert addr, "".join(acc)
+        worker = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
+             "--num-hosts", "2", "--host-index", "1", "--coordinator",
+             addr, "--device-plane", "cpu", prog.name],
+            env=env, capture_output=True, text=True, timeout=220)
+        assert head.wait(timeout=220) == 0, "".join(acc)
+        assert worker.returncode == 0, worker.stdout + worker.stderr
+        assert "MH-DEVPLANE-OK" in "".join(acc)
+    finally:
+        head.kill() if head.poll() is None else None
+        os.unlink(prog.name)
