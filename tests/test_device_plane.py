@@ -100,15 +100,13 @@ def test_multihost_launchers_device_plane():
     (simulated hosts) × their rank spans, jax.distributed wired through the
     modex, one global device mesh, allreduce across all processes'
     devices (≙ rank-per-chip across hosts, PRRTE's role end-to-end)."""
-    import os
     import re
-    import subprocess
-    import sys
     import tempfile
 
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
-    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)       # launcher sets the device plane
+    env["XLA_FLAGS"] = ""                # drop conftest's 8-device forcing
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     prog = tempfile.NamedTemporaryFile(
         "w", suffix=".py", delete=False, prefix="mh_devplane_")
     prog.write("""
@@ -129,6 +127,8 @@ if ctx.rank == 0:
 ctx.finalize()
 """)
     prog.close()
+    head = None
+    drainer = None
     try:
         head = subprocess.Popen(
             [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
@@ -146,7 +146,8 @@ ctx.finalize()
                 acc.append(ln)
                 lines.put(ln)
 
-        threading.Thread(target=drain, daemon=True).start()
+        drainer = threading.Thread(target=drain, daemon=True)
+        drainer.start()
         addr = None
         import time
         deadline = time.time() + 60
@@ -163,11 +164,14 @@ ctx.finalize()
         worker = subprocess.run(
             [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
              "--num-hosts", "2", "--host-index", "1", "--coordinator",
-             addr, "--device-plane", "cpu", prog.name],
-            env=env, capture_output=True, text=True, timeout=220)
+             addr, "--device-plane", "cpu", "--timeout", "220", prog.name],
+            env=env, capture_output=True, text=True, timeout=240)
         assert head.wait(timeout=220) == 0, "".join(acc)
+        drainer.join(timeout=30)     # EOF after all children exit — the
+        # final lines (MH-DEVPLANE-OK) may still be in the pipe otherwise
         assert worker.returncode == 0, worker.stdout + worker.stderr
         assert "MH-DEVPLANE-OK" in "".join(acc)
     finally:
-        head.kill() if head.poll() is None else None
+        if head is not None and head.poll() is None:
+            head.kill()
         os.unlink(prog.name)
