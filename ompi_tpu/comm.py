@@ -36,6 +36,9 @@ ROOT = -3
 PROC_NULL = -2
 
 
+_GROUP_SEQ_LOCK = threading.Lock()
+
+
 class Group:
     """An ordered set of world ranks (≙ ompi/group)."""
 
@@ -474,6 +477,73 @@ class Communicator:
         in_group = group.rank_of_world(self.ctx.rank) >= 0
         return self.split(color=0 if in_group else None, key=self.rank,
                           name=name)
+
+    def create_group(self, group: Group, tag: int = 0,
+                     name: str = "groupcomm") -> Optional["Communicator"]:
+        """MPI_Comm_create_group: like create_from_group but collective
+        over the GROUP's members only — non-members need not call; a
+        straggler outside the group can't stall creation. The CID is
+        LEADER-ALLOCATED (the group's first world rank hands out
+        monotonically from its own per-process sequence) and carries the
+        leader's rank, so any two such comms differ: same leader → the
+        sequence separates them, different leaders → the rank field does.
+        ``tag`` isolates the agreement traffic of concurrent calls (the
+        reference's tag-scoped path), not the CID value."""
+        me = group.rank_of_world(self.ctx.rank)
+        if me < 0:
+            return None
+        base = -600000 - (tag % 1000) * 4
+        n = len(group.world_ranks)
+        with _GROUP_SEQ_LOCK:     # ctx-level seq: per-comm locks differ
+            seq = getattr(self.ctx, "_group_cid_seq", 0)
+            self.ctx._group_cid_seq = seq + 1
+        props = np.zeros(n, np.int64)
+        props[me] = seq
+        right = group.world_ranks[(me + 1) % n]
+        left = group.world_ranks[(me - 1) % n]
+        for step in range(n - 1):
+            s = (me - step) % n
+            d = (me - step - 1) % n
+            inbox = np.zeros(1, np.int64)
+            self.ctx.p2p.sendrecv(props[s:s + 1], right, inbox, left,
+                                  base, base, cid=self.cid)
+            props[d] = inbox[0]
+        # band 2^36: above any plausible split lineage (generation-k split
+        # cids grow as 1024^k — gen 3 ≈ 2^31) yet compact enough that
+        # children namespacing cid*1024+k survive three more generations
+        # in int64 (the same depth budget every cid band here has)
+        cid = (1 << 36) | ((group.world_ranks[0] & 0x3FFF) << 16) \
+            | (int(props[0]) & 0xFFFF)
+        return self._inherit(Communicator(
+            self.ctx, Group(list(group.world_ranks)), cid, name))
+
+    def split_type(self, split_type: str = "shared", key: int = 0,
+                   name: str = "nodecomm") -> "Communicator":
+        """MPI_Comm_split_type(COMM_TYPE_SHARED): one communicator per
+        shared-memory host (the HAN/hierarchy building block). Host
+        identity = the shm transport's host key when available, else
+        hostname+boot-id."""
+        if split_type != "shared":
+            raise ValueError(f"unknown split_type {split_type!r}")
+        from .p2p.shm import _host_key
+        me = _host_key().encode()[:64]
+        pad = np.zeros(64, np.uint8)
+        pad[:len(me)] = np.frombuffer(me, np.uint8)
+        keys = np.asarray(self.coll.allgather(self, pad)).reshape(
+            self.size, 64)
+        uniq = sorted({bytes(k) for k in keys})
+        color = uniq.index(bytes(keys[self.rank]))
+        # pass key through untouched: split() already tie-breaks equal keys
+        # by parent rank, and rewriting an explicit key=0 would break MPI's
+        # lowest-key-first ordering
+        return self.split(color=color, key=key, name=name)
+
+    def idup(self, name: Optional[str] = None):
+        """MPI_Comm_idup — executed eagerly (legal: nonblocking calls may
+        complete immediately); returns a completed request carrying the
+        new communicator on ``.result``."""
+        from .p2p.request import CompletedRequest
+        return CompletedRequest(result=self.dup(name))
 
     def barrier(self) -> None:
         self.coll.barrier(self)

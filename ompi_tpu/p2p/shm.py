@@ -37,7 +37,16 @@ _var.register("transport", "shm", "ring_size", 1 << 21, type=int, level=4,
 
 
 def _host_key() -> str:
-    return socket.gethostname()
+    """Shared-memory host identity: hostname ALONE merges distinct
+    containers/VMs that default to the same name (e.g. 'localhost'), so
+    qualify with the kernel boot id — equal only for processes under one
+    kernel, i.e. exactly the processes that can share /dev/shm."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as fh:
+            boot = fh.read().strip()
+    except OSError:
+        boot = ""
+    return f"{socket.gethostname()}#{boot}"
 
 
 def _chan_name(job: str, src: int, dst: int) -> bytes:

@@ -249,3 +249,50 @@ def test_intercomm_ft_guard():
         assert inter._world_dst(0) == inter.remote_group.world_of_rank(0)
         return True
     assert all(run(2, body))
+
+
+# ---------------------------------------------------------------------------
+# comm constructors round-2 additions: create_group (group-collective),
+# split_type(shared), idup (≙ MPI_Comm_create_group / split_type / idup)
+# ---------------------------------------------------------------------------
+
+def test_create_group_only_members_call():
+    import numpy as np
+    from ompi_tpu import runtime
+
+    def fn(ctx):
+        c = ctx.comm_world
+        g = c.group.incl([0, 2])
+        if c.rank in (0, 2):
+            # ONLY the members call — rank 1/3 never participate, and the
+            # creation must not stall on them
+            sub = c.create_group(g, tag=5)
+            assert sub is not None and sub.size == 2
+            out = sub.coll.allreduce(sub, np.ones(4) * (sub.rank + 1))
+            np.testing.assert_allclose(np.asarray(out), np.full(4, 3.0))
+            return sub.cid
+        return None
+
+    res = runtime.run_ranks(4, fn)
+    assert res[0] == res[2] and res[0] is not None
+    assert res[1] is None and res[3] is None
+
+
+def test_split_type_shared_and_idup():
+    import numpy as np
+    from ompi_tpu import runtime
+
+    def fn(ctx):
+        c = ctx.comm_world
+        node = c.split_type("shared")
+        # threaded ranks share one host: the node comm IS the world
+        assert node.size == c.size
+        out = node.coll.allreduce(node, np.ones(2))
+        np.testing.assert_allclose(np.asarray(out), np.full(2, c.size))
+        req = c.idup()
+        dup = req.result
+        assert req.done and dup.size == c.size and dup.cid != c.cid
+        dup.barrier()
+        return True
+
+    assert all(runtime.run_ranks(3, fn))
