@@ -204,6 +204,22 @@ class Communicator:
                 raise ProcFailedError(exc.rank) from None
             raise
 
+    def send_init(self, buf, dst: int, tag: int = 0, **kw):
+        """MPI_Send_init: a persistent send template (p2p/persistent.py);
+        arm with .start(), complete with .wait(), re-arm at will."""
+        from .p2p.persistent import PersistentRequest
+        return PersistentRequest(self, "send", buf, dst, tag, **kw)
+
+    def ssend_init(self, buf, dst: int, tag: int = 0, **kw):
+        from .p2p.persistent import PersistentRequest
+        return PersistentRequest(self, "ssend", buf, dst, tag, **kw)
+
+    def recv_init(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
+                  **kw):
+        """MPI_Recv_init."""
+        from .p2p.persistent import PersistentRequest
+        return PersistentRequest(self, "recv", buf, src, tag, **kw)
+
     def sendrecv(self, sendbuf, dst: int, recvbuf, src: int,
                  sendtag: int = 0, recvtag: int = ANY_TAG):
         rreq = self.irecv(recvbuf, src, recvtag)

@@ -1,0 +1,148 @@
+"""Classic persistent point-to-point requests.
+
+≙ MPI_Send_init / MPI_Recv_init / MPI_Start / MPI_Startall (the reference
+implements them in pml/ob1 as pre-built request templates re-armed by
+start). A persistent request captures the call's arguments once; start()
+re-activates it (posting a fresh underlying operation), wait()/test()
+complete the CURRENT activation, and the request stays allocated for the
+next start — the classic halo-exchange pattern:
+
+    sreq = comm.send_init(sbuf, right, tag=7)
+    rreq = comm.recv_init(rbuf, left, tag=7)
+    for _ in range(iters):
+        start_all([sreq, rreq])
+        ...overlap compute...
+        sreq.wait(); rreq.wait()
+    sreq.free(); rreq.free()
+
+Buffers are captured by REFERENCE (MPI semantics): refill the send buffer
+/ read the recv buffer between activations. Not to be confused with
+partitioned p2p (part.py — MPI-4 Psend/Precv) or persistent collectives
+(coll/nbc.py persistent()).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .request import Request, Status
+
+
+class PersistentRequest:
+    """An inactive request template; start() arms it."""
+
+    __slots__ = ("_comm", "_kind", "_buf", "_peer", "_tag", "_kw",
+                 "_active", "_freed", "_last_status", "_last_result")
+
+    def __init__(self, comm, kind: str, buf, peer: int, tag: int,
+                 **kw) -> None:
+        self._comm = comm
+        self._kind = kind          # "send" | "ssend" | "recv"
+        self._buf = buf
+        self._peer = peer
+        self._tag = tag
+        self._kw = kw
+        self._active: Optional[Request] = None
+        self._freed = False
+        self._last_status: Optional[Status] = None   # most recent collection
+        self._last_result = None                     # e.g. device recv array
+
+    @property
+    def active(self) -> bool:
+        """MPI-active: started and not yet COLLECTED by wait/test —
+        transport-level completion alone does not deactivate it."""
+        return self._active is not None
+
+    def start(self) -> "PersistentRequest":
+        """Arm the request (MPI_Start). Starting while the previous
+        activation is still in flight is erroneous in MPI; enforced."""
+        if self._freed:
+            raise RuntimeError("persistent request used after free")
+        if self.active:
+            raise RuntimeError(
+                "MPI_Start on an ACTIVE persistent request (the previous "
+                "activation has not completed)")
+        if self._kind == "recv":
+            self._active = self._comm.irecv(self._buf, self._peer,
+                                            self._tag, **self._kw)
+        else:
+            kw = dict(self._kw)
+            if self._kind == "ssend":
+                kw["sync"] = True
+            self._active = self._comm.isend(self._buf, self._peer,
+                                            self._tag, **kw)
+        return self
+
+    def _collect(self) -> None:
+        """The current activation completed: keep its status/result so
+        they survive re-arming (device recvs deliver ONLY via .result —
+        see pml.py's device-destination contract)."""
+        self._last_status = self._active.status
+        self._last_result = self._active.result
+        self._active = None
+
+    def wait(self, timeout: Optional[float] = None) -> Status:
+        """Complete the current activation; the request stays allocated
+        (inactive) for the next start. Waiting on an INACTIVE request whose
+        last activation was already collected (e.g. via test()) is MPI's
+        no-op wait: the last status returns again."""
+        if self._freed:
+            raise RuntimeError("persistent request used after free")
+        if self._active is None:
+            if self._last_status is not None:
+                return self._last_status
+            raise RuntimeError("wait on a never-started persistent request")
+        self._active.wait(timeout=timeout)
+        self._collect()
+        return self._last_status
+
+    def test(self) -> bool:
+        if self._freed:
+            raise RuntimeError("persistent request used after free")
+        if self._active is None:
+            return True
+        if self._active.test():
+            self._collect()
+            return True
+        return False
+
+    @property
+    def status(self) -> Optional[Status]:
+        """Status of the most recently collected activation."""
+        return self._last_status
+
+    @property
+    def result(self):
+        """Result of the current (if collected-able) or most recently
+        collected activation — where device-array recvs deliver."""
+        if self._active is not None:
+            return self._active.result
+        return self._last_result
+
+    def free(self) -> None:
+        """MPI_Request_free on an inactive persistent request."""
+        if self.active:
+            raise RuntimeError("free of an ACTIVE persistent request")
+        self._freed = True
+        self._active = None
+
+
+def start_all(requests: List[PersistentRequest]) -> None:
+    """MPI_Startall."""
+    for r in requests:
+        r.start()
+
+
+def wait_all_persistent(requests: List[PersistentRequest],
+                        timeout: Optional[float] = None) -> List[Status]:
+    """MPI_Waitall over persistent requests: ONE overall deadline (the
+    per-request remainder shrinks as earlier ones complete), matching
+    request.wait_all's discipline rather than compounding n×timeout."""
+    import time
+    deadline = None if timeout is None else time.monotonic() + timeout
+    out = []
+    for r in requests:
+        left = None if deadline is None else \
+            max(0.0, deadline - time.monotonic())
+        out.append(r.wait(timeout=left))
+    return out

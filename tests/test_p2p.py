@@ -378,3 +378,74 @@ def test_reachable_weight_ladder():
     assert weight(private, "93.184.216.34") > weight(lo, "93.184.216.34")
     # localhost target inverts the ladder
     assert weight(lo, "127.0.0.1") > weight(private, "127.0.0.1")
+
+
+# ---------------------------------------------------------------------------
+# classic persistent p2p (≙ MPI_Send_init/Recv_init/Start/Startall — the
+# pml/ob1 pre-built request templates; p2p/persistent.py)
+# ---------------------------------------------------------------------------
+
+def test_persistent_halo_exchange():
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.p2p.persistent import start_all
+
+    def fn(ctx):
+        c = ctx.comm_world
+        right = (c.rank + 1) % c.size
+        left = (c.rank - 1) % c.size
+        sbuf = np.zeros(16)
+        rbuf = np.zeros(16)
+        sreq = c.send_init(sbuf, right, tag=7)
+        rreq = c.recv_init(rbuf, left, tag=7)
+        from ompi_tpu.p2p.persistent import wait_all_persistent
+        got = []
+        for it in range(5):
+            sbuf[:] = 100.0 * it + c.rank      # refill BETWEEN activations
+            start_all([sreq, rreq])
+            if it % 2 == 0:
+                sreq.wait()
+                st = rreq.wait()
+            else:
+                # test()-then-wait must be legal (MPI no-op wait) and the
+                # status must survive collection via test()
+                while not rreq.test():
+                    pass
+                st = rreq.wait()
+                wait_all_persistent([sreq])
+            assert st.source == left
+            got.append(float(rbuf[0]))
+        sreq.free()
+        rreq.free()
+        return got
+
+    res = runtime.run_ranks(3, fn)
+    for me, vals in enumerate(res):
+        left = (me - 1) % 3
+        assert vals == [100.0 * it + left for it in range(5)]
+
+
+def test_persistent_misuse_raises():
+    import numpy as np
+    import pytest
+    from ompi_tpu import runtime
+
+    def fn(ctx):
+        c = ctx.comm_world
+        if c.rank == 0:
+            req = c.send_init(np.zeros(4), 1, tag=3)
+            req.start()
+            with pytest.raises(RuntimeError, match="ACTIVE"):
+                req.start()               # re-start while in flight
+            req.wait()
+            req.free()
+            with pytest.raises(RuntimeError, match="after free"):
+                req.start()
+            c.barrier()
+        else:
+            buf = np.zeros(4)
+            c.recv(buf, 0, tag=3)
+            c.barrier()
+        return True
+
+    assert all(runtime.run_ranks(2, fn))
