@@ -73,6 +73,102 @@ class InterColl:
             return recvbuf
         return out
 
+    def reduce(self, comm, sendbuf, recvbuf=None, op: Op = None,
+               root: int = 0):
+        """Rooted: the REMOTE group's contributions reduce onto the root
+        (MPI-4 §6.8 reduce addressing: ROOT / PROC_NULL / remote rank)."""
+        from ..comm import PROC_NULL, ROOT, TAG_INTER_COLL
+        op = op or SUM
+        lc = self._lc(comm)
+        if root == PROC_NULL:
+            return None
+        if root == ROOT:
+            # I am the root: the sending group reduced locally and its
+            # leader ships one vector
+            out = np.empty_like(np.asarray(sendbuf)) if recvbuf is None \
+                else recvbuf
+            comm.recv(out, 0, TAG_INTER_COLL)
+            return out
+        # sending group: reduce locally onto our leader, leader sends to
+        # the remote root
+        part = lc.coll.reduce(lc, sendbuf, op=op, root=0)
+        if lc.rank == 0:
+            comm.send(np.asarray(part), root, TAG_INTER_COLL)
+        return None
+
+    def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        """Rooted: the root receives the concatenation of the REMOTE
+        group's buffers."""
+        from ..comm import PROC_NULL, ROOT, TAG_INTER_COLL
+        lc = self._lc(comm)
+        if root == PROC_NULL:
+            return None
+        if root == ROOT:
+            if recvbuf is None:
+                raise ValueError(
+                    "intercomm gather at ROOT needs recvbuf shaped "
+                    "(remote_size, *elem) — the remote element shape is "
+                    "not inferable here")
+            comm.recv(np.asarray(recvbuf), 0, TAG_INTER_COLL)
+            return recvbuf
+        cat = lc.coll.gather(lc, np.asarray(sendbuf), root=0)
+        if lc.rank == 0:
+            comm.send(np.ascontiguousarray(cat), root, TAG_INTER_COLL)
+        return None
+
+    def scatter(self, comm, sendbuf=None, recvbuf=None, root: int = 0):
+        """Rooted: the root scatters one block per REMOTE rank."""
+        from ..comm import PROC_NULL, ROOT, TAG_INTER_COLL
+        lc = self._lc(comm)
+        if root == PROC_NULL:
+            return None
+        if root == ROOT:
+            comm.send(np.ascontiguousarray(sendbuf), 0, TAG_INTER_COLL)
+            return None
+        if recvbuf is None:
+            raise ValueError("intercomm scatter receivers need recvbuf")
+        recvbuf = np.asarray(recvbuf)
+        blocks = None
+        if lc.rank == 0:        # leader-only staging: don't allocate the
+            blocks = np.empty((lc.size,) + recvbuf.shape, recvbuf.dtype)
+            comm.recv(blocks, root, TAG_INTER_COLL)
+        lc.coll.scatter(lc, blocks, recvbuf, root=0)
+        return recvbuf
+
+    def alltoall(self, comm, sendbuf, recvbuf=None):
+        """Block i of each rank's sendbuf goes to REMOTE rank i; symmetric
+        both ways (MPI-4 §6.8 alltoall on intercomms). Leaders exchange the
+        full block matrices, then each side scatters rows locally."""
+        from ..comm import TAG_INTER_COLL
+        lc = self._lc(comm)
+        sendbuf = np.asarray(sendbuf)
+        rsize = comm.remote_size
+        sp = sendbuf.reshape(rsize, -1)      # one block per REMOTE rank
+        # the RECEIVED block shape comes from recvbuf (the MPI contract:
+        # recvcount describes the remote side's sends and may differ from
+        # ours per direction); symmetric fallback without one
+        if recvbuf is None:
+            recvbuf = np.empty((rsize,) + sp.shape[1:], sp.dtype)
+        rblk = np.asarray(recvbuf).reshape(rsize, -1).shape[1:]
+        # gather my side's matrix (local_size, rsize, sblk) onto the leader
+        mat = lc.coll.gather(lc, sp, root=0)
+        inbox = None
+        if lc.rank == 0:        # leader-only staging buffers
+            out = np.ascontiguousarray(np.swapaxes(np.asarray(mat), 0, 1))
+            # leaders swap transposed matrices; shapes differ when group
+            # sizes or per-direction counts differ — each side's inbox is
+            # sized from ITS recv contract, and the byte counts agree
+            # pairwise because my (rsize, lsize, sblk) send is exactly the
+            # remote's (lsize, rsize, rblk') recv
+            inbox = np.empty((lc.size, rsize) + rblk,
+                             np.asarray(recvbuf).dtype)
+            comm.sendrecv(out, 0, inbox, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        # row r of inbox (after local scatter) = blocks addressed to local
+        # rank r, ordered by remote rank
+        lc.coll.scatter(lc, inbox, recvbuf, root=0)
+        return recvbuf
+
     def allgather(self, comm, sendbuf, recvbuf=None):
         """Every rank receives the concatenation of the REMOTE group's
         buffers. When the two sides contribute different per-rank counts

@@ -296,3 +296,89 @@ def test_split_type_shared_and_idup():
         return True
 
     assert all(runtime.run_ranks(3, fn))
+
+
+def test_intercomm_rooted_and_alltoall_collectives():
+    """MPI-4 §6.8 rooted collectives + alltoall on an intercommunicator
+    (coll/inter.py round-2 additions)."""
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.comm import PROC_NULL, ROOT
+
+    def fn(ctx):
+        c = ctx.comm_world
+        # groups {0,1} and {2,3}; build the intercomm via split + leaders
+        side = 0 if c.rank < 2 else 1
+        local = c.split(color=side, key=c.rank)
+        inter = local.create_intercomm(
+            0, c, remote_leader=(0 if side else 2), tag=77)
+        lrank = local.rank
+        # rooted reduce: remote group's sums land on side-0 rank 0
+        send = np.full(4, float(c.rank + 1))
+        if side == 0 and lrank == 0:
+            out = inter.coll.reduce(inter, send, root=ROOT)
+            np.testing.assert_allclose(out, np.full(4, 3.0 + 4.0))
+        elif side == 0:
+            inter.coll.reduce(inter, send, root=PROC_NULL)
+        else:
+            inter.coll.reduce(inter, send, root=0)
+        # rooted gather at side-1 rank 1
+        if side == 1 and lrank == 1:
+            got = np.zeros((2, 2))
+            inter.coll.gather(inter, np.zeros(2), got, root=ROOT)
+            np.testing.assert_allclose(got, [[10, 10], [11, 11]])
+        elif side == 1:
+            inter.coll.gather(inter, np.zeros(2), root=PROC_NULL)
+        else:
+            inter.coll.gather(inter, np.full(2, 10.0 + lrank), root=1)
+        # rooted scatter from side-0 rank 1
+        if side == 0 and lrank == 1:
+            inter.coll.scatter(inter, np.arange(4.0), root=ROOT)
+        elif side == 0:
+            inter.coll.scatter(inter, root=PROC_NULL)
+        else:
+            r = np.zeros(2)
+            inter.coll.scatter(inter, recvbuf=r, root=1)
+            np.testing.assert_allclose(r, [2 * lrank, 2 * lrank + 1])
+        # alltoall: block i → remote rank i, both directions
+        sendm = np.array([[100.0 * c.rank + 0], [100.0 * c.rank + 1]])
+        recvm = np.zeros((2, 1))
+        inter.coll.alltoall(inter, sendm, recvm)
+        # my row j = remote rank j's block addressed to MY local rank
+        remote_base = 2 if side == 0 else 0
+        expect = np.array([[100.0 * (remote_base + j) + lrank]
+                           for j in range(2)])
+        np.testing.assert_allclose(recvm, expect)
+        inter.coll.barrier(inter)
+        return True
+
+    assert all(runtime.run_ranks(4, fn, timeout=90))
+
+
+def test_intercomm_alltoall_asymmetric_counts():
+    """Per-direction asymmetric counts: side 0 sends 1 element per remote
+    rank, side 1 sends 3 — each receiver's recvbuf describes the remote
+    side (MPI intercomm alltoall contract)."""
+    import numpy as np
+    from ompi_tpu import runtime
+
+    def fn(ctx):
+        c = ctx.comm_world
+        side = 0 if c.rank < 2 else 1
+        local = c.split(color=side, key=c.rank)
+        inter = local.create_intercomm(
+            0, c, remote_leader=(0 if side else 2), tag=31)
+        lrank = local.rank
+        sblk = 1 if side == 0 else 3
+        rblk = 3 if side == 0 else 1
+        send = np.stack([np.full(sblk, 10.0 * c.rank + j)
+                         for j in range(2)])
+        recv = np.zeros((2, rblk))
+        inter.coll.alltoall(inter, send, recv)
+        rb = 2 if side == 0 else 0
+        expect = np.stack([np.full(rblk, 10.0 * (rb + j) + lrank)
+                           for j in range(2)])
+        np.testing.assert_allclose(recv, expect)
+        return True
+
+    assert all(runtime.run_ranks(4, fn, timeout=90))
