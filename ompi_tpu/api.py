@@ -27,6 +27,10 @@ import numpy as np
 
 from .p2p.request import ANY_SOURCE, ANY_TAG
 
+# intercomm rooted-collective sentinels (≙ MPI_ROOT / MPI_PROC_NULL)
+_INTER_ROOT = -3
+_INTER_PROC_NULL = -2
+
 # MPI error classes (mpi.h values where they exist; identity is the name)
 ERR_COMM = "MPI_ERR_COMM"
 ERR_COUNT = "MPI_ERR_COUNT"
@@ -84,13 +88,23 @@ def _check_comm(comm):
     return comm
 
 
+def _peer_count(comm) -> int:
+    """How many peers an argument indexes: the REMOTE group on
+    intercommunicators (MPI's addressing for p2p and sendbuf layout)."""
+    return comm.remote_size if getattr(comm, "is_inter", False) \
+        else comm.size
+
+
 def _check_rank(comm, rank: int, what: str, wildcard: bool = False):
     if wildcard and rank == ANY_SOURCE:
         return rank
+    if what == "root" and getattr(comm, "is_inter", False) \
+            and rank in (_INTER_ROOT, _INTER_PROC_NULL):
+        return rank          # MPI_ROOT / MPI_PROC_NULL addressing
     if not isinstance(rank, (int, np.integer)) or not \
-            (0 <= int(rank) < comm.size):
+            (0 <= int(rank) < _peer_count(comm)):
         return _fail(comm, ERR_RANK if what != "root" else ERR_ROOT,
-                     f"{what}={rank!r} outside [0, {comm.size})")
+                     f"{what}={rank!r} outside [0, {_peer_count(comm)})")
     return int(rank)
 
 
@@ -127,10 +141,10 @@ def _check_counts_list(comm, counts, what: str):
     if counts is None:
         return _fail(comm, ERR_COUNT, f"{what} is required")
     counts = list(counts)
-    if len(counts) != comm.size:
+    if len(counts) != _peer_count(comm):
         return _fail(comm, ERR_COUNT,
-                     f"{what} has {len(counts)} entries for a "
-                     f"{comm.size}-rank communicator")
+                     f"{what} has {len(counts)} entries for "
+                     f"{_peer_count(comm)} addressed ranks")
     if any((not isinstance(c, (int, np.integer)) or c < 0) for c in counts):
         return _fail(comm, ERR_COUNT, f"{what} entries must be ≥ 0")
     return counts
@@ -252,7 +266,11 @@ def allreduce(comm, sendbuf, recvbuf=None, op=None):
 @_binding
 def gather(comm, sendbuf, recvbuf=None, root: int = 0):
     _check_comm(comm)
-    _check_buffer(comm, sendbuf, "sendbuf")
+    # the intercomm ROOT side receives only — its sendbuf is legitimately
+    # absent (MPI_ROOT addressing)
+    _check_buffer(comm, sendbuf, "sendbuf",
+                  allow_none=(root == _INTER_ROOT
+                              and getattr(comm, "is_inter", False)))
     root = _check_rank(comm, root, "root")
     return comm.coll.gather(comm, sendbuf, recvbuf, root=root)
 
@@ -285,10 +303,10 @@ def allgatherv(comm, sendbuf, recvbuf=None, counts=None, displs=None):
 def alltoall(comm, sendbuf, recvbuf=None):
     _check_comm(comm)
     n = np.size(_check_buffer(comm, sendbuf, "sendbuf"))
-    if n % comm.size != 0:
+    if n % _peer_count(comm) != 0:
         return _fail(comm, ERR_COUNT,
-                     f"sendbuf size {n} not divisible by comm size "
-                     f"{comm.size}")
+                     f"sendbuf size {n} not divisible by the "
+                     f"{_peer_count(comm)} addressed ranks")
     return comm.coll.alltoall(comm, sendbuf, recvbuf)
 
 
@@ -322,10 +340,10 @@ def reduce_scatter(comm, sendbuf, recvbuf, counts, op=None):
 def reduce_scatter_block(comm, sendbuf, recvbuf=None, op=None):
     _check_comm(comm)
     n = np.size(_check_buffer(comm, sendbuf, "sendbuf"))
-    if n % comm.size != 0:
+    if n % _peer_count(comm) != 0:
         return _fail(comm, ERR_COUNT,
-                     f"sendbuf size {n} not divisible by comm size "
-                     f"{comm.size}")
+                     f"sendbuf size {n} not divisible by the "
+                     f"{_peer_count(comm)} addressed ranks")
     op = _check_op(comm, op)
     return comm.coll.reduce_scatter_block(comm, sendbuf, recvbuf, op=op)
 

@@ -129,7 +129,8 @@ class InterColl:
             raise ValueError("intercomm scatter receivers need recvbuf")
         recvbuf = np.asarray(recvbuf)
         blocks = None
-        if lc.rank == 0:        # leader-only staging: don't allocate the
+        if lc.rank == 0:        # leader-only staging buffer (non-leaders
+            # never touch the full matrix, so never allocate it there)
             blocks = np.empty((lc.size,) + recvbuf.shape, recvbuf.dtype)
             comm.recv(blocks, root, TAG_INTER_COLL)
         lc.coll.scatter(lc, blocks, recvbuf, root=0)
@@ -192,3 +193,76 @@ class InterColl:
             np.copyto(np.asarray(recvbuf), out)
             return recvbuf
         return out
+
+    def allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
+                   displs=None):
+        """All-variant with per-REMOTE-rank counts: ``counts[i]`` is what
+        remote rank i contributes (MPI: the recv signature describes the
+        remote group). Gap regions of a displs-strided recvbuf are left
+        untouched, and strided recvbufs are written through ``.flat``."""
+        from ..comm import TAG_INTER_COLL
+        lc = self._lc(comm)
+        sendbuf = np.asarray(sendbuf).reshape(-1)
+        if counts is None:
+            raise ValueError("intercomm allgatherv needs counts "
+                             "(per REMOTE rank)")
+        counts = [int(v) for v in counts]
+        if displs is None:
+            displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]]))
+        # variable gather onto the leader; only the leader needs sizes
+        mysize = np.array([sendbuf.size], np.int64)
+        sizes_at_leader = lc.coll.gather(lc, mysize, root=0)
+        lsizes = None if sizes_at_leader is None else \
+            [int(v) for v in np.asarray(sizes_at_leader).reshape(-1)]
+        cat = lc.coll.gatherv(lc, sendbuf, counts=lsizes, root=0)
+        total_in = int(sum(counts))
+        inbox = np.empty(total_in, sendbuf.dtype)
+        if lc.rank == 0:
+            comm.sendrecv(np.ascontiguousarray(np.asarray(cat)), 0,
+                          inbox, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        inbox = np.asarray(lc.coll.bcast(lc, inbox, root=0))
+        span = max(int(d) + int(c) for d, c in zip(displs, counts))
+        if recvbuf is None:
+            recvbuf = np.empty(span, sendbuf.dtype)
+        out = np.asarray(recvbuf)
+        off = 0
+        for i, c_ in enumerate(counts):
+            # .flat slice-assignment works on strided buffers too and
+            # touches ONLY the count regions (displs gaps stay intact)
+            out.flat[int(displs[i]):int(displs[i]) + c_] = \
+                inbox[off:off + c_]
+            off += c_
+        return recvbuf
+
+    def reduce_scatter_block(self, comm, sendbuf, recvbuf=None,
+                             op: Op = None):
+        """Each side reduces the REMOTE group's contributions and scatters
+        the result across its own ranks in equal blocks (MPI-4 §6.8)."""
+        from ..comm import TAG_INTER_COLL
+        op = op or SUM
+        lc = self._lc(comm)
+        sendbuf = np.asarray(sendbuf)
+        # my sendbuf is sized for the REMOTE side's scatter; the incoming
+        # vector is sized for MINE — only recvbuf can define my block when
+        # the two groups differ in size
+        if recvbuf is None and comm.remote_size != lc.size:
+            raise ValueError(
+                "intercomm reduce_scatter_block with asymmetric group "
+                "sizes needs recvbuf (the incoming block size is not "
+                "derivable from sendbuf)")
+        blk = (np.asarray(recvbuf).reshape(-1).size if recvbuf is not None
+               else sendbuf.reshape(-1).size // lc.size)
+        if recvbuf is None:
+            recvbuf = np.empty(blk, sendbuf.dtype)
+        red = lc.coll.reduce(lc, sendbuf, op=op, root=0)
+        remote_red = None
+        if lc.rank == 0:
+            remote_red = np.empty(lc.size * blk,
+                                  np.asarray(recvbuf).dtype)
+            comm.sendrecv(np.ascontiguousarray(np.asarray(red)), 0,
+                          remote_red, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+            remote_red = remote_red.reshape(lc.size, -1)
+        lc.coll.scatter(lc, remote_red, recvbuf, root=0)
+        return recvbuf

@@ -82,3 +82,41 @@ def test_errhandler_swallows():
         return True
 
     assert all(runtime.run_ranks(2, fn))
+
+
+def test_api_intercomm_awareness():
+    """The validation facade must accept intercomm addressing: ROOT /
+    PROC_NULL sentinels, per-REMOTE-rank counts, and remote-size-based
+    divisibility (review findings on the §6.8 additions)."""
+    import numpy as np
+    from ompi_tpu import api, runtime
+    from ompi_tpu.comm import PROC_NULL, ROOT
+
+    def fn(ctx):
+        c = ctx.comm_world
+        side = 0 if c.rank < 2 else 1
+        local = c.split(color=side, key=c.rank)
+        inter = local.create_intercomm(
+            0, c, remote_leader=(0 if side else 2), tag=51)
+        send = np.full(2, float(c.rank + 1))
+        if side == 0 and local.rank == 0:
+            out = api.reduce(inter, send, np.zeros(2), root=ROOT)
+            np.testing.assert_allclose(out, np.full(2, 7.0))
+        elif side == 0:
+            api.reduce(inter, send, root=PROC_NULL)
+        else:
+            api.reduce(inter, send, root=0)
+        # gather at ROOT with sendbuf=None must validate
+        if side == 1 and local.rank == 0:
+            got = np.zeros((2, 2))
+            api.gather(inter, None, got, root=ROOT)
+        elif side == 1:
+            api.gather(inter, np.zeros(1), root=PROC_NULL)
+        else:
+            api.gather(inter, np.full(2, 5.0 + local.rank), root=0)
+        # alltoall sized per REMOTE rank passes validation
+        out = api.alltoall(inter, np.arange(float(2 * inter.remote_size)))
+        assert out is not None
+        return True
+
+    assert all(runtime.run_ranks(4, fn, timeout=90))

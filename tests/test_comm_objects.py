@@ -382,3 +382,36 @@ def test_intercomm_alltoall_asymmetric_counts():
         return True
 
     assert all(runtime.run_ranks(4, fn, timeout=90))
+
+
+def test_intercomm_allgatherv_and_reduce_scatter_block():
+    import numpy as np
+    from ompi_tpu import runtime
+
+    def fn(ctx):
+        c = ctx.comm_world
+        side = 0 if c.rank < 2 else 1
+        local = c.split(color=side, key=c.rank)
+        inter = local.create_intercomm(
+            0, c, remote_leader=(0 if side else 2), tag=41)
+        lrank = local.rank
+        # allgatherv: remote rank i contributes i+1 elements of value
+        # 100*world_rank
+        mine = np.full(lrank + 1, 100.0 * c.rank)
+        counts = [1, 2]                      # remote lranks contribute 1,2
+        out = np.asarray(inter.coll.allgatherv(
+            inter, mine, counts=counts))
+        rb = 2 if side == 0 else 0
+        expect = np.concatenate([np.full(j + 1, 100.0 * (rb + j))
+                                 for j in range(2)])
+        np.testing.assert_allclose(out[:3], expect)
+        # reduce_scatter_block: remote group's sums scattered over my side
+        send = np.arange(2 * 4, dtype=np.float64) * (c.rank + 1)
+        r = np.zeros(4)
+        inter.coll.reduce_scatter_block(inter, send, r)
+        remote_mult = (3 + 4) if side == 0 else (1 + 2)
+        full = np.arange(8, dtype=np.float64) * remote_mult
+        np.testing.assert_allclose(r, full[lrank * 4:(lrank + 1) * 4])
+        return True
+
+    assert all(runtime.run_ranks(4, fn, timeout=90))
