@@ -340,6 +340,34 @@ class File:
         self._pos += (n * np.asarray(buf).itemsize) // self.etype.size
         return n
 
+    # -- nonblocking collective IO (MPI_File_iread_at_all family) -----------
+    # Executed eagerly on the calling thread, returning a completed request
+    # — legal (nonblocking calls may complete immediately) and the same
+    # stance as the coll framework's derived i* wrappers: the collective
+    # exchange must run on the owner thread (FUNNELED), so true background
+    # progression would need the async progress thread to own collectives,
+    # which MPI's threading rules don't require of this level.
+
+    def iread_at_all(self, offset: int, buf, count: Optional[int] = None):
+        from ..p2p.request import CompletedRequest
+        n = self.read_at_all(offset, buf, count)
+        return CompletedRequest(count=n, result=n)
+
+    def iwrite_at_all(self, offset: int, buf, count: Optional[int] = None):
+        from ..p2p.request import CompletedRequest
+        n = self.write_at_all(offset, buf, count)
+        return CompletedRequest(count=n, result=n)
+
+    def iread_all(self, buf, count: Optional[int] = None):
+        from ..p2p.request import CompletedRequest
+        n = self.read_all(buf, count)
+        return CompletedRequest(count=n, result=n)
+
+    def iwrite_all(self, buf, count: Optional[int] = None):
+        from ..p2p.request import CompletedRequest
+        n = self.write_all(buf, count)
+        return CompletedRequest(count=n, result=n)
+
     # -- split collectives (MPI_File_*_all_begin / _all_end) ----------------
     # MPI permits an implementation to perform the whole operation in _end
     # (MPI-4 §14.4.5); begin records the request, end runs the two-phase

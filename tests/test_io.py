@@ -386,3 +386,28 @@ def test_sharedfp_lockedfile():
     finally:
         _select("sharedfp", "")
         os.unlink(path)
+
+
+def test_nonblocking_collective_io():
+    """iread_at_all/iwrite_at_all (eager completed-request form — legal
+    MPI nonblocking semantics, same stance as the coll i* wrappers)."""
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+        data = np.arange(16, dtype=np.int64) + 100 * comm.rank
+        req = f.iwrite_at_all(comm.rank * data.nbytes, data)
+        assert req.wait().count == 16 and req.result == 16
+        got = np.zeros(16, np.int64)
+        req = f.iread_at_all(((comm.rank + 1) % comm.size) * got.nbytes, got)
+        req.wait()
+        np.testing.assert_array_equal(
+            got, np.arange(16) + 100 * ((comm.rank + 1) % comm.size))
+        f.close()
+        return True
+
+    try:
+        assert all(run(3, body))
+    finally:
+        os.unlink(path)
