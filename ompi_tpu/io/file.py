@@ -348,25 +348,36 @@ class File:
     # progression would need the async progress thread to own collectives,
     # which MPI's threading rules don't require of this level.
 
+    def _eager_coll(self, fn) -> "object":
+        """Run the collective now; deliver outcome (value OR error) through
+        the returned request — the same error discipline as _io_async, so
+        every File i* entry point surfaces failures on wait()."""
+        from ..p2p.request import Request
+        req = Request()
+        try:
+            n = fn()
+        except Exception as exc:
+            req.result = None
+            req.status.count = 0
+            req.complete(exc)
+        else:
+            req.result = n
+            req.status.count = int(n)
+            req.complete()
+        return req
+
     def iread_at_all(self, offset: int, buf, count: Optional[int] = None):
-        from ..p2p.request import CompletedRequest
-        n = self.read_at_all(offset, buf, count)
-        return CompletedRequest(count=n, result=n)
+        return self._eager_coll(lambda: self.read_at_all(offset, buf, count))
 
     def iwrite_at_all(self, offset: int, buf, count: Optional[int] = None):
-        from ..p2p.request import CompletedRequest
-        n = self.write_at_all(offset, buf, count)
-        return CompletedRequest(count=n, result=n)
+        return self._eager_coll(lambda: self.write_at_all(offset, buf,
+                                                          count))
 
     def iread_all(self, buf, count: Optional[int] = None):
-        from ..p2p.request import CompletedRequest
-        n = self.read_all(buf, count)
-        return CompletedRequest(count=n, result=n)
+        return self._eager_coll(lambda: self.read_all(buf, count))
 
     def iwrite_all(self, buf, count: Optional[int] = None):
-        from ..p2p.request import CompletedRequest
-        n = self.write_all(buf, count)
-        return CompletedRequest(count=n, result=n)
+        return self._eager_coll(lambda: self.write_all(buf, count))
 
     # -- split collectives (MPI_File_*_all_begin / _all_end) ----------------
     # MPI permits an implementation to perform the whole operation in _end

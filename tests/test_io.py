@@ -404,6 +404,17 @@ def test_nonblocking_collective_io():
         req.wait()
         np.testing.assert_array_equal(
             got, np.arange(16) + 100 * ((comm.rank + 1) % comm.size))
+        # pointer-based variants: _pos advances exactly once per call
+        f.seek(comm.rank * 16 * 8)
+        pos0 = f.tell()
+        req = f.iread_all(got)
+        req.wait()
+        assert f.tell() == pos0 + 16 * 8
+        np.testing.assert_array_equal(got, np.arange(16) + 100 * comm.rank)
+        f.seek(comm.rank * 16 * 8)
+        req = f.iwrite_all(got + 1)
+        req.wait()
+        assert f.tell() == pos0 + 16 * 8
         f.close()
         return True
 
