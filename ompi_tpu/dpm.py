@@ -42,9 +42,12 @@ _PORT_CID_BASE = 1 << 45         # intercomm cids for connect/accept
 
 
 def spawn(comm: Communicator, command: Sequence[str], maxprocs: int,
-          root: int = 0, env_extra: Optional[dict] = None) -> Communicator:
+          root: int = 0, env_extra: Optional[dict] = None,
+          info=None) -> Communicator:
     """MPI_Comm_spawn: collective over ``comm``; returns the parent side of
-    the parent↔children intercommunicator."""
+    the parent↔children intercommunicator. Honored MPI_Info hints: ``wdir``
+    (children's working directory), ``path`` (prepended to the child's
+    PATH); others are advisory."""
     ctx = comm.ctx
     if comm.rank == root:
         base, gid = ctx.bootstrap.grow(maxprocs)
@@ -89,7 +92,11 @@ def spawn(comm: Communicator, command: Sequence[str], maxprocs: int,
                         comm.group.world_of_rank(root)),
                     "OMPI_TPU_PARENT_CID": str(_SPAWN_CID_BASE | gid),
                 })
-                subprocess.Popen(cmd, env=env)
+                wdir = info.get("wdir") if info is not None else None
+                if info is not None and info.get("path"):
+                    env["PATH"] = (info.get("path") + os.pathsep
+                                   + env.get("PATH", ""))
+                subprocess.Popen(cmd, env=env, cwd=wdir)
             # children's ring-ready keys appear once their shm rx rings
             # exist; waiting here closes the add_peers/first-send race
             # (only the shm transport publishes them)

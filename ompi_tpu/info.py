@@ -37,6 +37,9 @@ class Info:
     def nkeys(self) -> int:
         return len(self._d)
 
+    def items(self):
+        return self._d.items()
+
     def keys(self) -> Iterator[str]:
         return iter(self._d)
 

@@ -127,7 +127,16 @@ class _TwoPhaseFcoll:
     operations (≙ fcoll/vulcan + common_ompio_aggregators.c)."""
 
     def _aggregators(self, f) -> List[int]:
-        n = int(_var.get("io_ompio_num_aggregators", 0))
+        # per-file hint beats the global var (MPI info plumbing:
+        # num_aggregators, with ROMIO's cb_nodes accepted as an alias).
+        # Hints are ADVISORY: an unparseable value falls back silently,
+        # like the reference ignoring invalid hints (MPI-4 §10)
+        hint = f.info.get("num_aggregators") or f.info.get("cb_nodes")
+        try:
+            n = int(hint) if hint else int(
+                _var.get("io_ompio_num_aggregators", 0))
+        except (TypeError, ValueError):
+            n = int(_var.get("io_ompio_num_aggregators", 0))
         if n <= 0:
             n = min(f.comm.size, 4)
         return list(range(min(n, f.comm.size)))

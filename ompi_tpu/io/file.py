@@ -21,6 +21,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..datatype import BYTE, Convertor, Datatype
+from ..info import Info
 from ..core.component import frameworks
 from . import components as _components  # noqa: F401 — registers fs/fbtl/...
 
@@ -40,10 +41,12 @@ _atomic_mutex = _components.path_mutex
 class File:
     """One communicator-wide file handle (MPI_File)."""
 
-    def __init__(self, comm, path: str, amode: int, fd: int) -> None:
+    def __init__(self, comm, path: str, amode: int, fd: int,
+                 info=None) -> None:
         self.comm = comm
         self.path = path
         self.amode = amode
+        self.info = info if info is not None else Info()
         self._fd = fd
         self._lock = threading.Lock()
         self._pos = 0                   # individual pointer, in etypes
@@ -63,8 +66,11 @@ class File:
     # -- open/close ---------------------------------------------------------
 
     @classmethod
-    def open(cls, comm, path: str, amode: int = MODE_RDONLY) -> "File":
-        """Collective open (MPI_File_open)."""
+    def open(cls, comm, path: str, amode: int = MODE_RDONLY,
+             info=None) -> "File":
+        """Collective open (MPI_File_open). Honored hints (MPI-4 §14.2.8
+        style, advisory otherwise): ``num_aggregators`` / ``cb_nodes``
+        override the two-phase aggregator count for THIS file."""
         flags = 0
         if amode & MODE_RDWR:
             flags |= os.O_RDWR
@@ -74,7 +80,7 @@ class File:
             flags |= os.O_RDONLY
         if amode & MODE_APPEND:
             flags |= os.O_APPEND
-        f = cls(comm, path, amode, -1)
+        f = cls(comm, path, amode, -1, info=info)
         err = None
         if comm.rank == 0:
             try:
@@ -504,6 +510,15 @@ class File:
             else:
                 sfp.write_value(self.size() // self.etype.size + offset)
         self.comm.barrier()
+
+    def set_info(self, info) -> None:
+        """MPI_File_set_info: merge new hints (advisory)."""
+        for k, v in info.items():
+            self.info.set(k, v)
+
+    def get_info(self):
+        """MPI_File_get_info: the hints in use."""
+        return self.info.dup()
 
     def set_atomicity(self, flag: bool) -> None:
         self.atomicity = bool(flag)

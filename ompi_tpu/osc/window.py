@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..info import Info
 from ..op import NO_OP, REPLACE, SUM, Op
 from ..p2p import transport as T
 from ..p2p.request import Request
@@ -129,8 +130,9 @@ class Window:
     communicator (≙ MPI_Win; ompi/win/win.h).  Created collectively."""
 
     def __init__(self, comm, local: Optional[np.ndarray],
-                 name: str = "win") -> None:
+                 name: str = "win", info=None) -> None:
         self.comm = comm
+        self.info = info if info is not None else Info()   # advisory hints
         self.local = local if local is not None else np.zeros(0, np.uint8)
         if not self.local.flags["C_CONTIGUOUS"]:
             raise ValueError("window buffer must be C-contiguous")
@@ -486,21 +488,33 @@ class Window:
         for r in range(self.comm.size):
             self.unlock(r)
 
+    def set_info(self, info) -> None:
+        """MPI_Win_set_info: merge hints (all advisory on this design —
+        AM-serviced windows have no no_locks/ordering fast paths to pick)."""
+        for k, v in info.items():
+            self.info.set(k, v)
+
+    def get_info(self) -> Info:
+        """MPI_Win_get_info: the hints in use."""
+        return self.info.dup()
+
     def free(self) -> None:
         self.comm.barrier()
         self.eng.windows.pop(self.win_id, None)
 
 
 def win_allocate(comm, count: int, dtype=np.float64,
-                 name: str = "win") -> Window:
+                 name: str = "win", info=None) -> Window:
     """MPI_Win_allocate: the window owns its buffer (``win.local``)."""
-    return Window(comm, np.zeros(count, dtype=np.dtype(dtype)), name=name)
+    return Window(comm, np.zeros(count, dtype=np.dtype(dtype)), name=name,
+                  info=info)
 
 
-def win_create(comm, buffer: np.ndarray, name: str = "win") -> Window:
+def win_create(comm, buffer: np.ndarray, name: str = "win",
+               info=None) -> Window:
     """MPI_Win_create: expose a USER-owned buffer — remote operations land
     directly in the caller's array (no copy; must be C-contiguous)."""
-    return Window(comm, buffer, name=name)
+    return Window(comm, buffer, name=name, info=info)
 
 
 class DynamicWindow(Window):

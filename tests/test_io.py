@@ -422,3 +422,32 @@ def test_nonblocking_collective_io():
         assert all(run(3, body))
     finally:
         os.unlink(path)
+
+
+def test_file_info_hints():
+    """MPI_Info plumbing: num_aggregators hint overrides the global var
+    for THIS file; get_info/set_info round-trip (MPI-4 §14.2.8)."""
+    from ompi_tpu.info import Info
+    path = _tmppath()
+
+    def body(ctx):
+        comm = ctx.comm_world
+        f = File.open(comm, path, MODE_RDWR | MODE_CREATE,
+                      info=Info({"num_aggregators": "1",
+                                 "access_style": "write_once"}))
+        assert f._fcoll._aggregators(f) == [0]
+        assert f.get_info().get("access_style") == "write_once"
+        f.set_info(Info({"num_aggregators": "2"}))
+        assert f._fcoll._aggregators(f) == [0, 1]
+        data = np.arange(8, dtype=np.int64) + comm.rank
+        f.write_at_all(comm.rank * data.nbytes, data)
+        got = np.zeros(8, np.int64)
+        f.read_at_all(comm.rank * got.nbytes, got)
+        np.testing.assert_array_equal(got, data)
+        f.close()
+        return True
+
+    try:
+        assert all(run(3, body))
+    finally:
+        os.unlink(path)

@@ -313,3 +313,22 @@ def test_win_allocate_shared_direct_loads():
         return True
 
     assert all(runtime.run_ranks(3, fn))
+
+
+def test_window_info_accessors():
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.info import Info
+    from ompi_tpu.osc import win_allocate
+
+    def fn(ctx):
+        c = ctx.comm_world
+        win = win_allocate(c, 2, np.float64,
+                           info=Info({"no_locks": "true"}))
+        assert win.get_info().get("no_locks") == "true"
+        win.set_info(Info({"accumulate_ordering": "none"}))
+        assert win.get_info().get("accumulate_ordering") == "none"
+        win.free()
+        return True
+
+    assert all(runtime.run_ranks(2, fn))
