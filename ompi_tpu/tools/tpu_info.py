@@ -20,9 +20,14 @@ def main(argv=None) -> int:
                     help="include devices and the transport/coll inventory")
     args = ap.parse_args(argv)
 
+    # import every component-bearing module so the registry is COMPLETE
+    # (≙ ompi_info opening all frameworks before dumping)
     import ompi_tpu  # noqa: F401  (register core)
-    import ompi_tpu.coll  # noqa: F401  (register coll components)
+    import ompi_tpu.coll  # noqa: F401  (coll components)
+    import ompi_tpu.hook  # noqa: F401  (hook framework)
+    import ompi_tpu.io  # noqa: F401  (io + fs/fbtl/fcoll/sharedfp)
     import ompi_tpu.p2p.selftrans  # noqa: F401
+    import ompi_tpu.p2p.shm  # noqa: F401
     import ompi_tpu.p2p.tcp  # noqa: F401
     from ompi_tpu import mpit
     from ompi_tpu.core import var as _var
