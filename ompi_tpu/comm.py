@@ -561,6 +561,12 @@ class Communicator:
         from .p2p.request import CompletedRequest
         return CompletedRequest(result=self.dup(name))
 
+    def abort(self, code: int = 1, msg: str = "") -> None:
+        """MPI_Abort: tear the whole job down (the comm argument is
+        advisory in practice in the reference too — mpirun kills the job).
+        Routed through the control plane so every rank learns."""
+        self.ctx.abort(code, msg or f"MPI_Abort on {self.name}")
+
     def barrier(self) -> None:
         self.coll.barrier(self)
 

@@ -26,6 +26,10 @@ class BootstrapError(RuntimeError):
 
 
 class Bootstrap:
+    # True when one OS process hosts exactly this rank (tpurun children):
+    # MPI_Abort may then terminate the process. False for in-process
+    # (threaded) ranks, where killing the process would take out peers.
+    process_scoped = False
     """Abstract control plane for one rank."""
 
     rank: int

@@ -298,12 +298,13 @@ def main(argv: List[str] | None = None) -> int:
         term_at = None          # when SIGTERM went out (escalate to KILL)
         abort_check_at = time.monotonic()
         while remaining:
-            # cross-launcher abort watch (multi-host): another host's rank
-            # failed → kill our local ranks too, like mpirun taking the
-            # whole job down. Head checks its coordinator object; workers
-            # poll over a persistent connection every ~0.5 s.
-            if args.num_hosts > 1 and not args.enable_recovery \
-                    and term_at is None \
+            # abort watch: MPI_Abort or another host's rank failure →
+            # kill our local ranks too, like mpirun taking the whole job
+            # down. The head (or single-host launcher) checks its
+            # coordinator object; workers poll over a persistent
+            # connection every ~0.5 s.
+            if not args.enable_recovery and term_at is None \
+                    and (coord is not None or poller is not None) \
                     and time.monotonic() - abort_check_at > 0.5:
                 abort_check_at = time.monotonic()
                 ab = (coord.aborted if coord is not None

@@ -193,6 +193,7 @@ class Coordinator:
 
 
 class TcpBootstrap(Bootstrap):
+    process_scoped = True
     """Rank-side client: one persistent connection, RPCs serialized under a
     lock (rank-side callers are single-threaded; subsystems needing async
     notification — e.g. the failure detector — open their own TcpBootstrap)."""

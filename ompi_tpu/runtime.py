@@ -199,7 +199,20 @@ class Context:
         self.bootstrap.finalize()
 
     def abort(self, code: int = 1, msg: str = "") -> None:
-        self.bootstrap.abort(code, msg)
+        """MPI_Abort semantics: notify the control plane (so the launcher
+        and fence/get-blocked peers learn), then — when this process hosts
+        exactly this rank — terminate it (MPI_Abort does not return,
+        ompi/mpi/c/abort.c). Threaded in-process ranks (run_ranks) only
+        notify: killing the host process would take out peer ranks and the
+        harness; their LocalBootstrap wakes peers instead."""
+        try:
+            self.bootstrap.abort(code, msg)
+        finally:
+            if getattr(self.bootstrap, "process_scoped", False):
+                import os as _os
+                # exit statuses are 8-bit: clamp so an abort can never
+                # report success (e.g. code 256 -> status 0)
+                _os._exit((int(code) & 0xFF) or 1)
 
     # -- control-plane events (the canonical poll point) ---------------------
 

@@ -292,3 +292,38 @@ def test_mpisync_clock_offsets():
         assert np.isfinite(t).all()
         assert np.abs(t).max() < 0.5          # same host, same clock
     np.testing.assert_array_equal(np.asarray(res[0]), np.asarray(res[1]))
+
+
+def test_comm_abort_tears_job_down():
+    """MPI_Abort via the communicator: every rank exits promptly, the
+    launcher reports the abort code (≙ ompi/mpi/c/abort.c → RTE abort)."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    prog = tempfile.NamedTemporaryFile("w", suffix=".py", delete=False)
+    prog.write("""
+import numpy as np
+from ompi_tpu import runtime
+ctx = runtime.init()
+c = ctx.comm_world
+if ctx.rank == 1:
+    c.abort(7, "test abort")
+# every other rank would block forever without abort propagation
+buf = np.zeros(1)
+c.recv(buf, src=(ctx.rank + 1) % c.size, tag=99)
+""")
+    prog.close()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "3",
+             "--timeout", "60", prog.name],
+            env=env, capture_output=True, text=True, timeout=90)
+        assert r.returncode not in (0, 124), (r.returncode, r.stdout,
+                                              r.stderr)
+    finally:
+        os.unlink(prog.name)
