@@ -130,3 +130,56 @@ def wait_any(requests: List[Request], timeout: Optional[float] = None) -> int:
             err, r.pending_error = r.pending_error, None
             raise err
     raise TimeoutError("waitany: no request completed")
+
+
+class GeneralizedRequest(Request):
+    """MPI_Grequest_start/complete (MPI-4 §3.9): user-level operations that
+    complete through the MPI request machinery. The user marks completion
+    with ``grequest_complete()``; wait/test then invoke ``query_fn(status)``
+    to fill the status (exactly-once per completion, like the standard),
+    ``free_fn`` runs when the request is collected, and ``cancel_fn(
+    completed)`` serves cancellation requests."""
+
+    __slots__ = ("_query_fn", "_free_fn", "_cancel_fn", "_queried")
+
+    def __init__(self, query_fn=None, free_fn=None, cancel_fn=None) -> None:
+        super().__init__()
+        self._query_fn = query_fn
+        self._free_fn = free_fn
+        self._cancel_fn = cancel_fn
+        self._queried = False
+
+    def grequest_complete(self) -> None:
+        """The user's operation finished (MPI_Grequest_complete)."""
+        self.complete()
+
+    def cancel(self) -> None:
+        if self._cancel_fn is not None:
+            self._cancel_fn(self.done)
+        self.status.cancelled = not self.done
+
+    def wait(self, timeout=None) -> Status:
+        st = super().wait(timeout=timeout)
+        if not self._queried:
+            self._queried = True
+            if self._query_fn is not None:
+                self._query_fn(self.status)
+            if self._free_fn is not None:
+                self._free_fn()
+        return st
+
+    def test(self) -> bool:
+        done = super().test()
+        if done and not self._queried:
+            self._queried = True
+            if self._query_fn is not None:
+                self._query_fn(self.status)
+            if self._free_fn is not None:
+                self._free_fn()
+        return done
+
+
+def grequest_start(query_fn=None, free_fn=None,
+                   cancel_fn=None) -> GeneralizedRequest:
+    """MPI_Grequest_start."""
+    return GeneralizedRequest(query_fn, free_fn, cancel_fn)

@@ -449,3 +449,42 @@ def test_persistent_misuse_raises():
         return True
 
     assert all(runtime.run_ranks(2, fn))
+
+
+def test_generalized_requests():
+    """MPI_Grequest_start/complete: user operations driven through the
+    request machinery (wait blocks in the progress loop until the user's
+    thread completes it; query fills the status exactly once)."""
+    import threading
+    import time
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.p2p.request import grequest_start
+
+    def fn(ctx):
+        calls = {"query": 0, "free": 0}
+
+        def query(status):
+            calls["query"] += 1
+            status.count = 42
+
+        def free():
+            calls["free"] += 1
+
+        req = grequest_start(query_fn=query, free_fn=free)
+        assert not req.test() or calls  # not complete yet
+
+        def worker():
+            time.sleep(0.05)
+            req.grequest_complete()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        st = req.wait(timeout=10)
+        t.join()
+        assert st.count == 42
+        req.wait()                     # inactive wait: no double query/free
+        assert calls == {"query": 1, "free": 1}
+        return True
+
+    assert all(runtime.run_ranks(1, fn))
