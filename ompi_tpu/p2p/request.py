@@ -135,10 +135,12 @@ def wait_any(requests: List[Request], timeout: Optional[float] = None) -> int:
 class GeneralizedRequest(Request):
     """MPI_Grequest_start/complete (MPI-4 §3.9): user-level operations that
     complete through the MPI request machinery. The user marks completion
-    with ``grequest_complete()``; wait/test then invoke ``query_fn(status)``
-    to fill the status (exactly-once per completion, like the standard),
-    ``free_fn`` runs when the request is collected, and ``cancel_fn(
-    completed)`` serves cancellation requests."""
+    with ``grequest_complete()``; the query callback then fills the status
+    (exactly once — hooked at the completion layer so EVERY wait flavor,
+    wait/test/wait_all/wait_any, observes it) and the free callback
+    releases the user's resources. Cancellation routes to the user's
+    cancel function; per MPI, whether a cancel succeeded is reported by
+    the USER's query_fn setting ``status.cancelled``."""
 
     __slots__ = ("_query_fn", "_free_fn", "_cancel_fn", "_queried")
 
@@ -148,6 +150,16 @@ class GeneralizedRequest(Request):
         self._free_fn = free_fn
         self._cancel_fn = cancel_fn
         self._queried = False
+        self.add_completion_callback(self._grequest_collect)
+
+    def _grequest_collect(self, _req) -> None:
+        if self._queried:
+            return
+        self._queried = True
+        if self._query_fn is not None:
+            self._query_fn(self.status)
+        if self._free_fn is not None:
+            self._free_fn()
 
     def grequest_complete(self) -> None:
         """The user's operation finished (MPI_Grequest_complete)."""
@@ -156,27 +168,6 @@ class GeneralizedRequest(Request):
     def cancel(self) -> None:
         if self._cancel_fn is not None:
             self._cancel_fn(self.done)
-        self.status.cancelled = not self.done
-
-    def wait(self, timeout=None) -> Status:
-        st = super().wait(timeout=timeout)
-        if not self._queried:
-            self._queried = True
-            if self._query_fn is not None:
-                self._query_fn(self.status)
-            if self._free_fn is not None:
-                self._free_fn()
-        return st
-
-    def test(self) -> bool:
-        done = super().test()
-        if done and not self._queried:
-            self._queried = True
-            if self._query_fn is not None:
-                self._query_fn(self.status)
-            if self._free_fn is not None:
-                self._free_fn()
-        return done
 
 
 def grequest_start(query_fn=None, free_fn=None,

@@ -472,7 +472,8 @@ def test_generalized_requests():
             calls["free"] += 1
 
         req = grequest_start(query_fn=query, free_fn=free)
-        assert not req.test() or calls  # not complete yet
+        assert not req.test()
+        assert calls == {"query": 0, "free": 0}
 
         def worker():
             time.sleep(0.05)
@@ -485,6 +486,20 @@ def test_generalized_requests():
         assert st.count == 42
         req.wait()                     # inactive wait: no double query/free
         assert calls == {"query": 1, "free": 1}
+
+        # wait_all must observe query/free too (completion-layer hook)
+        from ompi_tpu.p2p.request import wait_all
+        calls2 = {"query": 0}
+        req2 = grequest_start(
+            query_fn=lambda st_: (calls2.__setitem__("query",
+                                                     calls2["query"] + 1),
+                                  setattr(st_, "count", 7)))
+        t2 = threading.Thread(target=lambda: (time.sleep(0.05),
+                                              req2.grequest_complete()))
+        t2.start()
+        sts = wait_all([req2], timeout=10)
+        t2.join()
+        assert sts[0].count == 7 and calls2["query"] == 1
         return True
 
     assert all(runtime.run_ranks(1, fn))
