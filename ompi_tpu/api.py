@@ -21,7 +21,7 @@ stack can actually detect).
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

@@ -52,7 +52,6 @@ Non-commutative ops fall back to the in-order linear algorithms
 
 from __future__ import annotations
 
-import math
 import os
 from typing import Optional, Sequence
 

@@ -17,8 +17,6 @@ MCA priority over coll/tuned for device buffers.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import numpy as np
 
 from ..core.component import Component, component

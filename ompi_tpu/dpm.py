@@ -31,7 +31,7 @@ import os
 import subprocess
 import sys
 import time
-from typing import List, Optional, Sequence
+from typing import Optional, Sequence
 
 import numpy as np
 

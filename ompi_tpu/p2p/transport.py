@@ -18,7 +18,7 @@ Transports in-tree: ``self`` (loopback), ``tcp`` (DCN analog), ``shm``
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 from ..core import var as _var
 from ..core.component import Component
