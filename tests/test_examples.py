@@ -1,0 +1,50 @@
+"""Examples as acceptance tests, run under tpurun in subprocesses — the
+reference's stance exactly (SURVEY.md §4: 'examples as acceptance
+tests'; examples/ring_c.c is the PR1 workload)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tpurun_example(name, np_=4, extra=(), timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = ""
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", str(np_),
+         "--timeout", str(timeout - 20), *extra,
+         os.path.join(REPO, "examples", name)],
+        env=env, capture_output=True, text=True, timeout=timeout,
+        cwd="/tmp")
+    assert r.returncode == 0, (name, r.stdout[-2000:], r.stderr[-2000:])
+    return r.stdout
+
+
+def test_example_ring():
+    out = _tpurun_example("ring.py")
+    assert "done: 10 laps" in out
+
+
+def test_example_connectivity():
+    out = _tpurun_example("connectivity.py")
+    assert "Connectivity test on 4 processes PASSED" in out
+
+
+def test_example_hello_and_spc():
+    assert "Hello, world" in _tpurun_example("hello.py", np_=2)
+    assert "sends" in _tpurun_example("spc_counters.py", np_=2)
+
+
+def test_example_oshmem():
+    out = _tpurun_example("oshmem_hello.py")
+    assert "symmetric put/verify on 4 PEs PASSED" in out
+
+
+def test_example_device_allreduce():
+    out = _tpurun_example("device_allreduce.py", np_=2,
+                          extra=("--device-plane", "cpu"))
+    assert "coll/xla path ok" in out
