@@ -232,16 +232,30 @@ def barrier(comm):
 @_binding
 def bcast(comm, buf, root: int = 0):
     _check_comm(comm)
-    _check_buffer(comm, buf)
     root = _check_rank(comm, root, "root")
+    # MPI-4 §6.8: on an intercomm, PROC_NULL members' buffers are not
+    # significant, and on the ROOT side buf is the payload source (always
+    # required); non-participants may legally pass None
+    _check_buffer(comm, buf,
+                  allow_none=(root == _INTER_PROC_NULL
+                              and getattr(comm, "is_inter", False)))
     return comm.coll.bcast(comm, buf, root=root)
 
 
 @_binding
 def reduce(comm, sendbuf, recvbuf=None, op=None, root: int = 0):
     _check_comm(comm)
-    _check_buffer(comm, sendbuf, "sendbuf")
     root = _check_rank(comm, root, "root")
+    # intercomm ROOT side receives only; PROC_NULL members pass nothing
+    # (same carve-out as gather — MPI-4 §6.8 buffer significance). On the
+    # ROOT side recvbuf becomes the significant buffer (it is also the
+    # shape template when sendbuf is absent — InterColl.reduce contract).
+    is_inter = getattr(comm, "is_inter", False)
+    _check_buffer(comm, sendbuf, "sendbuf",
+                  allow_none=(root in (_INTER_ROOT, _INTER_PROC_NULL)
+                              and is_inter))
+    if is_inter and root == _INTER_ROOT and sendbuf is None:
+        _check_buffer(comm, recvbuf, "recvbuf")
     op = _check_op(comm, op)
     return comm.coll.reduce(comm, sendbuf, recvbuf, op=op, root=root)
 
@@ -279,7 +293,15 @@ def gather(comm, sendbuf, recvbuf=None, root: int = 0):
 def scatter(comm, sendbuf, recvbuf=None, root: int = 0):
     _check_comm(comm)
     root = _check_rank(comm, root, "root")
-    if comm.rank == root:
+    # `comm.rank == root` is only meaningful on an intracomm: on an
+    # intercomm `root` indexes the REMOTE group, so a local rank that
+    # happens to equal it is still a receiver and legitimately passes
+    # sendbuf=None — there only the root == _INTER_ROOT caller sends,
+    # and it must bring a sendbuf
+    if getattr(comm, "is_inter", False):
+        if root == _INTER_ROOT:
+            _check_buffer(comm, sendbuf, "sendbuf")
+    elif comm.rank == root:
         _check_buffer(comm, sendbuf, "sendbuf")
     return comm.coll.scatter(comm, sendbuf, recvbuf, root=root)
 

@@ -940,7 +940,15 @@ def scan_recursive_doubling(comm, send: np.ndarray, recv: np.ndarray,
 # neighbor-exchange allgather[v] variants)
 # ---------------------------------------------------------------------------
 
-_BLOCK_SCHED_CACHE: dict = {}
+import threading
+from collections import OrderedDict
+
+_BLOCK_SCHED_CACHE: OrderedDict = OrderedDict()
+_BLOCK_SCHED_CACHE_MAX = 32   # LRU bound — see scaling note below
+# run_ranks ranks are threads in one process, and they all hit the cache
+# during block-exchange collectives — the LRU reorder/evict pair must not
+# race (a concurrent evict between get and move_to_end would KeyError)
+_BLOCK_SCHED_LOCK = threading.Lock()
 
 
 def _block_schedule(size: int, distances: tuple, radix: int):
@@ -955,11 +963,19 @@ def _block_schedule(size: int, distances: tuple, radix: int):
     Distance-halving distances give sparbit (coll_base_allgather.c:227),
     distance-doubling gives Bruck without the final rotation (:767 /
     allgatherv :95) — blocks travel addressed by their ORIGINAL indices, so
-    no rotation pass is needed and per-rank counts may vary freely."""
+    no rotation pass is needed and per-rank counts may vary freely.
+
+    Scaling: simulating all ranks costs O(p²·log p·radix) time and O(p²)
+    memory per distinct (size, distances, radix) — fine for TPU-host comm
+    sizes (tens of ranks); the decision tables route very large comms to
+    ring/recursive-doubling variants first. The cache is a small LRU so
+    many distinct comm sizes in one job cannot accumulate unboundedly."""
     key = (size, distances, radix)
-    cached = _BLOCK_SCHED_CACHE.get(key)
-    if cached is not None:
-        return cached
+    with _BLOCK_SCHED_LOCK:
+        cached = _BLOCK_SCHED_CACHE.get(key)
+        if cached is not None:
+            _BLOCK_SCHED_CACHE.move_to_end(key)
+            return cached
     have = {r: {r} for r in range(size)}
     order = {r: [r] for r in range(size)}   # deterministic block ordering
     rounds = {r: [] for r in range(size)}
@@ -988,7 +1004,10 @@ def _block_schedule(size: int, distances: tuple, radix: int):
                     order[r].append(b)
     assert all(len(have[r]) == size for r in range(size)), \
         "block schedule incomplete"
-    _BLOCK_SCHED_CACHE[key] = rounds
+    with _BLOCK_SCHED_LOCK:
+        _BLOCK_SCHED_CACHE[key] = rounds
+        while len(_BLOCK_SCHED_CACHE) > _BLOCK_SCHED_CACHE_MAX:
+            _BLOCK_SCHED_CACHE.popitem(last=False)
     return rounds
 
 

@@ -12,5 +12,6 @@ math where the data is" components are Pallas kernels:
     (coll_base_allreduce.c:344,621).
 """
 
-from .attention import flash_attention, flash_attention_partials  # noqa: F401
+from .attention import (flash_attention, flash_attention_partials,  # noqa: F401
+                        flash_mha)
 from .collective_matmul import allgather_matmul, matmul_reduce_scatter  # noqa: F401

@@ -8,7 +8,7 @@ carried across kv steps; the final kv step normalizes and writes the output
 block. Scores and accumulation are float32 on the MXU regardless of input
 dtype (bfloat16 inputs stay bfloat16 in HBM/VMEM).
 
-Two entry points:
+Three entry points:
   * ``flash_attention`` — self-contained attention (optionally causal);
   * ``flash_attention_partials`` — returns the *un-normalized* (o, m, l)
     triple for a Q-shard against one visiting K/V shard, with global
@@ -16,6 +16,12 @@ Two entry points:
     compute of ring attention (parallel/ring.py), which merges partials
     across ring hops — the kernel analog of the reference's segmented ring
     schedule (coll_base_allreduce.c:621).
+  * ``flash_mha`` — differentiable (custom-VJP) flash attention for
+    training: the forward saves only (o, logsumexp) and the backward
+    recomputes probabilities blockwise in two Pallas kernels (dq; dk/dv),
+    the FlashAttention-2 scheme — O(seq) residual memory instead of the
+    O(seq²) score tensor, which is what lets the flagship train step keep
+    long sequences on the MXU at high utilization.
 
 Interpret mode (``interpret=True``) runs the same kernels on CPU for tests;
 on TPU backends the default is the compiled path.
@@ -287,3 +293,216 @@ def flash_attention_partials(q: jax.Array, k: jax.Array, v: jax.Array,
         interpret=interpret,
     )(offs, q, k, v)
     return o, m, l
+
+
+# ---------------------------------------------------------------------------
+# differentiable flash attention (FlashAttention-2 backward as Pallas kernels)
+# ---------------------------------------------------------------------------
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                     causal: bool, block_q: int, block_k: int, q_steps: int):
+    """dK/dV for one KV block: grid = (batch*heads, kv_blocks, q_blocks),
+    q innermost-sequential so the (bk, d) accumulators live in VMEM scratch.
+    Probabilities are recomputed from the saved logsumexp — no O(s²)
+    residual."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros(dk_acc.shape, dk_acc.dtype)
+        dv_acc[...] = jnp.zeros(dv_acc.shape, dv_acc.dtype)
+
+    ki = pl.program_id(1)
+    visible = True
+    if causal:
+        # any (row ≥ col) pair in this tile?  rows are q, cols are kv
+        last_row = (qi + 1) * block_q - 1
+        first_col = ki * block_k
+        visible = last_row >= first_col
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = (qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            cols = (ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        dv_acc[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # pᵀ·dO
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)             # dsᵀ·Q
+
+    @pl.when(qi == q_steps - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale: float, causal: bool,
+                   block_q: int, block_k: int, kv_steps: int):
+    """dQ for one Q block: grid = (batch*heads, q_blocks, kv_blocks), kv
+    innermost-sequential."""
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros(dq_acc.shape, dq_acc.dtype)
+
+    qi = pl.program_id(1)
+    visible = True
+    if causal:
+        last_row = (qi + 1) * block_q - 1
+        first_col = ki * block_k
+        visible = last_row >= first_col
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = (qi * block_q
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
+            cols = (ki * block_k
+                    + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == kv_steps - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array,
+              causal: bool = False, scale: Optional[float] = None,
+              block_q: Optional[int] = None, block_k: Optional[int] = None,
+              interpret: Optional[bool] = None) -> jax.Array:
+    """Differentiable flash attention over (batch, seq, heads, head_dim).
+
+    The train-step entry point: identical math to ``flash_attention`` but
+    with a FlashAttention-2 backward (blockwise recompute from the saved
+    logsumexp), so ``jax.grad`` through it never materializes the score
+    matrix. Residuals are q, k, v, o, logsumexp — O(batch·seq·heads·d)."""
+    out, _ = _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k,
+                            interpret)
+    return out
+
+
+def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    if interpret is None:
+        interpret = _default_interpret()
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
+    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
+    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+    o_un, m, l = flash_attention_partials(
+        qf, kf, vf, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    l = jnp.maximum(l, 1e-20)
+    of = (o_un / l[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)                                    # (bh, s_q)
+    out = jnp.moveaxis(of.reshape(b, h, s_q, d), 1, 2)
+    return out, (qf, kf, vf, of, lse, (b, h))
+
+
+def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
+                   residuals, g):
+    qf, kf, vf, of, lse, (b, h) = residuals
+    if interpret is None:
+        interpret = _default_interpret()
+    bh, s_q, d = qf.shape
+    s_k = kf.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    dof = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d)
+    # δ_i = Σ_d dO·O — the dS correction term (FlashAttention-2 eq. 4)
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1)                                # (bh, s_q)
+
+    dkdv = functools.partial(
+        _bwd_dkdv_kernel, scale=float(scale), causal=bool(causal),
+        block_q=bq, block_k=bk, q_steps=s_q // bq)
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, s_k // bk, s_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh_, ki, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh_, ki, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh_, ki, qi: (bh_, qi)),
+            pl.BlockSpec((1, bq), lambda bh_, ki, qi: (bh_, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s_k, d), kf.dtype),
+            jax.ShapeDtypeStruct((bh, s_k, d), vf.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dqk = functools.partial(
+        _bwd_dq_kernel, scale=float(scale), causal=bool(causal),
+        block_q=bq, block_k=bk, kv_steps=s_k // bk)
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, s_q // bq, s_k // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh_, qi, ki: (bh_, ki, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, bq), lambda bh_, qi, ki: (bh_, qi)),
+            pl.BlockSpec((1, bq), lambda bh_, qi, ki: (bh_, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qf.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    unfold = lambda x, s: jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
+    return unfold(dq, s_q), unfold(dk, s_k), unfold(dv, s_k)
+
+
+flash_mha.defvjp(_flash_mha_fwd, _flash_mha_bwd)

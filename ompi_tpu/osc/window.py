@@ -618,6 +618,14 @@ class SharedWindow(Window):
                 os.unlink(self._sh_path)
             except OSError:
                 pass
+        # drop the numpy views pinning the mapping, then close it — else
+        # repeated allocate/free cycles accumulate live mmaps until GC
+        self._sh_segment = None
+        self.local = None
+        try:
+            self._sh_mmap.close()
+        except (BufferError, ValueError):
+            pass              # a caller still holds a shared_query view
 
 
 def win_allocate_shared(comm, count: int, dtype=np.float64,

@@ -204,7 +204,13 @@ class Context:
         exactly this rank — terminate it (MPI_Abort does not return,
         ompi/mpi/c/abort.c). Threaded in-process ranks (run_ranks) only
         notify: killing the host process would take out peer ranks and the
-        harness; their LocalBootstrap wakes peers instead."""
+        harness; their LocalBootstrap wakes peers instead.
+
+        Exit-status clamp: POSIX statuses are 8-bit, and an abort must
+        never look like success, so the reported status is
+        ``(code & 0xFF) or 1`` — errorcode 0 and any multiple of 256 both
+        surface as status 1. Launcher-side consumers comparing statuses to
+        the original errorcode should compare mod 256 (0 ≙ 1)."""
         try:
             self.bootstrap.abort(code, msg)
         finally:
