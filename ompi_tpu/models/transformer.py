@@ -39,12 +39,44 @@ class Config:
     d_ff: int = 512
     seq: int = 128
     dtype: Any = jnp.bfloat16        # activation/compute dtype (MXU-native)
-    attn: str = "dense"              # "dense" | "ring"
+    attn: str = "dense"              # "dense" | "ring" | "flash" (Pallas)
     rope_base: float = 10000.0
     mlp: str = "dense"               # "dense" | "moe" (expert-parallel)
     n_experts: int = 8
     moe_top_k: int = 2
     moe_aux_weight: float = 0.01
+    remat: str = "none"              # "none" | "dots" | "full" — see
+    #   make_train_step: "full" recomputes each layer in the backward
+    #   (cheapest memory, +~1 forward of FLOPs), "dots" saves matmul
+    #   outputs and recomputes only elementwise ops (MXU work unchanged)
+
+
+def flagship_config(seq: int = 2048) -> Config:
+    """The single-chip flagship: sized so the MXU saturates (d_model 2048
+    ≥ the 128×128 systolic tile by 16×, head_dim 128 = one lane tile,
+    d_ff 4×) and the Pallas flash path carries attention. ~440 M params —
+    fp32 master + Adam moments ≈ 5.3 GB, activations with "dots" remat fit
+    a 16 GB v5e at batch 4 × seq 2048."""
+    return Config(vocab=32768, d_model=2048, n_layers=6, n_heads=16,
+                  head_dim=128, d_ff=8192, seq=seq, attn="flash",
+                  remat="dots")
+
+
+def train_flops_per_token(cfg: Config) -> float:
+    """Counted model FLOPs per trained token (the MFU numerator), standard
+    accounting: 6 × matmul-weight params (fwd 2N + bwd 4N) plus causal
+    attention 6·s·h per layer, h = n_heads·head_dim (fwd score+AV = 4·s·h,
+    ×3 for train = 12·s·h, halved by causality). Remat recompute is
+    hardware work but NOT counted — MFU is model FLOPs / peak, methodology
+    per the reference's docs/tuning-apps/benchmarking.rst denominator
+    discipline."""
+    h = cfg.n_heads * cfg.head_dim
+    per_layer = (cfg.d_model * 3 * h          # wqkv
+                 + h * cfg.d_model            # wo
+                 + 3 * cfg.d_model * cfg.d_ff)  # gate/up/down
+    n_mm = cfg.n_layers * per_layer + cfg.d_model * cfg.vocab  # + logits
+    attn = 6 * cfg.seq * h * cfg.n_layers                      # causal
+    return 6.0 * n_mm + attn
 
 
 # -- init -------------------------------------------------------------------
@@ -142,44 +174,67 @@ def _rope(x, positions, base):
     return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
 
 
+def _layer_apply(x: jax.Array, layer: Dict, cfg: Config,
+                 mesh: Optional[Mesh]) -> Tuple[jax.Array, jax.Array]:
+    """One decoder layer; returns (x, router_aux)."""
+    b, s = x.shape[0], x.shape[1]
+    positions = jnp.arange(s)
+    h = _rms_norm(x, layer["attn_norm"])
+    qkv = h @ layer["wqkv"].astype(cfg.dtype)          # (b, s, 3*heads*hd)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    q = _rope(q, positions, cfg.rope_base)
+    k = _rope(k, positions, cfg.rope_base)
+    if cfg.attn == "ring" and mesh is not None and "sp" in mesh.axis_names:
+        att = ring_attention(q, k, v, mesh, "sp", causal=True,
+                             batch_axis="dp" if "dp" in mesh.axis_names
+                             else None,
+                             head_axis="tp" if "tp" in mesh.axis_names
+                             else None)
+    elif cfg.attn == "flash":
+        from ..ops.attention import flash_mha
+        att = flash_mha(q, k, v, True)                 # Pallas fwd + bwd
+    else:
+        att = attention_reference(q, k, v, causal=True)
+    att = att.reshape(b, s, cfg.n_heads * cfg.head_dim)
+    x = x + att @ layer["wo"].astype(cfg.dtype)        # row-parallel → psum
+    h = _rms_norm(x, layer["mlp_norm"])
+    if "moe" in layer:
+        from .moe import moe_block
+        mlp_out, aux = moe_block(h, layer["moe"], cfg.n_experts,
+                                 cfg.moe_top_k)
+        return x + mlp_out, aux
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
+    up = h @ layer["w_up"].astype(cfg.dtype)
+    return x + (gate * up) @ layer["w_down"].astype(cfg.dtype), \
+        jnp.zeros((), jnp.float32)
+
+
+def _remat_wrap(fn, mode: str):
+    if mode == "full":
+        return jax.checkpoint(fn)
+    if mode == "dots":
+        # keep matmul outputs, recompute elementwise (norms/rope/silu):
+        # backward re-does no MXU work, HBM residency drops to the dot
+        # outputs — the right trade on HBM-bandwidth-bound chips
+        return jax.checkpoint(
+            fn, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return fn
+
+
 def forward(params: Dict, tokens: jax.Array, cfg: Config,
             mesh: Optional[Mesh] = None) -> jax.Array:
     """tokens: (batch, seq) int32 → logits (batch, seq, vocab); with
     cfg.mlp == "moe" returns (logits, router_aux_loss)."""
-    b, s = tokens.shape
     x = params["embed"].astype(cfg.dtype)[tokens]      # (b, s, d)
-    positions = jnp.arange(s)
     aux_total = jnp.zeros((), jnp.float32)
+    layer_fn = _remat_wrap(
+        lambda x, layer: _layer_apply(x, layer, cfg, mesh), cfg.remat)
     for layer in params["layers"]:
-        h = _rms_norm(x, layer["attn_norm"])
-        qkv = h @ layer["wqkv"].astype(cfg.dtype)      # (b, s, 3*heads*hd)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
-        q = _rope(q, positions, cfg.rope_base)
-        k = _rope(k, positions, cfg.rope_base)
-        if cfg.attn == "ring" and mesh is not None and "sp" in mesh.axis_names:
-            att = ring_attention(q, k, v, mesh, "sp", causal=True,
-                                 batch_axis="dp" if "dp" in mesh.axis_names
-                                 else None,
-                                 head_axis="tp" if "tp" in mesh.axis_names
-                                 else None)
-        else:
-            att = attention_reference(q, k, v, causal=True)
-        att = att.reshape(b, s, cfg.n_heads * cfg.head_dim)
-        x = x + att @ layer["wo"].astype(cfg.dtype)    # row-parallel → psum
-        h = _rms_norm(x, layer["mlp_norm"])
-        if "moe" in layer:
-            from .moe import moe_block
-            mlp_out, aux = moe_block(h, layer["moe"], cfg.n_experts,
-                                     cfg.moe_top_k)
-            x = x + mlp_out
-            aux_total = aux_total + aux
-        else:
-            gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
-            up = h @ layer["w_up"].astype(cfg.dtype)
-            x = x + (gate * up) @ layer["w_down"].astype(cfg.dtype)
+        x, aux = layer_fn(x, layer)
+        aux_total = aux_total + aux
     x = _rms_norm(x, params["final_norm"])
     logits = x @ params["embed"].astype(cfg.dtype).T   # tied embedding
     logits = logits.astype(jnp.float32)
@@ -191,9 +246,12 @@ def loss_fn(params: Dict, tokens: jax.Array, cfg: Config,
     out = forward(params, tokens[:, :-1], cfg, mesh)
     logits, aux = out if cfg.mlp == "moe" else (out, 0.0)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return jnp.mean(nll) + cfg.moe_aux_weight * aux
+    # logsumexp-form CE: one (b, s) reduction instead of materializing a
+    # second (b, s, vocab) float32 log-probability tensor — at flagship
+    # scale that second tensor alone is GBs of HBM
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - gold) + cfg.moe_aux_weight * aux
 
 
 # -- training ---------------------------------------------------------------
