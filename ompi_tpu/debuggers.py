@@ -32,18 +32,21 @@ def _fmt(v: int, anyv: int) -> str:
 def message_queues(ctx) -> Dict[str, List[Dict[str, Any]]]:
     """Snapshot the rank's posted-recv / unexpected / pending-send queues."""
     eng = ctx.p2p.matching
-    posted = [
-        {"cid": cid, "src": p.src, "tag": p.tag}
-        for cid, lst in list(eng._posted.items())
-        for p in list(lst)
-    ]
-    unexpected = [
-        {"cid": cid, "src": u.src, "tag": u.tag, "seq": u.seq,
-         "kind": u.kind, "nbytes": len(u.payload)}
-        for cid, by_src in list(eng._unexpected.items())
-        for _src, q in list(by_src.items())
-        for u in list(q)
-    ]
+    if hasattr(eng, "snapshot"):        # native engine: C++-side queues
+        posted, unexpected = eng.snapshot()
+    else:
+        posted = [
+            {"cid": cid, "src": p.src, "tag": p.tag}
+            for cid, lst in list(eng._posted.items())
+            for p in list(lst)
+        ]
+        unexpected = [
+            {"cid": cid, "src": u.src, "tag": u.tag, "seq": u.seq,
+             "kind": u.kind, "nbytes": len(u.payload)}
+            for cid, by_src in list(eng._unexpected.items())
+            for _src, q in list(by_src.items())
+            for u in list(q)
+        ]
     pending_sends = [
         {"transport": mod.name, "frames": int(mod.pending_count())}
         for mod in ctx.layer.transports

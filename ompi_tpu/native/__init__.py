@@ -7,6 +7,8 @@ pieces where native actually pays on a TPU *host*:
   * ``shmbox.cpp``    — shared-memory SPSC ring channels (≙ btl/sm)
   * ``convertor.cpp`` — derived-datatype pack/unpack loops (≙ opal_convertor)
   * ``cma.cpp``       — cross-memory-attach single-copy reads (≙ smsc/cma)
+  * ``mx.cpp``        — matching engine + per-message p2p frame path
+                        (≙ pml_ob1_recvfrag.c matching + fbox send path)
 
 Build strategy (no pip, no pybind11 in the image): a single ``g++ -O3
 -shared -fPIC`` invocation at first import. The artifact name embeds a
@@ -27,7 +29,7 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["shmbox.cpp", "convertor.cpp", "cma.cpp"]
+_SOURCES = ["shmbox.cpp", "convertor.cpp", "cma.cpp", "mx.cpp"]
 
 _lock = threading.Lock()
 _lib = None
@@ -123,6 +125,57 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.cma_read.restype = ctypes.c_int64
     lib.cma_probe.argtypes = []
     lib.cma_probe.restype = ctypes.c_int
+    # -- mx: native matching + p2p frame engine -----------------------------
+    i = ctypes.c_int
+    i32, i64, u32, u64 = (ctypes.c_int32, ctypes.c_int64, ctypes.c_uint32,
+                          ctypes.c_uint64)
+    chp = ctypes.c_char_p
+    lib.mx_new.argtypes = [u64]
+    lib.mx_new.restype = i
+    lib.mx_destroy.argtypes = [i]
+    lib.mx_destroy.restype = None
+    lib.mx_set_peruse.argtypes = [i, i]
+    lib.mx_set_peruse.restype = None
+    lib.mx_set_peer_tx.argtypes = [i, i32, i, i]
+    lib.mx_set_peer_tx.restype = None
+    lib.mx_add_rx.argtypes = [i, i32, i]
+    lib.mx_add_rx.restype = None
+    # c_char_p payload args: python bytes pass zero-copy (C only reads)
+    lib.mx_tx.argtypes = [i, i32, chp, u32, chp, u64]
+    lib.mx_tx.restype = i
+    lib.mx_send_eager.argtypes = [i, i32, i64, i64, u32, chp, u64]
+    lib.mx_send_eager.restype = i
+    # u8p (not c_char_p) so numpy arrays stream zero-copy via .ctypes
+    lib.mx_send_frags.argtypes = [i, i32, i64, u8p, u64, u64]
+    lib.mx_send_frags.restype = i
+    lib.mx_post_recv.argtypes = [i, i64, i32, i64, u8p, u64, i64,
+                                 ctypes.c_void_p]
+    lib.mx_post_recv.restype = i
+    lib.mx_cancel.argtypes = [i, i64, i64]
+    lib.mx_cancel.restype = i
+    lib.mx_probe.argtypes = [i, i64, i32, i64, i, ctypes.c_void_p]
+    lib.mx_probe.restype = i
+    lib.mx_add_sink.argtypes = [i, i64, u8p, u64]
+    lib.mx_add_sink.restype = None
+    lib.mx_arrived.argtypes = [i, i32, i64, i64, u32, u64, i, i64, i64,
+                               chp, u64]
+    lib.mx_arrived.restype = None
+    lib.mx_fail_src.argtypes = [i, i32, ctypes.POINTER(i64), i]
+    lib.mx_fail_src.restype = None
+    lib.mx_progress.argtypes = [i]
+    lib.mx_progress.restype = i
+    lib.mx_drain.argtypes = [i, ctypes.c_void_p, i]
+    lib.mx_drain.restype = i
+    lib.mx_pending_tx.argtypes = [i, i32]
+    lib.mx_pending_tx.restype = i
+    lib.mx_pending_tx_peer.argtypes = [i, i32]
+    lib.mx_pending_tx_peer.restype = i
+    lib.mx_free_blob.argtypes = [ctypes.c_void_p]
+    lib.mx_free_blob.restype = None
+    lib.mx_stat.argtypes = [i, i]
+    lib.mx_stat.restype = u64
+    lib.mx_dump.argtypes = [i, chp, i]
+    lib.mx_dump.restype = i
     return lib
 
 

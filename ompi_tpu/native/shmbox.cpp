@@ -234,6 +234,41 @@ int shmbox_read_frame(int h, uint8_t* buf, uint32_t buflen,
   return (int)lens[1];
 }
 
+// Zero-copy receive pair for the native engine (mx.cpp): expose the next
+// frame IN PLACE when it lies contiguous in the ring, so payload bytes can
+// be memcpy'd exactly once (ring → posted buffer / sink), then consume it
+// with shmbox_advance. Returns header length; -1 when empty; 0 when the
+// frame wraps the ring edge (caller falls back to the copying read).
+int shmbox_peek_inplace(int h, const uint8_t** hdr, const uint8_t** payload,
+                        uint32_t* plen) {
+  Chan* cp = chan_of(h);
+  if (!cp) return -1;
+  Chan& c = *cp;
+  uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
+  uint64_t head = c.ctl->head.load(std::memory_order_acquire);
+  if (head == tail) return -1;
+  uint32_t lens[2];
+  ring_read(c, tail, reinterpret_cast<uint8_t*>(lens), 8);
+  const uint32_t cap = c.ctl->capacity;
+  uint64_t body = lens[0] - 8;
+  uint64_t off = (tail + 8) % cap;
+  if (off + body > cap) return 0;              // wraps: copying path
+  *hdr = c.data + off;
+  *payload = c.data + off + lens[1];
+  *plen = (uint32_t)(body - lens[1]);
+  return (int)lens[1];
+}
+
+void shmbox_advance(int h) {
+  Chan* cp = chan_of(h);
+  if (!cp) return;
+  Chan& c = *cp;
+  uint64_t tail = c.ctl->tail.load(std::memory_order_relaxed);
+  uint32_t lens[2];
+  ring_read(c, tail, reinterpret_cast<uint8_t*>(lens), 8);
+  c.ctl->tail.store(tail + round8(lens[0]), std::memory_order_release);
+}
+
 // ---- doorbells -----------------------------------------------------------
 //
 // Named-semaphore wakeup for idle receivers. Spinning in the progress loop

@@ -112,7 +112,7 @@ class _SendState:
 
 
 class _RecvState:
-    __slots__ = ("req", "conv", "received", "total", "finish")
+    __slots__ = ("req", "conv", "received", "total", "finish", "sink_buf")
 
     def __init__(self, req: Request, conv, total: int,
                  finish=None) -> None:
@@ -121,6 +121,7 @@ class _RecvState:
         self.received = 0
         self.total = total
         self.finish = finish     # device staging upload, run at completion
+        self.sink_buf = None     # contiguous target for the native frag sink
 
 
 class _PackedSink:
@@ -262,7 +263,7 @@ class P2P:
     def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
               cid: int = 0, datatype: Optional[Datatype] = None,
               count: Optional[int] = None) -> Request:
-        req, on_match = self._recv_handler(buf, datatype, count)
+        req, on_match, _ = self._recv_handler(buf, datatype, count)
         if peruse.active:
             peruse.fire(peruse.REQ_ACTIVATE, kind="recv", peer=src,
                         tag=tag, cid=cid)
@@ -275,8 +276,10 @@ class P2P:
 
     def _recv_handler(self, buf, datatype: Optional[Datatype],
                       count: Optional[int]):
-        """(request, on_match) pair shared by irecv and imrecv — everything
-        that happens once a message matches this receive."""
+        """(request, on_match, info) triple shared by irecv and imrecv —
+        everything that happens once a message matches this receive.
+        ``info`` = (arr, dt, cnt, dinfo) so the native pml can decide
+        direct-buffer eligibility without re-deriving it."""
         dinfo = _accel.check_addr(buf)
         if dinfo is not None:
             # device destination: stage packed stream on host, upload once
@@ -343,10 +346,14 @@ class P2P:
                     sink = _PackedSink(u.header["size"])
                     state = _RecvState(req, sink, u.header["size"],
                                        finish=lambda: deliver(bytes(sink.data)))
+                    state.sink_buf = sink.data       # native-sink candidate
                 else:
                     state = _RecvState(req, Convertor(arr, dt, cnt),
                                        u.header["size"])
+                    if dt.is_contiguous and arr.flags["C_CONTIGUOUS"]:
+                        state.sink_buf = arr         # native-sink candidate
                 self._pending_recv[rreq] = state
+                self._register_sink(rreq, state, u.src)
                 req.status.count = u.header["size"]
                 if u.header["size"] == 0:
                     del self._pending_recv[rreq]
@@ -358,7 +365,13 @@ class P2P:
                                 {"k": "ack", "sreq": u.header["sreq"],
                                  "rreq": rreq}, b"")
 
-        return req, on_match
+        return req, on_match, (arr if dinfo is None else None,
+                               dt, cnt, dinfo)
+
+    def _register_sink(self, rreq: int, state: "_RecvState",
+                       src: int) -> None:
+        """Hook: the native pml registers contiguous fragment sinks with the
+        C++ engine here so frag payloads land by memcpy without Python."""
 
     # -- matched probe (≙ MPI_Mprobe/Mrecv, ompi/message/) ------------------
 
@@ -394,7 +407,7 @@ class P2P:
                count: Optional[int] = None) -> Request:
         """Receive the matched message into ``buf`` (MPI_Imrecv)."""
         u = msg.consume()
-        req, on_match = self._recv_handler(buf, datatype, count)
+        req, on_match, _ = self._recv_handler(buf, datatype, count)
         on_match(u)
         return req
 
@@ -471,27 +484,38 @@ class P2P:
             self.matching.arrived(header["cid"], src, header["tag"],
                                   header["seq"], k, header, payload)
         elif k == "ack":
-            state = self._pending_send.pop(header["sreq"])
-            if header["rreq"] < 0:   # receiver matched but discarded (truncate)
-                state.req.complete()
-            else:
-                self._stream_frags(src, header["rreq"], state)
-        elif k == "fin":             # CMA single-copy done: nothing to stream
-            state = self._pending_send.pop(header["sreq"])
-            state.keep = None
-            state.req.complete()
+            self._handle_ack(src, header["sreq"], header["rreq"])
+        elif k == "fin":
+            self._handle_fin(header["sreq"])
         elif k == "frag":
-            state = self._pending_recv[header["rreq"]]
-            state.conv.set_position(header["off"])
-            state.conv.unpack(payload)
-            state.received += len(payload)
-            if state.received >= state.total:
-                del self._pending_recv[header["rreq"]]
-                if state.finish is not None:
-                    state.finish()
-                state.req.complete()
+            self._handle_frag(header["rreq"], header["off"], payload)
         else:
             raise RuntimeError(f"unknown p2p frame kind {k!r}")
+
+    # split out so the native pml's drained events reuse the exact protocol
+    def _handle_ack(self, src: int, sreq: int, rreq: int) -> None:
+        state = self._pending_send.pop(sreq)
+        if rreq < 0:             # receiver matched but discarded (truncate)
+            state.req.complete()
+        else:
+            self._stream_frags(src, rreq, state)
+
+    def _handle_fin(self, sreq: int) -> None:
+        """CMA single-copy done: nothing to stream."""
+        state = self._pending_send.pop(sreq)
+        state.keep = None
+        state.req.complete()
+
+    def _handle_frag(self, rreq: int, off: int, payload: bytes) -> None:
+        state = self._pending_recv[rreq]
+        state.conv.set_position(off)
+        state.conv.unpack(payload)
+        state.received += len(payload)
+        if state.received >= state.total:
+            del self._pending_recv[rreq]
+            if state.finish is not None:
+                state.finish()
+            state.req.complete()
 
     def _cma_pull(self, cma, arr: np.ndarray, size: int) -> bool:
         """Read the sender's exposed buffer via process_vm_readv; False

@@ -1,0 +1,504 @@
+"""Native-engine pml: the per-message host data path in C++ (≙ pml/ob1's C
+matching engine, pml_ob1_recvfrag.c:453, and btl/sm's fbox send path,
+btl_sm_fbox.h:31-35).
+
+Round-2 profiling put 60-80 µs of Python in every host message.  Here the
+hot path is ONE ctypes call each way into native/mx.cpp:
+
+  * eager send → ``mx_send_eager`` (header pack + ring write + doorbell);
+  * arrivals   → ``mx_progress`` drains every shm ring in C++, matches in
+    C++, memcpys eager payloads into posted user buffers and fragment
+    payloads into registered sinks, then queues fixed-size records that
+    ``_mx_progress`` turns into Request completions.
+
+Python keeps the *protocol* (rendezvous decisions, CMA, device staging,
+truncation, errors) — those are per-*message* for large transfers, not
+per-byte.  The C++ engine holds the matching state for ALL transports:
+tcp/self arrivals are fed through ``mx_arrived`` so ANY_SOURCE sees one
+unified queue, exactly ob1's single-matching-engine property.
+
+Selection: ``runtime.Context`` instantiates ``NativeP2P`` when the native
+library builds, the shm transport was selected, and
+``OMPI_TPU_pml_base_native`` (default true) allows it; otherwise the pure
+Python ``P2P`` remains in charge (no-toolchain hosts lose speed, not
+features).  Both speak the identical wire format, so native and pure
+ranks interoperate within one job.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import peruse
+from ..core import var as _var
+from ..datatype import Datatype
+from . import transport as T
+from . import wire
+from .matching import Unexpected
+from .pml import P2P, _guarded
+from .request import ANY_SOURCE, ANY_TAG, Request
+
+_var.register("pml", "base", "native", True, type=bool, level=3,
+              help="Use the native (C++) matching + frame engine when the "
+                   "shm transport and toolchain are available.")
+
+_U8P = ctypes.POINTER(ctypes.c_uint8)
+
+_EV_RECV_DONE = 1
+_EV_RECV_DATA = 2
+_EV_RECV_RNDV = 3
+_EV_PY_FRAME = 4
+_EV_ACK = 5
+_EV_SINK_DONE = 6
+_EV_RECV_FAILED = 7
+_EV_RECV_PENDING = 8
+_EV_UNEX = 9
+
+_K_MATCH, _K_RNDV = 1, 2
+
+
+class _MxEv(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [("type", ctypes.c_int32), ("peer", ctypes.c_int32),
+                ("a", ctypes.c_int64), ("b", ctypes.c_int64),
+                ("c", ctypes.c_int64), ("d", ctypes.c_int64),
+                ("e", ctypes.c_int64), ("f", ctypes.c_int32),
+                ("blob", ctypes.c_void_p), ("blen", ctypes.c_uint64)]
+
+
+class _MxImm(ctypes.Structure):
+    _pack_ = 1
+    _fields_ = [("kind", ctypes.c_int32), ("src", ctypes.c_int32),
+                ("tag", ctypes.c_int64), ("seq", ctypes.c_uint32),
+                ("size", ctypes.c_uint64),
+                ("sreq_or_token", ctypes.c_int64),
+                ("blob", ctypes.c_void_p), ("blen", ctypes.c_uint64)]
+
+
+class _Slot:
+    """Python side of a posted receive living in the C++ engine."""
+    __slots__ = ("req", "on_match", "arr", "cap")
+
+    def __init__(self, req, on_match, arr, cap) -> None:
+        self.req = req
+        self.on_match = on_match   # full protocol closure (pml._recv_handler)
+        self.arr = arr             # direct-mode destination (host contiguous)
+        self.cap = cap
+
+
+class NativeMatching:
+    """Facade over the C++ queues with the classic engine's external
+    surface — ULFM ``fail_src``, probe, cancel, and the debugger snapshot —
+    so ft/ulfm.py and debuggers.py work unchanged."""
+
+    def __init__(self, pml: "NativeP2P") -> None:
+        self._pml = pml
+        self.spc = None
+
+    # -- probe (≙ matching.probe) ------------------------------------------
+
+    def probe(self, cid: int, src: int, tag: int,
+              remove: bool = False) -> Optional[Unexpected]:
+        p = self._pml
+        imm = _MxImm()
+        if not p._lib.mx_probe(p._mxh, cid, src, tag, int(remove),
+                               ctypes.byref(imm)):
+            return None
+        # a peek (iprobe poll loop) only reads src/tag/size — skip the
+        # payload copy; only a dequeue (mprobe) materializes the bytes
+        return p._imm_to_unexpected(cid, imm, owned=remove,
+                                    want_payload=remove)
+
+    def cancel(self, cid: int, slot_id: int) -> bool:
+        p = self._pml
+        ok = bool(p._lib.mx_cancel(p._mxh, cid, slot_id))
+        if ok:
+            p._slots.pop(slot_id, None)
+        return ok
+
+    def fail_src(self, src: int, err: Exception,
+                 any_source_cids=frozenset(),
+                 pending_err: Exception | None = None) -> None:
+        p = self._pml
+        cids = list(any_source_cids)
+        arr = (ctypes.c_int64 * max(len(cids), 1))(*cids)
+        p._fail_err = err
+        p._fail_pending_err = pending_err or err
+        p._lib.mx_fail_src(p._mxh, src, arr, len(cids))
+        p._drain()            # the failure records are queued synchronously
+
+    # feed from python-side transports (tcp/self) — same unified queues
+    def arrived(self, cid: int, src: int, tag: int, seq: int, kind: str,
+                header: Dict[str, Any], payload: bytes) -> None:
+        p = self._pml
+        if kind == "match":
+            p._lib.mx_arrived(p._mxh, src, cid, tag, seq,
+                              header["size"], _K_MATCH, 0, -1,
+                              payload, len(payload))
+        else:
+            token = -1
+            if "cma" in header:   # only extended headers need a token; a
+                # plain rndv reconstructs losslessly from the event fields
+                token = next(p._token_ids)
+                p._tokens[token] = header
+            p._lib.mx_arrived(p._mxh, src, cid, tag, seq, header["size"],
+                              _K_RNDV, header.get("sreq", 0), token, b"", 0)
+        p._drain()
+
+    # -- debugger snapshot (debuggers.message_queues) ----------------------
+
+    def snapshot(self):
+        p = self._pml
+        need = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(need)
+            n = p._lib.mx_dump(p._mxh, buf, need)
+            if n <= need:
+                break
+            need = n + 1
+        posted, unexpected = [], []
+        for line in buf.raw[:n].decode().splitlines():
+            parts = line.split()
+            if parts[0] == "P":
+                posted.append({"cid": int(parts[1]), "src": int(parts[2]),
+                               "tag": int(parts[3])})
+            else:
+                unexpected.append({
+                    "cid": int(parts[1]), "src": int(parts[2]),
+                    "tag": int(parts[3]), "seq": int(parts[4]),
+                    "kind": "match" if parts[5] == "1" else "rndv",
+                    "nbytes": int(parts[6])})
+        return posted, unexpected
+
+
+class NativeP2P(P2P):
+    """P2P with the per-message path in C++ — see module docstring."""
+
+    def __init__(self, bootstrap, layer, engine, spc=None) -> None:
+        from .. import native
+
+        super().__init__(bootstrap, layer, engine, spc=spc)
+        self._lib = native.load()
+        shm = next(t for t in layer.transports if t.name == "shm")
+        self._shm = shm
+        self._mxh = self._lib.mx_new(shm._ring)
+        if self._mxh < 0:
+            raise RuntimeError("mx engine table exhausted")
+        shm.adopt_mx(self._lib, self._mxh)
+        # replace the classic matching engine; external consumers
+        # (ulfm, debuggers, inherited probe/mprobe paths) use the facade
+        self.matching = NativeMatching(self)
+        self.matching.spc = self.spc
+        self._slots: Dict[int, _Slot] = {}
+        self._slot_ids = itertools.count(1)
+        self._tokens: Dict[int, Dict[str, Any]] = {}
+        self._token_ids = itertools.count(1)
+        self._mx_peers: Dict[int, bool] = {}
+        self._fail_err: Optional[Exception] = None
+        self._fail_pending_err: Optional[Exception] = None
+        self._evbuf = (_MxEv * 64)()
+        self._in_drain = False
+        self._mx_peruse = False
+        self._stat_base = [0, 0]      # matches_posted, unexpected_arrivals
+        engine.register(self._mx_progress)
+
+    def finalize(self) -> None:
+        if self._mxh >= 0:
+            self._lib.mx_destroy(self._mxh)
+            self._mxh = -1
+
+    # -- helpers ------------------------------------------------------------
+
+    def _is_mx_peer(self, peer: int) -> bool:
+        v = self._mx_peers.get(peer)
+        if v is None:
+            v = self.layer.for_peer(peer) is self._shm
+            self._mx_peers[peer] = v
+        return v
+
+    def _imm_to_unexpected(self, cid: int, imm: _MxImm, owned: bool,
+                           want_payload: bool = True) -> Unexpected:
+        """Rebuild the classic Unexpected view from an immediate-match /
+        probe result (Message/mprobe and the python-mode recv paths)."""
+        if imm.kind == 2:        # match payload
+            payload = ctypes.string_at(imm.blob, imm.blen) \
+                if imm.blob and want_payload else b""
+            if owned and imm.blob:
+                self._lib.mx_free_blob(imm.blob)
+            header = {"k": "match", "cid": cid, "tag": imm.tag,
+                      "seq": imm.seq, "size": imm.size}
+            return Unexpected(imm.src, imm.tag, imm.seq, "match", header,
+                              payload)
+        if imm.kind == 4:        # rndv with python-held header (cma etc.)
+            header = self._tokens.pop(imm.sreq_or_token) if owned else \
+                self._tokens[imm.sreq_or_token]
+        else:                    # fmt-1 rndv
+            header = {"k": "rndv", "cid": cid, "tag": imm.tag,
+                      "seq": imm.seq, "size": imm.size,
+                      "sreq": imm.sreq_or_token}
+        return Unexpected(imm.src, imm.tag, imm.seq, "rndv", header, b"")
+
+    def _register_sink(self, rreq: int, state, src: int) -> None:
+        """Contiguous sinks land by C++ memcpy when the peer's frags come
+        over an mx-owned ring (pml hook)."""
+        buf = state.sink_buf
+        if buf is None or state.total == 0 or not self._is_mx_peer(src):
+            return
+        if isinstance(buf, np.ndarray):
+            ptr = buf.reshape(-1).view(np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8))
+        else:                    # bytearray (_PackedSink staging buffer)
+            ptr = ctypes.cast(
+                (ctypes.c_char * len(buf)).from_buffer(buf),
+                ctypes.POINTER(ctypes.c_uint8))
+        self._lib.mx_add_sink(self._mxh, rreq, ptr, state.total)
+        # state.conv stays: the C++ engine falls back to the python frag
+        # path for out-of-bounds fragments (its error path) and that path
+        # needs the convertor to diagnose the bad offset
+
+    # -- send ---------------------------------------------------------------
+
+    @_guarded
+    def isend(self, buf, dst: int, tag: int = 0, cid: int = 0,
+              datatype: Optional[Datatype] = None,
+              count: Optional[int] = None, sync: bool = False) -> Request:
+        # fast path: host-contiguous eager to an mx peer — ONE native call.
+        # Size gate FIRST: the ndarray branch copies (tobytes), which must
+        # never happen for rendezvous-bound payloads.
+        if not sync and datatype is None and count is None:
+            data = None
+            if type(buf) is bytes:
+                if len(buf) <= self._shm.eager_limit:
+                    data = buf
+            elif isinstance(buf, np.ndarray) and \
+                    buf.nbytes <= self._shm.eager_limit and \
+                    buf.flags["C_CONTIGUOUS"] and buf.dtype != object:
+                data = buf.tobytes()
+            if data is not None and self._is_mx_peer(dst):
+                key = (cid, dst)
+                seq = self._send_seq[key]
+                self._send_seq[key] = seq + 1
+                if dst not in self._shm._mx_tx_wired:
+                    self._shm._mx_wire_tx(dst)
+                if self._lib.mx_send_eager(self._mxh, dst, cid, tag, seq,
+                                           data, len(data)) == -2:
+                    raise ValueError(
+                        f"eager frame of {len(data)} bytes exceeds the shm "
+                        f"ring capacity (raise transport_shm_ring_size)")
+                req = Request()
+                req.status.source = self.rank
+                req.status.tag = tag
+                req.status.count = len(data)
+                req.complete()       # eager: complete once buffered
+                n = len(data)
+                self.spc.inc("isends")
+                self.spc.inc("eager_sends")
+                self.spc.inc("bytes_sent", n)
+                self.spc.peer_traffic("tx", dst, n)
+                if peruse.active:
+                    peruse.fire(peruse.REQ_ACTIVATE, kind="send", peer=dst,
+                                tag=tag, cid=cid, nbytes=n)
+                return req
+        return super().isend(buf, dst, tag, cid, datatype, count, sync)
+
+    def _stream_frags(self, dst: int, rreq: int, state) -> None:
+        if not self._is_mx_peer(dst):
+            return super()._stream_frags(dst, rreq, state)
+        # zero-copy source: the pinned user array (CMA declined) streams
+        # straight from its own memory — no tobytes() staging copy. The
+        # native call parks copies only if the receiver stops draining, so
+        # the buffer is never referenced after return (MPI completion ok).
+        if state.data is not None:
+            src = state.data
+            ptr = ctypes.cast(ctypes.c_char_p(src), _U8P)
+            n = len(src)
+        elif state.keep is not None:
+            arr = state.keep.reshape(-1).view(np.uint8)
+            ptr = arr.ctypes.data_as(_U8P)
+            n = arr.nbytes
+        else:
+            ptr, n = None, 0
+        if not n:
+            state.req.complete()
+            return
+        self._lib.mx_send_frags(self._mxh, dst, rreq, ptr, n,
+                                self._shm.max_send_size)
+        state.req.complete()
+
+    # -- recv ---------------------------------------------------------------
+
+    @_guarded
+    def irecv(self, buf, src: int = ANY_SOURCE, tag: int = ANY_TAG,
+              cid: int = 0, datatype: Optional[Datatype] = None,
+              count: Optional[int] = None) -> Request:
+        req, on_match, (arr, dt, cnt, dinfo) = \
+            self._recv_handler(buf, datatype, count)
+        if peruse.active:
+            peruse.fire(peruse.REQ_ACTIVATE, kind="recv", peer=src,
+                        tag=tag, cid=cid)
+        direct = (dinfo is None and arr is not None and cnt is not None
+                  and dt.is_contiguous and arr.flags["C_CONTIGUOUS"])
+        cap = dt.size * cnt if cnt is not None else 0
+        slot_id = next(self._slot_ids)
+        imm = _MxImm()
+        if direct:
+            ptr = arr.reshape(-1).view(np.uint8).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_uint8))
+        else:
+            ptr = None
+        rc = self._lib.mx_post_recv(self._mxh, cid, src, tag, ptr, cap,
+                                    slot_id, ctypes.byref(imm))
+        if rc == 1:
+            if peruse.active:
+                peruse.fire(peruse.REQ_MATCH_UNEX, cid=cid, src=imm.src,
+                            tag=imm.tag, seq=imm.seq)
+            if imm.kind == 1:    # payload already memcpy'd into arr
+                # ("recvs" was already counted by _recv_handler)
+                self.spc.inc("bytes_recvd", imm.blen)
+                self.spc.peer_traffic("rx", imm.src, imm.blen)
+                req.status.source = imm.src
+                req.status.tag = imm.tag
+                req.status.count = imm.blen
+                req.complete()
+            else:                # python protocol (rndv / size>cap / ...)
+                on_match(self._imm_to_unexpected(cid, imm, owned=True))
+            self.spc.inc("matches_unexpected")
+            return req
+        if peruse.active:
+            peruse.fire(peruse.REQ_INSERT_IN_POSTED_Q, cid=cid, src=src,
+                        tag=tag)
+        self._slots[slot_id] = _Slot(req, on_match, arr if direct else None,
+                                     cap)
+        req._posted_ref = (self.matching, cid, slot_id)
+        return req
+
+    # -- progress: drain native completion records --------------------------
+
+    def _mx_progress(self) -> int:
+        if peruse.active != self._mx_peruse:
+            self._mx_peruse = peruse.active
+            self._lib.mx_set_peruse(self._mxh, int(peruse.active))
+        n = self._lib.mx_progress(self._mxh)
+        if n == -2:
+            raise RuntimeError(
+                "shm rx frame exceeds the ring frame budget (protocol "
+                "bug: writer must respect max_send_size)")
+        return n + self._drain()
+
+    def _drain(self) -> int:
+        # re-entrancy guard: an event handler can feed the engine again
+        # (tcp rndv → matching.arrived → _drain); the records it queues are
+        # picked up by THIS loop's next pass — never by a nested one that
+        # would clobber the shared event buffer mid-iteration
+        if self._in_drain:
+            return 0
+        self._in_drain = True
+        lib, evbuf = self._lib, self._evbuf
+        total = 0
+        try:
+            while True:
+                k = lib.mx_drain(self._mxh, evbuf, len(evbuf))
+                for i in range(k):
+                    self._handle_event(evbuf[i])
+                total += k
+                if k == 0:
+                    break
+        finally:
+            self._in_drain = False
+        if total:
+            self._sync_stats()
+        return total
+
+    def _handle_event(self, ev: _MxEv) -> None:
+        t = ev.type
+        if t == _EV_RECV_DONE:
+            slot = self._slots.pop(ev.a, None)
+            if slot is None:
+                return
+            # ("recvs" was counted at post time by _recv_handler)
+            self.spc.inc("bytes_recvd", ev.d)
+            self.spc.peer_traffic("rx", ev.b, ev.d)
+            slot.req.status.source = ev.b
+            slot.req.status.tag = ev.c
+            slot.req.status.count = ev.d
+            slot.req.complete()
+        elif t == _EV_RECV_DATA:
+            slot = self._slots.pop(ev.a, None)
+            payload = ctypes.string_at(ev.blob, ev.blen) if ev.blob else b""
+            if ev.blob:
+                lib_free = self._lib.mx_free_blob
+                lib_free(ev.blob)
+            if slot is None:
+                return
+            header = {"k": "match", "cid": 0, "tag": ev.c, "seq": 0,
+                      "size": ev.d}
+            slot.on_match(Unexpected(ev.b, ev.c, 0, "match", header,
+                                     payload))
+        elif t == _EV_RECV_RNDV:
+            slot = self._slots.pop(ev.a, None)
+            if ev.f:             # python-held header token (cma rndv)
+                header = self._tokens.pop(ev.e)
+            else:
+                header = {"k": "rndv", "tag": ev.c, "size": ev.d,
+                          "sreq": ev.e}
+            if slot is None:
+                return
+            slot.on_match(Unexpected(ev.b, ev.c, 0, "rndv", header, b""))
+        elif t == _EV_PY_FRAME:
+            frame = ctypes.string_at(ev.blob, ev.blen) if ev.blob else b""
+            if ev.blob:
+                self._lib.mx_free_blob(ev.blob)
+            hlen = ev.a
+            tag, header = wire.decode(frame[:hlen])
+            self._shm.deliver(ev.peer, tag, header, frame[hlen:])
+        elif t == _EV_ACK:
+            self._handle_ack(ev.peer, ev.a, ev.b)
+        elif t == _EV_SINK_DONE:
+            state = self._pending_recv.pop(ev.a, None)
+            if state is None:
+                return
+            state.received = ev.b
+            if state.finish is not None:
+                state.finish()
+            state.req.complete()
+        elif t == _EV_RECV_FAILED:
+            slot = self._slots.pop(ev.a, None)
+            if slot is not None:
+                slot.req.complete(self._fail_err)
+        elif t == _EV_RECV_PENDING:
+            slot = self._slots.get(ev.a)
+            if slot is not None:
+                slot.req.set_pending(self._fail_pending_err)
+        elif t == _EV_UNEX:
+            if peruse.active:
+                peruse.fire(peruse.MSG_INSERT_IN_UNEX_Q, cid=ev.a,
+                            src=ev.b, tag=ev.c, seq=ev.e)
+
+    def _sync_stats(self) -> None:
+        """Mirror the C++ matching counters into SPC (mpit/finalize dump)."""
+        lib = self._lib
+        mp = lib.mx_stat(self._mxh, 0)
+        ua = lib.mx_stat(self._mxh, 1)
+        if mp > self._stat_base[0]:
+            self.spc.inc("matches_posted", mp - self._stat_base[0])
+            self._stat_base[0] = mp
+        if ua > self._stat_base[1]:
+            self.spc.inc("unexpected_arrivals", ua - self._stat_base[1])
+            self._stat_base[1] = ua
+
+
+def maybe_native(bootstrap, layer, engine, spc=None) -> Optional[NativeP2P]:
+    """NativeP2P when the toolchain + shm transport + var allow it."""
+    from .. import native
+
+    if not _var.get("pml_base_native", True):
+        return None
+    if not native.available():
+        return None
+    if not any(t.name == "shm" for t in layer.transports):
+        return None
+    return NativeP2P(bootstrap, layer, engine, spc=spc)

@@ -32,8 +32,10 @@ from ..core.component import component
 from . import transport as T
 from . import wire
 
-_var.register("transport", "shm", "ring_size", 1 << 21, type=int, level=4,
-              help="Bytes per directed shared-memory ring channel.")
+_var.register("transport", "shm", "ring_size", 1 << 22, type=int, level=4,
+              help="Bytes per directed shared-memory ring channel. 4 MiB "
+                   "default: the fragment path then moves 1 MiB chunks "
+                   "with few drain handoffs (bandwidth sweep, BASELINE.md).")
 
 
 def _host_key() -> str:
@@ -73,7 +75,7 @@ class ShmTransport(T.Transport):
         self._tx: Dict[int, int] = {}        # peer → handle (me→peer ring)
         self._pending: Dict[int, deque] = {}  # peer → frames awaiting space
         self._hosts: Dict[int, Optional[str]] = {}
-        self._ring = int(_var.get("transport_shm_ring_size", 1 << 21))
+        self._ring = int(_var.get("transport_shm_ring_size", 1 << 22))
         self._bell = -1
         self._tx_bells: Dict[int, int] = {}
         # cap fragments so one frame can never exceed half a ring
@@ -87,6 +89,11 @@ class ShmTransport(T.Transport):
         # memoryview refuses to index/slice-read; 'B' is the plain bytes view
         self._rxview = memoryview(self._rxbuf).cast("B")
         self._rxbody = ctypes.c_uint32(0)
+        # native-engine adoption (p2p/pmlx.py): when set, the C++ mx engine
+        # owns this transport's rings — send() routes frames through the
+        # engine's per-peer FIFO and progress() defers to mx_progress
+        self._mx = None                       # (lib, engine handle)
+        self._mx_tx_wired: set = set()
 
     def open(self) -> bool:
         return native.available()
@@ -131,6 +138,8 @@ class ShmTransport(T.Transport):
                 raise RuntimeError(
                     f"shm transport: cannot create rx ring from rank {peer}")
             self._rx[peer] = h
+            if self._mx is not None:
+                self._mx[0].mx_add_rx(self._mx[1], peer, h)
         self.size = max(self.size, new_size)
 
     def reachable(self, peer: int) -> bool:
@@ -178,9 +187,40 @@ class ShmTransport(T.Transport):
             self._lib.doorbell_post(bell)
         return rc >= 0
 
+    def adopt_mx(self, lib, eng: int) -> None:
+        """Hand the rings to the native engine: rx rings registered for
+        C++ draining; tx rings wired lazily at first send."""
+        self._mx = (lib, eng)
+        for peer, h in self._rx.items():
+            lib.mx_add_rx(eng, peer, h)
+
+    def _mx_wire_tx(self, peer: int) -> None:
+        lib, eng = self._mx
+        h = self._tx_handle(peer)
+        bell = self._tx_bells.get(peer)
+        if bell is None:
+            bell = self._lib.doorbell_open(
+                _bell_name(self._bootstrap.job_id, peer), 0)
+            self._tx_bells[peer] = bell
+        lib.mx_set_peer_tx(eng, peer, h, bell)
+        self._mx_tx_wired.add(peer)
+
     def send(self, peer: int, tag: int, header: Dict[str, Any],
              payload: bytes) -> None:
         hdr = wire.encode(tag, header)
+        if self._mx is not None:
+            if peer not in self._mx_tx_wired:
+                self._mx_wire_tx(peer)
+            if not isinstance(payload, bytes):
+                payload = bytes(payload)
+            rc = self._mx[0].mx_tx(self._mx[1], peer, hdr, len(hdr),
+                                   payload, len(payload))
+            if rc == -2:
+                raise ValueError(
+                    f"frame of {len(hdr)}+{len(payload)} bytes exceeds shm "
+                    f"ring capacity {self._ring} (raise "
+                    f"transport_shm_ring_size)")
+            return
         q = self._pending.get(peer)
         if q:
             q.append((hdr, payload))    # keep FIFO behind parked frames
@@ -191,6 +231,8 @@ class ShmTransport(T.Transport):
     # -- rx / progress ------------------------------------------------------
 
     def progress(self) -> int:
+        if self._mx is not None:
+            return 0        # the native pml's drain loop owns the rings
         n = 0
         for peer, q in list(self._pending.items()):
             while q:
@@ -227,13 +269,24 @@ class ShmTransport(T.Transport):
         return n
 
     def pending_count(self, exclude: frozenset = frozenset()) -> int:
+        if self._mx is not None:
+            lib, eng = self._mx
+            if not exclude:
+                return lib.mx_pending_tx(eng, -1)
+            return sum(lib.mx_pending_tx_peer(eng, p)
+                       for p in self._mx_tx_wired if p not in exclude)
         return sum(len(q) for p, q in self._pending.items()
                    if p not in exclude)
+
+    def _has_parked(self) -> bool:
+        if self._mx is not None:
+            return self._mx[0].mx_pending_tx(self._mx[1], -1) > 0
+        return any(self._pending.values())
 
     def idle_wait(self, timeout: float) -> None:
         """Block until a sender rings our doorbell (or timeout) — called by
         the progress engine when a wait loop goes idle."""
-        if any(self._pending.values()):
+        if self._has_parked():
             # Our own parked frames need progress, not sleep — but the
             # peer needs the core to drain its ring, so cede it instead of
             # hot-spinning (the caller's loop re-enters progress right away).

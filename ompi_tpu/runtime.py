@@ -98,7 +98,10 @@ class Context:
         self._install_idle_hook(mods)
         from .spc import Counters
         self.spc = Counters()
-        self.p2p = P2P(self.bootstrap, self.layer, self.engine, spc=self.spc)
+        from .p2p.pmlx import maybe_native
+        self.p2p = maybe_native(self.bootstrap, self.layer, self.engine,
+                                spc=self.spc) \
+            or P2P(self.bootstrap, self.layer, self.engine, spc=self.spc)
         self._comm_world = None
         self.finalized = False
         # blocking waits on this thread must pump THIS context's engine even
@@ -194,6 +197,8 @@ class Context:
             self.bootstrap.fence()
         except Exception as exc:
             output.verbose(1, "runtime", f"finalize fence failed: {exc}")
+        if hasattr(self.p2p, "finalize"):
+            self.p2p.finalize()         # native engine teardown before rings
         for t in self.layer.transports:
             t.finalize()
         self.bootstrap.finalize()
