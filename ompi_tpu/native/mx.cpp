@@ -214,7 +214,7 @@ int tx_frame(Engine& e, int32_t peer, const uint8_t* hdr, uint32_t hlen,
   int rc = shmbox_write(pt.ring, hdr, hlen, payload, (uint32_t)plen);
   if (rc == 1 && pt.bell >= 0) doorbell_post(pt.bell);
   if (rc >= 0) return 1;
-  if (rc == -2 || rc == -3) return -2;
+  if (rc == -2 || rc == -3) return rc;   // never-fits / dead handle
   pt.pending.push_back({{hdr, hdr + hlen}, {payload, payload + plen}});
   e.stats[6]++;
   return 0;
@@ -473,7 +473,8 @@ int mx_tx(int h, int32_t peer, const uint8_t* hdr, uint32_t hlen,
           const uint8_t* payload, uint64_t plen) {
   Engine* e = eng_of(h);
   if (!e) return -1;
-  return tx_frame(*e, peer, hdr, hlen, payload, plen) == -2 ? -2 : 0;
+  int rc = tx_frame(*e, peer, hdr, hlen, payload, plen);
+  return rc < 0 ? rc : 0;
 }
 
 // ONE call per eager message: pack header + ring write + doorbell
@@ -491,8 +492,9 @@ int mx_send_eager(int h, int32_t peer, int64_t cid, int64_t tag,
   w.seq = seq;
   w.size = plen;
   e->stats[2]++;
-  return tx_frame(*e, peer, reinterpret_cast<uint8_t*>(&w), sizeof(w),
-                  payload, plen) == -2 ? -2 : 0;
+  int rc = tx_frame(*e, peer, reinterpret_cast<uint8_t*>(&w), sizeof(w),
+                    payload, plen);
+  return rc < 0 ? rc : 0;
 }
 
 // stream an entire fragment train in one call (sender bandwidth path).
@@ -501,6 +503,9 @@ int mx_send_eager(int h, int32_t peer, int64_t cid, int64_t tag,
 // ring into its registered sink; only after 10 ms of no progress do frames
 // fall back to park-copies (keeps a deadlocked/slow peer from stalling the
 // caller forever, at the price of the copy).
+// returns 0 on success (every chunk written or parked), -2/-3 when the
+// ring can never take a chunk / the handle is dead — callers must fail the
+// send request, not report success
 int mx_send_frags(int h, int32_t peer, int64_t rreq, const uint8_t* data,
                   uint64_t len, uint64_t chunk) {
   Engine* e = eng_of(h);
@@ -535,7 +540,7 @@ int mx_send_frags(int h, int32_t peer, int64_t rreq, const uint8_t* data,
         sent = true;
         break;
       }
-      if (rc == -2 || rc == -3) return -1;   // can never fit / bad handle
+      if (rc == -2 || rc == -3) return rc;   // can never fit / bad handle
       if (!posted && pt.bell >= 0) {
         doorbell_post(pt.bell);              // ring is full: wake the peer
         posted = true;
@@ -543,8 +548,10 @@ int mx_send_frags(int h, int32_t peer, int64_t rreq, const uint8_t* data,
       if (now_us() - last_progress > 10000) break;
       sched_yield();
     }
-    if (!sent)
-      tx_frame(*e, peer, hdr, sizeof(w), data + off, n);
+    if (!sent) {
+      int rc = tx_frame(*e, peer, hdr, sizeof(w), data + off, n);
+      if (rc < 0) return rc;
+    }
   }
   return 0;
 }

@@ -5,8 +5,9 @@
 the byte transports, with active-message emulation where the transport has no
 native put/get (opal/mca/btl/base/btl_base_am_rdma.c:1203-1207) — which on
 the host data plane here is always.  Device-resident one-sided access rides
-the ICI instead: see ``ompi_tpu.parallel`` (ppermute/all_to_all are the
-TPU-native remote-memory primitives).
+the ICI instead: ``DeviceWindow`` (osc/device.py) keeps the window in HBM
+shards and executes each access epoch as one compiled XLA program over the
+mesh — the osc/rdma role, redesigned for the epoch≙program correspondence.
 """
 
 from .window import (
@@ -22,4 +23,14 @@ from .window import (
 
 __all__ = ["Window", "DynamicWindow", "win_allocate", "win_create",
            "win_create_dynamic", "win_allocate_shared",
-           "LOCK_SHARED", "LOCK_EXCLUSIVE"]
+           "LOCK_SHARED", "LOCK_EXCLUSIVE",
+           "DeviceWindow", "DeviceGetHandle", "win_allocate_device"]
+
+
+def __getattr__(name):
+    # lazy: osc.device imports jax; host-only users of osc.window
+    # (launcher paths, no-accelerator hosts) must not pay for it
+    if name in ("DeviceWindow", "DeviceGetHandle", "win_allocate_device"):
+        from . import device
+        return getattr(device, name)
+    raise AttributeError(name)

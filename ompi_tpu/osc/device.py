@@ -1,0 +1,319 @@
+"""Device-resident one-sided windows: RMA on HBM over ICI.
+
+The reference's osc/rdma runs windows directly on registered (incl. GPU)
+memory, with put/get/accumulate landing in the remote buffer without host
+staging (``ompi/mca/osc/rdma/osc_rdma.h:133``,
+``ompi/mca/osc/rdma/osc_rdma_comm.c:1``). The TPU has no one-sided NIC verb
+— remote HBM is reached through compiled XLA programs over ICI — so the
+TPU-first redesign maps MPI's *epoch* model onto XLA's *program* model:
+
+  * the window's memory is ONE jax array of shape (nranks, *shape), sharded
+    over the mesh axis — each rank's slice lives in its chip's HBM;
+  * put/get/accumulate inside an access epoch are **recorded**, not
+    executed (MPI already forbids reading a target location that the same
+    epoch writes, so deferral is invisible to a correct program);
+  * the closing synchronization (``fence`` / PSCW ``complete``) executes
+    the whole epoch as ONE jitted program — indexed updates + gathers on
+    the sharded array, whose cross-shard moves XLA lowers to ICI
+    collectives/permutes. The window buffer is donated, so the update is
+    in-place in HBM: no host staging anywhere in the fence path.
+  * an executable cache keyed by the epoch's op *signature* (kinds,
+    targets, offsets, shapes — not values) makes steady-state epochs
+    (stencil exchanges, halo updates) a single cached-executable launch,
+    the same role the per-(shape,op) cache plays in DeviceComm.
+
+``get`` returns a ``DeviceGetHandle`` whose ``.value`` is a device array
+valid after the closing sync — the MPI completion rule made explicit.
+
+Synchronization surface mirrors the host windows (fence, post/start/
+complete/wait, and lock/unlock degenerating to epochs): in the
+single-controller SPMD model every sync point is a program boundary, so
+active-target epochs map exactly; passive target keeps host-window
+semantics (use the AM-emulation `Window` for that — the reference keeps
+its AM fallback for the same reason, ``btl_base_am_rdma.c:1203``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..op import SUM, Op
+
+# device kernels per wire name: numpy ufuncs reject tracers, so the epoch
+# program combines with jnp (≙ the op/avx table's device column, op.h:503)
+_JNP_OPS = {
+    "sum": lambda old, new: old + new,
+    "prod": lambda old, new: old * new,
+    "max": jnp.maximum,
+    "min": jnp.minimum,
+    "land": lambda old, new: (old.astype(bool) & new.astype(bool)
+                              ).astype(old.dtype),
+    "lor": lambda old, new: (old.astype(bool) | new.astype(bool)
+                             ).astype(old.dtype),
+    "lxor": lambda old, new: (old.astype(bool) ^ new.astype(bool)
+                              ).astype(old.dtype),
+    "band": lambda old, new: old & new,
+    "bor": lambda old, new: old | new,
+    "bxor": lambda old, new: old ^ new,
+    "replace": lambda old, new: new,
+    "no_op": lambda old, new: old,
+}
+
+
+class DeviceGetHandle:
+    """Deferred get result: ``.value`` is defined after the epoch closes
+    (MPI_Get completes at the closing synchronization)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value: Optional[jax.Array] = None
+
+
+def _combine(name: str, old, new):
+    fn = _JNP_OPS.get(name)
+    if fn is None:
+        raise ValueError(f"op {name!r} has no device kernel (register a "
+                         f"jnp-compatible op in osc.device._JNP_OPS)")
+    return fn(old, new)
+
+
+class DeviceWindow:
+    """An RMA window whose memory is a sharded device array (one shard per
+    rank over ``axis``); created collectively in the single-controller
+    model. ``shape``/``dtype`` describe each rank's slice."""
+
+    def __init__(self, mesh: Mesh, shape: Sequence[int], axis: str = "x",
+                 dtype=jnp.float32, init: Optional[jax.Array] = None,
+                 name: str = "devwin") -> None:
+        self.mesh = mesh
+        self.axis = axis
+        self.nranks = mesh.shape[axis]
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(dtype)
+        self.name = name
+        self.sharding = NamedSharding(mesh, P(axis))
+        if init is not None:
+            init = jnp.asarray(init, self.dtype)
+            if init.shape != (self.nranks, *self.shape):
+                raise ValueError(
+                    f"init shape {init.shape} != {(self.nranks, *self.shape)}")
+            self.array = jax.device_put(init, self.sharding)
+        else:
+            self.array = jax.device_put(
+                jnp.zeros((self.nranks, *self.shape), self.dtype),
+                self.sharding)
+        self._ops: List[Tuple] = []        # recorded epoch operations
+        self._in_epoch = False
+        self._cache: Dict[Tuple, Any] = {}
+        self._pscw_targets: Optional[list] = None
+
+    # -- epoch recording -----------------------------------------------------
+
+    def _record(self, entry: Tuple) -> None:
+        if not self._in_epoch:
+            raise RuntimeError(
+                "device-window RMA outside an access epoch (call fence() "
+                "or start() first)")
+        # validate NOW, while target/offset are concrete python ints —
+        # inside the program dynamic_slice CLAMPS out-of-range starts,
+        # which would silently land the op on the wrong rank/range
+        target, offset = entry[1], entry[2]
+        n = int(np.prod(entry[3]))
+        flat_len = int(np.prod(self.shape)) if self.shape else 1
+        if not 0 <= target < self.nranks:
+            raise IndexError(
+                f"RMA target rank {target} outside [0, {self.nranks})")
+        if offset < 0 or offset + n > flat_len:
+            raise IndexError(
+                f"RMA range [{offset}, {offset + n}) outside the "
+                f"{flat_len}-element window slice")
+        self._ops.append(entry)
+
+    def _payload(self, data) -> jax.Array:
+        x = jnp.asarray(data, self.dtype)
+        return x
+
+    def put(self, target: int, data, offset: int = 0) -> None:
+        """Replace ``data.size`` elements of target's slice starting at
+        flat ``offset`` (MPI_Put)."""
+        x = self._payload(data).reshape(-1)
+        self._record(("put", int(target), int(offset), x.shape, x))
+
+    def accumulate(self, target: int, data, op: Op = SUM,
+                   offset: int = 0) -> None:
+        """MPI_Accumulate with the window-atomic op applied on the target
+        shard. Same-epoch accumulates apply in record order (MPI only
+        guarantees element-wise atomicity; the single program gives a
+        deterministic order, which is stronger)."""
+        x = self._payload(data).reshape(-1)
+        self._record(("acc", int(target), int(offset), x.shape, x, op))
+
+    def get(self, target: int, count: int, offset: int = 0) -> DeviceGetHandle:
+        """MPI_Get of ``count`` elements; handle resolves at the closing
+        sync. Reads observe the state BEFORE this epoch's updates (reading
+        a location the same epoch writes is erroneous per MPI-4 §12.7, so
+        a correct program can't tell)."""
+        h = DeviceGetHandle()
+        self._record(("get", int(target), int(offset), (int(count),), h))
+        return h
+
+    def get_accumulate(self, target: int, data, op: Op = SUM,
+                       offset: int = 0) -> DeviceGetHandle:
+        """MPI_Get_accumulate: fetch the pre-epoch value, then accumulate."""
+        x = self._payload(data).reshape(-1)
+        h = DeviceGetHandle()
+        self._record(("getacc", int(target), int(offset), x.shape, x, op, h))
+        return h
+
+    # -- epoch execution -----------------------------------------------------
+
+    def _signature(self, ops: List[Tuple]) -> Tuple:
+        """Cache key: op kinds, element counts, and op names — NOT targets
+        or offsets (those enter the program as traced scalars), so a
+        steady-state exchange pattern with moving targets (stencil halo,
+        ring rotation) reuses ONE executable."""
+        sig = []
+        for e in ops:
+            kind = e[0]
+            if kind in ("put", "get"):
+                sig.append((kind, e[3]))
+            else:                       # acc / getacc carry the op at [5]
+                sig.append((kind, e[3], e[5].name))
+        return tuple(sig)
+
+    def _run_epoch(self) -> None:
+        ops = self._ops
+        self._ops = []
+        if not ops:
+            return
+        sig = self._signature(ops)
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(sig)
+            self._cache[sig] = fn
+        args = []
+        for e in ops:
+            args.append(jnp.int32(e[1]))           # target
+            args.append(jnp.int32(e[2]))           # offset
+            if e[0] in ("put", "acc", "getacc"):
+                args.append(e[4])                  # payload
+        self.array, gets = fn(self.array, *args)
+        gi = 0
+        for e in ops:
+            if e[0] == "get":
+                e[4].value = gets[gi]
+                gi += 1
+            elif e[0] == "getacc":
+                e[6].value = gets[gi]
+                gi += 1
+
+    def _build(self, sig: Tuple):
+        """Compile one program applying the whole epoch: gathers read the
+        pre-epoch array, updates land as dynamic-slice updates — all on the
+        sharded array, so XLA inserts the ICI moves and keeps HBM
+        residency end to end."""
+        flat_len = int(np.prod(self.shape)) if self.shape else 1
+
+        def epoch(win, *args):
+            flat = win.reshape(self.nranks, flat_len)
+            pre = flat                       # gets/get_accumulate read this
+            gets = []
+            ai = 0
+            for e in sig:
+                kind = e[0]
+                n = int(np.prod(e[1]))
+                target, offset = args[ai], args[ai + 1]
+                ai += 2
+                if kind == "get":
+                    gets.append(jax.lax.dynamic_slice(
+                        pre, (target, offset), (1, n))[0])
+                    continue
+                data = args[ai]
+                ai += 1
+                if kind == "getacc":
+                    gets.append(jax.lax.dynamic_slice(
+                        pre, (target, offset), (1, n))[0])
+                old = jax.lax.dynamic_slice(flat, (target, offset), (1, n))
+                if kind == "put":
+                    new = data[None]
+                else:                        # acc / getacc: named op
+                    new = _combine(e[2], old, data[None])
+                flat = jax.lax.dynamic_update_slice(flat, new,
+                                                    (target, offset))
+            return flat.reshape(self.nranks, *self.shape), tuple(gets)
+
+        jitted = jax.jit(epoch, donate_argnums=(0,),
+                         out_shardings=(self.sharding, None))
+        return jitted
+
+    # -- synchronization (≙ osc_rdma_active_target.c) ------------------------
+
+    def fence(self, assertion: int = 0) -> None:
+        """Close the current epoch (execute it as one device program) and
+        open the next — MPI_Win_fence. The program launch is the mesh-wide
+        sync: every shard's updates are applied when it returns."""
+        if self._in_epoch:
+            self._run_epoch()
+        self._in_epoch = True
+
+    def start(self, targets: Optional[Sequence[int]] = None) -> None:
+        """Open a PSCW access epoch toward ``targets`` (MPI_Win_start)."""
+        if self._in_epoch:
+            raise RuntimeError("start() inside an open epoch")
+        self._pscw_targets = list(targets) if targets is not None else None
+        self._in_epoch = True
+
+    def complete(self) -> None:
+        """Close the PSCW access epoch (MPI_Win_complete): executes the
+        recorded ops; enforces that every op named an exposed target."""
+        if not self._in_epoch:
+            raise RuntimeError("complete() without start()")
+        if self._pscw_targets is not None:
+            bad = [e for e in self._ops if e[1] not in self._pscw_targets]
+            if bad:
+                # the epoch is erroneous: drop its ops and close it, so a
+                # caller that catches this cannot have the rejected ops
+                # silently executed by a later sync
+                self._ops = []
+                self._in_epoch = False
+                self._pscw_targets = None
+                raise RuntimeError(
+                    f"RMA to rank {bad[0][1]} outside the started group")
+        self._run_epoch()
+        self._in_epoch = False
+        self._pscw_targets = None
+
+    def post(self, origins: Optional[Sequence[int]] = None) -> None:
+        """MPI_Win_post — expose the local slice. In the single-controller
+        model exposure is implicit (the program boundary orders access);
+        kept for source parity with the host window surface."""
+
+    def wait(self) -> None:
+        """MPI_Win_wait — in this model the access side's complete() IS the
+        program launch, after which all updates are visible."""
+
+    def free(self) -> None:
+        self._cache.clear()
+        self._ops.clear()
+        self._in_epoch = False
+        self.array = None      # release the HBM shards (MPI_Win_free)
+
+    # -- direct views --------------------------------------------------------
+
+    def rank_slice(self, rank: int) -> jax.Array:
+        """Read rank's slice (valid outside an epoch — like a load from a
+        locally-exposed window)."""
+        return self.array[rank]
+
+
+def win_allocate_device(mesh: Mesh, shape, axis: str = "x",
+                        dtype=jnp.float32, init=None) -> DeviceWindow:
+    """MPI_Win_allocate with ``alloc_shared_noncontig``-style freedom: the
+    implementation owns placement — here, one HBM shard per rank."""
+    return DeviceWindow(mesh, shape, axis=axis, dtype=dtype, init=init)

@@ -148,6 +148,7 @@ class NativeMatching:
             p._lib.mx_arrived(p._mxh, src, cid, tag, seq, header["size"],
                               _K_RNDV, header.get("sreq", 0), token, b"", 0)
         p._drain()
+        p._sync_stats()          # an eventless unexpected still counts
 
     # -- debugger snapshot (debuggers.message_queues) ----------------------
 
@@ -284,24 +285,29 @@ class NativeP2P(P2P):
                 self._send_seq[key] = seq + 1
                 if dst not in self._shm._mx_tx_wired:
                     self._shm._mx_wire_tx(dst)
-                if self._lib.mx_send_eager(self._mxh, dst, cid, tag, seq,
-                                           data, len(data)) == -2:
+                rc = self._lib.mx_send_eager(self._mxh, dst, cid, tag, seq,
+                                             data, len(data))
+                if rc == -2:
                     raise ValueError(
                         f"eager frame of {len(data)} bytes exceeds the shm "
                         f"ring capacity (raise transport_shm_ring_size)")
+                if rc == -3:
+                    raise RuntimeError(
+                        f"shm ring to rank {dst} is dead (handle closed)")
+                n = len(data)
+                if peruse.active:    # activate BEFORE complete (PERUSE
+                    # pairing discipline — classic isend order)
+                    peruse.fire(peruse.REQ_ACTIVATE, kind="send", peer=dst,
+                                tag=tag, cid=cid, nbytes=n)
                 req = Request()
                 req.status.source = self.rank
                 req.status.tag = tag
-                req.status.count = len(data)
+                req.status.count = n
                 req.complete()       # eager: complete once buffered
-                n = len(data)
                 self.spc.inc("isends")
                 self.spc.inc("eager_sends")
                 self.spc.inc("bytes_sent", n)
                 self.spc.peer_traffic("tx", dst, n)
-                if peruse.active:
-                    peruse.fire(peruse.REQ_ACTIVATE, kind="send", peer=dst,
-                                tag=tag, cid=cid, nbytes=n)
                 return req
         return super().isend(buf, dst, tag, cid, datatype, count, sync)
 
@@ -325,8 +331,13 @@ class NativeP2P(P2P):
         if not n:
             state.req.complete()
             return
-        self._lib.mx_send_frags(self._mxh, dst, rreq, ptr, n,
-                                self._shm.max_send_size)
+        rc = self._lib.mx_send_frags(self._mxh, dst, rreq, ptr, n,
+                                     self._shm.max_send_size)
+        if rc < 0:
+            state.req.complete(RuntimeError(
+                f"fragment stream to rank {dst} failed "
+                f"({'dead shm ring' if rc == -3 else 'frame cannot fit'})"))
+            return
         state.req.complete()
 
     # -- recv ---------------------------------------------------------------
@@ -387,7 +398,13 @@ class NativeP2P(P2P):
             raise RuntimeError(
                 "shm rx frame exceeds the ring frame budget (protocol "
                 "bug: writer must respect max_send_size)")
-        return n + self._drain()
+        drained = self._drain()
+        if n and not drained:
+            # frames moved without producing events (eager→unexpected with
+            # peruse off is eventless): still mirror the C++ counters so
+            # SPC/mpit never under-report unexpected_arrivals
+            self._sync_stats()
+        return n + drained
 
     def _drain(self) -> int:
         # re-entrancy guard: an event handler can feed the engine again

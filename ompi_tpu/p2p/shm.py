@@ -220,6 +220,9 @@ class ShmTransport(T.Transport):
                     f"frame of {len(hdr)}+{len(payload)} bytes exceeds shm "
                     f"ring capacity {self._ring} (raise "
                     f"transport_shm_ring_size)")
+            if rc == -3:
+                raise RuntimeError(
+                    f"shm ring to rank {peer} is dead (handle closed)")
             return
         q = self._pending.get(peer)
         if q:

@@ -332,3 +332,128 @@ def test_window_info_accessors():
         return True
 
     assert all(runtime.run_ranks(2, fn))
+
+
+# ---------------------------------------------------------------------------
+# device-resident windows (osc/device.py): RMA on the sharded HBM array,
+# each epoch one compiled program over the 8-device CPU mesh
+# ---------------------------------------------------------------------------
+
+class TestDeviceWindow:
+    def _win(self, shape=(8,), dtype=None, init=None):
+        import jax.numpy as jnp
+        from ompi_tpu.osc import win_allocate_device
+        from ompi_tpu.parallel import make_mesh
+        mesh = make_mesh({"x": 8})
+        return win_allocate_device(mesh, shape, axis="x",
+                                   dtype=dtype or jnp.float32, init=init)
+
+    def test_fence_put_get(self):
+        import numpy as np
+        win = self._win()
+        win.fence()
+        win.put(3, np.arange(8, dtype=np.float32))       # fill rank 3
+        win.put(5, np.full(4, 7.0, np.float32), offset=2)
+        h = win.get(3, count=8)
+        win.fence()
+        # get saw the PRE-epoch state (zeros) — MPI completion semantics
+        np.testing.assert_array_equal(np.asarray(h.value), np.zeros(8))
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(3)),
+                                      np.arange(8))
+        got5 = np.asarray(win.rank_slice(5))
+        np.testing.assert_array_equal(got5[2:6], np.full(4, 7.0))
+        np.testing.assert_array_equal(got5[:2], np.zeros(2))
+        # second epoch reads what the first wrote
+        win.fence()
+        h2 = win.get(3, count=4, offset=4)
+        win.fence()
+        np.testing.assert_array_equal(np.asarray(h2.value),
+                                      np.arange(4, 8))
+
+    def test_fence_accumulate_and_ops(self):
+        import numpy as np
+        from ompi_tpu.op import MAX
+        win = self._win(shape=(4,))
+        win.fence()
+        win.accumulate(2, np.ones(4, np.float32))
+        win.accumulate(2, np.full(4, 2.0, np.float32))   # same epoch: sums
+        win.accumulate(6, np.full(2, -5.0, np.float32), op=MAX, offset=1)
+        win.fence()
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(2)),
+                                      np.full(4, 3.0))
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(6)),
+                                      np.zeros(4))       # max(0, -5) = 0
+
+    def test_get_accumulate_fetch_semantics(self):
+        import numpy as np
+        win = self._win(shape=(2,),
+                        init=np.tile(np.arange(2, dtype=np.float32),
+                                     (8, 1)) + 10)
+        win.fence()
+        h = win.get_accumulate(4, np.ones(2, np.float32))
+        win.fence()
+        np.testing.assert_array_equal(np.asarray(h.value), [10., 11.])
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(4)),
+                                      [11., 12.])
+
+    def test_pscw_epoch(self):
+        import numpy as np
+        import pytest as _pytest
+        win = self._win(shape=(2,))
+        win.post([0])                 # exposure side (bookkeeping)
+        win.start([1, 2])
+        win.put(1, np.array([5., 6.], np.float32))
+        win.accumulate(2, np.array([1., 1.], np.float32))
+        win.complete()
+        win.wait()
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(1)),
+                                      [5., 6.])
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(2)),
+                                      [1., 1.])
+        # access outside the started group is the MPI error case; the
+        # erroneous epoch's ops are dropped, not deferred to a later sync
+        win.start([1])
+        win.put(3, np.full(2, 9.0, np.float32))
+        with _pytest.raises(RuntimeError, match="outside the started"):
+            win.complete()
+        win.fence()
+        win.fence()
+        np.testing.assert_array_equal(np.asarray(win.rank_slice(3)),
+                                      [0., 0.])
+
+    def test_out_of_range_rma_rejected_at_record(self):
+        import numpy as np
+        import pytest as _pytest
+        win = self._win(shape=(4,))
+        win.fence()
+        with _pytest.raises(IndexError, match="target rank"):
+            win.put(8, np.zeros(4, np.float32))
+        with _pytest.raises(IndexError, match="outside the"):
+            win.put(0, np.zeros(4, np.float32), offset=2)
+
+    def test_epoch_is_one_cached_program_no_host_staging(self):
+        import jax
+        import numpy as np
+        win = self._win(shape=(16,))
+        data = jax.device_put(np.arange(16, dtype=np.float32))
+        for i in range(3):            # identical signature → 1 executable
+            win.fence()
+            win.put((i + 1) % 8, data)
+            win.get(0, count=16)
+            win.fence()
+        assert len(win._cache) == 1
+        # device residency: the epoch result and get values live on device
+        # with the window's sharding — nothing came back to host
+        assert win.array.sharding == win.sharding
+        h = None
+        win.fence()
+        h = win.get(2, count=16)
+        win.fence()
+        assert isinstance(h.value, jax.Array)
+
+    def test_rma_outside_epoch_raises(self):
+        import numpy as np
+        import pytest as _pytest
+        win = self._win()
+        with _pytest.raises(RuntimeError, match="epoch"):
+            win.put(0, np.zeros(8, np.float32))
