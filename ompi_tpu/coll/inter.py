@@ -266,3 +266,103 @@ class InterColl:
             remote_red = remote_red.reshape(lc.size, -1)
         lc.coll.scatter(lc, remote_red, recvbuf, root=0)
         return recvbuf
+
+
+class InterXlaColl(InterColl):
+    """Device-aware intercomm collectives: the hierarchical ICI/DCN shape
+    of two TPU slices bridged by their hosts. When the buffers are device
+    arrays and this side's local_comm carries a mesh, the intra-group
+    phases run as compiled XLA programs over the local mesh (ICI — the
+    expensive O(local_size) part), and only ONE already-reduced buffer
+    crosses the group boundary through the leaders' host path (the DCN
+    analog). ≙ ompi/mca/coll/inter/coll_inter_allreduce.c:1 composed with
+    the coll/xla device dispatch; attach via parallel.attach_mesh on the
+    intercommunicator.
+
+    Host buffers fall through to the plain InterColl table unchanged."""
+
+    def _device_ready(self, comm, buf) -> bool:
+        from .xla import _is_device
+        lc = comm.local_comm
+        return (lc is not None and getattr(lc, "device_comm", None)
+                is not None and _is_device(buf))
+
+    def allreduce(self, comm, sendbuf, recvbuf=None, op: Op = None):
+        """Each side receives the reduction of the REMOTE group; the local
+        reduction runs on the mesh, leaders bridge one vector."""
+        from ..comm import TAG_INTER_COLL
+        # an explicit recvbuf is a host-contract request (the device path
+        # returns a fresh device array and never fills one)
+        if recvbuf is not None or not self._device_ready(comm, sendbuf):
+            return super().allreduce(comm, sendbuf, recvbuf, op)
+        import jax
+        import jax.numpy as jnp
+        op = op or SUM
+        lc = self._lc(comm)
+        dc = lc.device_comm
+        loc = dc.allreduce(sendbuf, op)          # ICI: local reduction
+        # leaders swap ONE reduced row on the host bridge (DCN analog)
+        row = np.asarray(jax.device_get(loc))[:1]
+        remote = np.empty_like(row)
+        if lc.rank == 0:
+            comm.sendrecv(np.ascontiguousarray(row), 0, remote, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        remote = lc.coll.bcast(lc, remote, root=0)
+        # replicate the remote reduction back across the local mesh rows
+        rows = np.broadcast_to(remote, np.asarray(loc).shape)
+        return jax.device_put(jnp.asarray(rows), dc.sharding())
+
+    def bcast(self, comm, buf, root: int = 0):
+        """Rooted device bcast: the receiving side lands the root's buffer
+        in row 0 and broadcasts it across its mesh on ICI."""
+        from ..comm import PROC_NULL, ROOT, TAG_INTER_COLL
+        if not self._device_ready(comm, buf):
+            return super().bcast(comm, buf, root)
+        import jax
+        import jax.numpy as jnp
+        lc = self._lc(comm)
+        dc = lc.device_comm
+        if root == PROC_NULL:
+            return buf
+        if root == ROOT:
+            comm.send(np.asarray(jax.device_get(buf))[0], 0,
+                      TAG_INTER_COLL)
+            return buf
+        host = np.asarray(jax.device_get(buf))
+        if lc.rank == 0:
+            row0 = np.empty_like(host[0])
+            comm.recv(row0, root, TAG_INTER_COLL)
+            host = np.broadcast_to(row0, host.shape)
+        host = lc.coll.bcast(lc, np.ascontiguousarray(host), root=0)
+        return jax.device_put(jnp.asarray(host), dc.sharding())
+
+    def allgather(self, comm, sendbuf, recvbuf=None):
+        """Every rank receives the REMOTE group's concatenation; the local
+        gather runs on the mesh, leaders bridge the concatenated matrix."""
+        from ..comm import TAG_INTER_COLL
+        # recvbuf given → host contract (and the only way to express
+        # asymmetric per-side shapes); device path handles the symmetric
+        # no-recvbuf case
+        if recvbuf is not None or not self._device_ready(comm, sendbuf):
+            return super().allgather(comm, sendbuf, recvbuf)
+        import jax
+        import jax.numpy as jnp
+        lc = self._lc(comm)
+        dc = lc.device_comm
+        # local mesh gather: (r, *e) → every row holds (r, *e) concat
+        gathered = dc.allgather(
+            sendbuf.reshape(sendbuf.shape[0], 1, *sendbuf.shape[1:]))
+        local_cat = np.asarray(jax.device_get(gathered))[0]
+        # the device ROWS play the rank role here; the bridge is sized
+        # symmetrically (the recvbuf gate above routes asymmetric slices
+        # to the host path, which sizes from the recv contract)
+        remote_cat = np.empty_like(local_cat)
+        if lc.rank == 0:
+            comm.sendrecv(np.ascontiguousarray(local_cat), 0,
+                          remote_cat, 0,
+                          sendtag=TAG_INTER_COLL, recvtag=TAG_INTER_COLL)
+        remote_cat = lc.coll.bcast(lc, remote_cat, root=0)
+        rows = np.broadcast_to(
+            remote_cat.reshape(1, -1),
+            (np.asarray(sendbuf).shape[0], remote_cat.size))
+        return jax.device_put(jnp.asarray(rows), dc.sharding())

@@ -30,6 +30,7 @@ from .p2p.request import ANY_SOURCE, ANY_TAG, Request
 # another subsystem's range.
 TAG_INTER_COLL = -14
 TAG_INTERCOMM_BASE = -50000        # handshake band: -50000 .. -50999
+TAG_INTER_SPLIT = -51001           # intercomm split leader exchange
 
 # intercomm rooted-collective sentinels (≙ MPI_ROOT / MPI_PROC_NULL)
 ROOT = -3
@@ -327,9 +328,7 @@ class Communicator:
         after a shrink only survivors saw) re-converge. No root, no serial
         O(p) message chain, no probe timeout path (round-1 weak #5)."""
         if self.is_inter:
-            raise NotImplementedError(
-                "split on an intercommunicator is not supported; merge() "
-                "it first (dup() on intercomms is supported)")
+            return self._split_inter(color, key, name)
         if getattr(self.ctx, "spc", None) is not None:
             self.ctx.spc.inc("comm_splits")
         undef = -(1 << 62)
@@ -351,6 +350,83 @@ class Communicator:
         world_ranks = [int(rows[r, 2]) for _k, r in members]
         return self._inherit(Communicator(self.ctx, Group(world_ranks), cid,
                                           name or f"{self.name}.split"))
+
+    def _split_inter(self, color, key: int,
+                     name: Optional[str]) -> Optional["Communicator"]:
+        """MPI_Comm_split on an intercommunicator (MPI-4 §7.4.2; reference
+        ``ompi/communicator/comm.c`` ompi_comm_split intercomm branch):
+        every member of BOTH groups supplies (color, key); the result for a
+        rank is an intercommunicator whose local group is its side's
+        same-color members and whose remote group is the other side's —
+        a color present on only one side yields MPI_COMM_NULL (None) there.
+
+        Structure: local split for the new local_comm, one local allgather
+        of (color, key, world_rank), leaders swap the tables plus CID
+        proposals over the parent intercomm, local bcast, then every rank
+        of both sides computes identical groups and CIDs."""
+        lc = self.local_comm
+        if lc is None:
+            raise RuntimeError(
+                f"intercomm {self.name} has no local_comm attached")
+        new_local = lc.split(color, key,
+                             name=f"{name or self.name}.local")
+        undef = -(1 << 62)
+        color_wire = undef if color is None else int(color)
+        # one allgather carries (color, key, world_rank, cid_counter) —
+        # the same packing the intracomm split uses
+        mine = np.array([color_wire, int(key), self.ctx.rank,
+                         lc._cid_counter], np.int64)
+        table = np.asarray(lc.coll.allgather(lc, mine))      # (lsize, 4)
+        rows = table[:, :3]
+        prop = int(table[:, 3].max())
+        wire_tag = TAG_INTER_SPLIT
+        if lc.rank == 0:
+            # isend-then-recv, like create_intercomm: two leaders both
+            # blocking-sending would deadlock past the eager limit
+            payload = np.concatenate(
+                [np.array([prop, rows.shape[0]], np.int64),
+                 rows.reshape(-1)])
+            sreq = self.isend(payload, 0, wire_tag)
+            st = self.probe(0, wire_tag, timeout=60)
+            if st is None:
+                raise RuntimeError(
+                    f"intercomm split on {self.name}: no reply from the "
+                    f"remote leader within 60s")
+            other = np.zeros(st["count"] // 8, np.int64)
+            self.recv(other, 0, wire_tag)
+            sreq.wait()
+        else:
+            other = None
+        n = np.array([0 if other is None else len(other)], np.int64)
+        n = lc.coll.bcast(lc, n, root=0)
+        if other is None:
+            other = np.zeros(int(n[0]), np.int64)
+        other = lc.coll.bcast(lc, other, root=0)
+        rprop, rn = int(other[0]), int(other[1])
+        rrows = np.asarray(other[2:2 + rn * 3]).reshape(rn, 3)
+        base = max(prop, rprop)
+        lcolors = {int(c) for c in rows[:, 0] if c != undef}
+        rcolors = {int(c) for c in rrows[:, 0] if c != undef}
+        both = sorted(lcolors & rcolors)
+        with lc._lock:
+            # both sides reserve the same CID band, keeping later
+            # allocations on the two sides from colliding
+            lc._cid_counter = max(lc._cid_counter,
+                                  base + max(len(both), 1))
+        if color is None or int(color) not in both:
+            return None       # MPI_COMM_NULL: no counterpart group
+
+        def carve(table):
+            members = sorted((int(table[r, 1]), r)
+                             for r in range(table.shape[0])
+                             if int(table[r, 0]) == int(color))
+            return [int(table[r, 2]) for _k, r in members]
+
+        cid = base + both.index(int(color))
+        return self._inherit(Communicator(
+            self.ctx, Group(carve(rows)), cid,
+            name or f"{self.name}.split",
+            remote_group=Group(carve(rrows)), local_comm=new_local))
 
     def _inherit(self, child: "Communicator") -> "Communicator":
         """New communicators inherit the parent's error handler (MPI-4

@@ -39,7 +39,31 @@ from .collectives import (  # noqa: F401
 
 def attach_mesh(comm, mesh, axis: str) -> None:
     """Give a communicator a device mesh, enabling the coll/xla component
-    (re-runs coll selection so xla outranks the host components)."""
+    (re-runs coll selection so xla outranks the host components).
+
+    On an INTERcommunicator the mesh describes this side's local group;
+    collectives then take the hierarchical ICI/DCN shape (InterXlaColl):
+    intra-group phases as XLA programs over this mesh, leader bridge on
+    the host path. Each side attaches its own mesh — two slices."""
+    if comm.is_inter:
+        lc = comm.local_comm
+        if lc is None:
+            raise ValueError(
+                f"intercomm {comm.name} has no local_comm to carry a mesh")
+        if getattr(lc, "device_comm", None) is None:
+            attach_mesh(lc, mesh, axis)
+        elif lc.device_mesh is not mesh or lc.device_axis != axis:
+            # the collectives run on the local_comm's mesh — recording a
+            # different one here would silently diverge from reality
+            raise ValueError(
+                f"intercomm {comm.name}: local_comm already carries mesh "
+                f"axis {lc.device_axis!r}; detach or pass the same mesh")
+        comm.device_mesh = lc.device_mesh
+        comm.device_axis = lc.device_axis
+        from ..coll.inter import InterXlaColl
+
+        comm.coll = InterXlaColl()
+        return
     if comm.size != 1 and mesh.shape[axis] != comm.size:
         raise ValueError(
             f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "

@@ -27,7 +27,7 @@ ranks) are fully independent.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from .control import Bootstrap, from_environment
 from .core.component import frameworks
@@ -114,6 +114,7 @@ class Context:
             memchecker.install(self)    # --mca memchecker_enabled 1
         from . import hook
         hook.fire("init_bottom", self)   # ≙ mca/hook mpi_init hooks
+        _ctx_opened()                    # interlib: a runtime is now live
         if self._async_progress:
             import time as _time
 
@@ -158,6 +159,7 @@ class Context:
         if self.finalized:
             return
         self.finalized = True
+        _ctx_closed()
         if self._prog_thread is not None:
             # pump loop exits on the finalized flag; rejoin so the rest of
             # finalize (drain, fence) runs back under the FUNNELED contract
@@ -312,3 +314,72 @@ def run_ranks(n: int, fn: Callable[[Context], object],
         if exc is not None:
             raise exc
     return results
+
+
+# ---------------------------------------------------------------------------
+# interlib: multi-runtime coordination (≙ ompi/interlib/interlib.c:1)
+# ---------------------------------------------------------------------------
+# The reference lets independently-written libraries in one process declare
+# their use of the MPI runtime (via MPI_T init under the covers) so init/
+# finalize and thread levels compose instead of colliding. The analog here:
+# an embedding framework (a serving stack, another collective library)
+# declares itself before using ompi_tpu, and can query who else is resident
+# and whether a Context is live, instead of guessing from side effects.
+
+_interlib: Dict[str, dict] = {}
+_interlib_lock = threading.Lock()
+_n_live_contexts = 0
+
+
+def _ctx_opened() -> None:
+    global _n_live_contexts
+    with _interlib_lock:
+        _n_live_contexts += 1
+
+
+def _ctx_closed() -> None:
+    global _n_live_contexts
+    with _interlib_lock:
+        _n_live_contexts = max(0, _n_live_contexts - 1)
+
+
+def _live_contexts() -> int:
+    with _interlib_lock:
+        return _n_live_contexts
+
+THREAD_SINGLE = 0
+THREAD_FUNNELED = 1
+THREAD_SERIALIZED = 2
+THREAD_MULTIPLE = 3
+
+
+def interlib_declare(name: str, version: str = "",
+                     thread_level: int = THREAD_MULTIPLE) -> None:
+    """Declare a co-resident runtime/library (≙ ompi_interlib_declare).
+    Re-declaring the same name updates its record; the effective process
+    thread level is the MINIMUM of every declaration (the most restrictive
+    resident library wins, like MPI_Init_thread's provided level)."""
+    with _interlib_lock:
+        _interlib[str(name)] = {"version": str(version),
+                                "thread_level": int(thread_level)}
+
+
+def interlib_withdraw(name: str) -> bool:
+    """Remove a declaration (library unloaded/finalized)."""
+    with _interlib_lock:
+        return _interlib.pop(str(name), None) is not None
+
+
+def interlib_query() -> dict:
+    """Who shares this process: declared libraries, the effective thread
+    level, and whether any ompi_tpu runtime is currently live (init()'s
+    singleton OR directly-constructed / run_ranks Contexts — the count is
+    maintained by Context init/finalize)."""
+    with _interlib_lock:
+        libs = {k: dict(v) for k, v in _interlib.items()}
+    levels = [v["thread_level"] for v in libs.values()]
+    return {
+        "libraries": libs,
+        "thread_level": min(levels) if levels else THREAD_MULTIPLE,
+        "runtime_active": _live_contexts() > 0,
+    }

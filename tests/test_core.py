@@ -194,3 +194,32 @@ def test_launcher_bind_env():
     assert "OMPI_TPU_BIND_CPUS" in env
     env2 = build_env({}, rank=0, size=2, coord="h:1", job="j", mca=[])
     assert "OMPI_TPU_BIND_CPUS" not in env2
+
+
+def test_interlib_declare_query_withdraw():
+    """interlib (≙ ompi/interlib/interlib.c): co-resident runtimes declare
+    themselves; the effective thread level is the most restrictive; query
+    reports whether an ompi_tpu Context is live."""
+    from ompi_tpu import runtime
+
+    runtime.interlib_declare("serving-stack", "1.2",
+                             runtime.THREAD_MULTIPLE)
+    runtime.interlib_declare("legacy-lib", "0.9",
+                             runtime.THREAD_FUNNELED)
+    q = runtime.interlib_query()
+    assert set(q["libraries"]) >= {"serving-stack", "legacy-lib"}
+    assert q["thread_level"] == runtime.THREAD_FUNNELED
+
+    def fn(ctx):
+        inner = runtime.interlib_query()
+        # a live Context (run_ranks-created, not just init()'s singleton)
+        # must report the runtime active — the collision interlib prevents
+        assert inner["runtime_active"] is True
+        return inner["libraries"]["serving-stack"]["version"]
+
+    assert runtime.run_ranks(1, fn) == ["1.2"]
+    assert runtime.interlib_withdraw("legacy-lib")
+    assert not runtime.interlib_withdraw("legacy-lib")
+    assert runtime.interlib_query()["thread_level"] == \
+        runtime.THREAD_MULTIPLE
+    runtime.interlib_withdraw("serving-stack")
