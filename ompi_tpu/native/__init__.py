@@ -146,8 +146,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.mx_send_eager.argtypes = [i, i32, i64, i64, u32, chp, u64]
     lib.mx_send_eager.restype = i
     # u8p (not c_char_p) so numpy arrays stream zero-copy via .ctypes
-    lib.mx_send_frags.argtypes = [i, i32, i64, u8p, u64, u64]
+    lib.mx_send_frags.argtypes = [i, i32, i64, u8p, u64, u64, u64]
     lib.mx_send_frags.restype = i
+    lib.mx_sink_credit.argtypes = [i, i64, u64, u64]
+    lib.mx_sink_credit.restype = i
     lib.mx_post_recv.argtypes = [i, i64, i32, i64, u8p, u64, i64,
                                  ctypes.c_void_p]
     lib.mx_post_recv.restype = i

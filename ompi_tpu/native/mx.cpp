@@ -129,8 +129,33 @@ struct Unex {
 struct Sink {
   uint8_t* buf;
   uint64_t total;
-  uint64_t received;
+  uint64_t received;                    // covered bytes (deduplicated)
+  // merged covered intervals: striping/failover may DUPLICATE fragments
+  // (idempotent replays), so coverage — not byte count — defines done
+  std::map<uint64_t, uint64_t> ivals;   // start → end (exclusive)
 };
+
+// merge [off, off+len) into the sink's coverage; updates received
+void sink_cover(Sink& s, uint64_t off, uint64_t len) {
+  uint64_t start = off, end = off + len;
+  auto it = s.ivals.lower_bound(start);
+  if (it != s.ivals.begin()) {
+    auto prev = std::prev(it);
+    if (prev->second >= start) {
+      start = prev->first;
+      end = std::max(end, prev->second);
+      it = prev;
+    }
+  }
+  while (it != s.ivals.end() && it->first <= end) {
+    end = std::max(end, it->second);
+    it = s.ivals.erase(it);
+  }
+  s.ivals[start] = end;
+  uint64_t covered = 0;
+  for (auto& [a, b] : s.ivals) covered += b - a;
+  s.received = covered;
+}
 
 struct PendingTx {            // parked frame awaiting ring space
   std::vector<uint8_t> hdr;
@@ -372,7 +397,7 @@ void process_frame(Engine& e, int32_t peer, const uint8_t* hdr,
         uint64_t off = (uint64_t)w.b;
         if (off + plen <= s.total) {
           memcpy(s.buf + off, payload, plen);
-          s.received += plen;
+          sink_cover(s, off, plen);
           e.stats[4]++;
           e.stats[5] += plen;
           if (s.received >= s.total) {
@@ -505,9 +530,10 @@ int mx_send_eager(int h, int32_t peer, int64_t cid, int64_t tag,
 // caller forever, at the price of the copy).
 // returns 0 on success (every chunk written or parked), -2/-3 when the
 // ring can never take a chunk / the handle is dead — callers must fail the
-// send request, not report success
+// send request, not report success. ``base`` is the receiver-side offset
+// of data[0] (striping sends sub-ranges of the message).
 int mx_send_frags(int h, int32_t peer, int64_t rreq, const uint8_t* data,
-                  uint64_t len, uint64_t chunk) {
+                  uint64_t len, uint64_t chunk, uint64_t base) {
   Engine* e = eng_of(h);
   if (!e || chunk == 0) return -1;
   PeerTx& pt = e->tx[peer];
@@ -527,7 +553,7 @@ int mx_send_frags(int h, int32_t peer, int64_t rreq, const uint8_t* data,
     WireP2P w;
     memset(&w, 0, sizeof(w));
     w.fmt = kFmtP2P; w.am_tag = kAmP2P; w.kind = kFrag;
-    w.a = rreq; w.b = (int64_t)off;
+    w.a = rreq; w.b = (int64_t)(base + off);
     const uint8_t* hdr = reinterpret_cast<uint8_t*>(&w);
     bool sent = false;
     bool posted = false;
@@ -651,7 +677,24 @@ int mx_probe(int h, int64_t cid, int32_t src, int64_t tag, int remove,
 // register a contiguous fragment sink (receiver side of the frag train)
 void mx_add_sink(int h, int64_t rreq, uint8_t* buf, uint64_t total) {
   Engine* e = eng_of(h);
-  if (e) e->sinks[rreq] = {buf, total, 0};
+  if (e) e->sinks[rreq] = {buf, total, 0, {}};
+}
+
+// credit coverage delivered OUTSIDE the engine (a striped fragment that
+// arrived on a python-side transport and was unpacked there). Returns 1
+// when the sink just completed (caller finishes the request; no
+// EV_SINK_DONE is queued), 0 when still open, -1 when unknown.
+int mx_sink_credit(int h, int64_t rreq, uint64_t off, uint64_t len) {
+  Engine* e = eng_of(h);
+  if (!e) return -1;
+  auto it = e->sinks.find(rreq);
+  if (it == e->sinks.end()) return -1;
+  sink_cover(it->second, off, len);
+  if (it->second.received >= it->second.total) {
+    e->sinks.erase(it);
+    return 1;
+  }
+  return 0;
 }
 
 // feed a frame that arrived on a python-side transport (tcp/self) or a

@@ -65,6 +65,27 @@ def _guarded(fn):
     return wrapped
 
 
+_var.register("bml", "r2", "striping", "auto", type=str, level=4,
+              help="Stripe rendezvous fragment trains across every "
+                   "transport that reaches the peer, weighted by bandwidth "
+                   "class (bml.h:57-72 scheduling; failed paths retire and "
+                   "their ranges replay on survivors either way). "
+                   "auto = stripe only with >1 usable CPU: on a 1-core "
+                   "host the paths serialize and striping measurably "
+                   "loses (BASELINE.md); 1/0 force it on/off.")
+
+
+def _striping_on() -> bool:
+    v = str(_var.get("bml_r2_striping", "auto")).lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    import os
+    try:
+        return len(os.sched_getaffinity(0)) > 1
+    except (AttributeError, OSError):
+        return (os.cpu_count() or 1) > 1
 _var.register("smsc", "", "enabled", True, type=bool, level=4,
               help="Allow CMA single-copy rendezvous over shared memory "
                    "(≙ the smsc/cma component; disable to force the "
@@ -112,7 +133,8 @@ class _SendState:
 
 
 class _RecvState:
-    __slots__ = ("req", "conv", "received", "total", "finish", "sink_buf")
+    __slots__ = ("req", "conv", "received", "total", "finish", "sink_buf",
+                 "native_sink", "_ivals")
 
     def __init__(self, req: Request, conv, total: int,
                  finish=None) -> None:
@@ -122,6 +144,24 @@ class _RecvState:
         self.total = total
         self.finish = finish     # device staging upload, run at completion
         self.sink_buf = None     # contiguous target for the native frag sink
+        self.native_sink = False
+        self._ivals: list = []   # merged covered [start, end) intervals
+
+    def cover(self, off: int, n: int) -> None:
+        """Merge [off, off+n) into coverage; striping failover may replay
+        fragments, so DEDUPLICATED coverage — not byte count — defines
+        completion (≙ the reference's per-request range accounting)."""
+        start, end = off, off + n
+        merged = []
+        for a, b in self._ivals:
+            if b < start or a > end:
+                merged.append((a, b))
+            else:
+                start, end = min(a, start), max(b, end)
+        merged.append((start, end))
+        merged.sort()
+        self._ivals = merged
+        self.received = sum(b - a for a, b in merged)
 
 
 class _PackedSink:
@@ -507,10 +547,12 @@ class P2P:
         state.req.complete()
 
     def _handle_frag(self, rreq: int, off: int, payload: bytes) -> None:
-        state = self._pending_recv[rreq]
+        state = self._pending_recv.get(rreq)
+        if state is None:
+            return               # late duplicate after completion (failover)
         state.conv.set_position(off)
         state.conv.unpack(payload)
-        state.received += len(payload)
+        state.cover(off, len(payload))
         if state.received >= state.total:
             del self._pending_recv[rreq]
             if state.finish is not None:
@@ -545,16 +587,59 @@ class P2P:
         return False
 
     def _stream_frags(self, dst: int, rreq: int, state: _SendState) -> None:
-        transport = self.layer.for_peer(dst)
-        chunk = transport.max_send_size
         if state.data is None and state.keep is not None:
             state.data = state.keep.tobytes()   # CMA declined: pack now
         data = state.data
         if not data:
             state.req.complete()
             return
-        for off in range(0, len(data), chunk):
-            transport.send(dst, T.AM_P2P,
-                           {"k": "frag", "rreq": rreq, "off": off},
-                           data[off:off + chunk])
+        # striping + failover (≙ bml/r2, bml.h:57-72): the fragment train
+        # splits across every transport that reaches the peer, weighted by
+        # bandwidth class; a transport error retires that path and its
+        # range replays on a survivor (fragment replay is idempotent — the
+        # receiver tracks covered intervals)
+        primary = self.layer.for_peer(dst)
+        paths = self.layer.paths_for_peer(dst) if _striping_on() \
+            else [primary]
+        work = list(self._stripe_plan(len(data), paths, primary))
+        while work:
+            t, base, n = work.pop(0)
+            try:
+                self._send_range(dst, rreq, data, base, n, t)
+            except Exception as exc:
+                self.layer.mark_failed(dst, t)
+                survivors = self.layer.paths_for_peer(dst)
+                if not survivors:
+                    state.req.complete(exc)
+                    return
+                work.append((survivors[0], base, n))
         state.req.complete()   # sender side done once handed to transport
+
+    def _stripe_plan(self, nbytes: int, paths, primary):
+        """[(transport, base, length)] — contiguous ranges by bandwidth
+        weight; short messages stay on the primary."""
+        if len(paths) < 2 or nbytes < 4 * primary.max_send_size:
+            return [(primary, 0, nbytes)]
+        total_bw = sum(t.bandwidth for t in paths)
+        plan, base = [], 0
+        for i, t in enumerate(paths):
+            if i == len(paths) - 1:
+                share = nbytes - base
+            else:
+                share = (nbytes * t.bandwidth // total_bw) & ~0xFFF
+            if share > 0:
+                plan.append((t, base, share))
+                base += share
+        return plan
+
+    def _send_range(self, dst: int, rreq: int, data, base: int, n: int,
+                    transport, off_base: int = 0) -> None:
+        """Stream one chunked range; ``off_base`` rebases receiver-side
+        offsets when ``data`` is a copied sub-range of the message."""
+        chunk = transport.max_send_size
+        for off in range(base, base + n, chunk):
+            m = min(chunk, base + n - off)
+            transport.send(dst, T.AM_P2P,
+                           {"k": "frag", "rreq": rreq,
+                            "off": off_base + off},
+                           data[off:off + m])

@@ -204,6 +204,9 @@ class NativeP2P(P2P):
         self._evbuf = (_MxEv * 64)()
         self._in_drain = False
         self._mx_peruse = False
+        # failover: a retired path must also leave the fast-path routing
+        # cache, or eager sends keep hitting the dead shm ring
+        layer.on_path_failed.append(self._path_failed)
         self._stat_base = [0, 0]      # matches_posted, unexpected_arrivals
         engine.register(self._mx_progress)
 
@@ -213,6 +216,10 @@ class NativeP2P(P2P):
             self._mxh = -1
 
     # -- helpers ------------------------------------------------------------
+
+    def _path_failed(self, peer: int, transport) -> None:
+        if transport is self._shm:
+            self._mx_peers[peer] = False
 
     def _is_mx_peer(self, peer: int) -> bool:
         v = self._mx_peers.get(peer)
@@ -257,9 +264,10 @@ class NativeP2P(P2P):
                 (ctypes.c_char * len(buf)).from_buffer(buf),
                 ctypes.POINTER(ctypes.c_uint8))
         self._lib.mx_add_sink(self._mxh, rreq, ptr, state.total)
-        # state.conv stays: the C++ engine falls back to the python frag
-        # path for out-of-bounds fragments (its error path) and that path
-        # needs the convertor to diagnose the bad offset
+        state.native_sink = True
+        # state.conv stays: striped fragments arriving on python-side
+        # transports (tcp share) unpack through it and credit the C++
+        # sink's coverage (_handle_frag override)
 
     # -- send ---------------------------------------------------------------
 
@@ -320,25 +328,68 @@ class NativeP2P(P2P):
         # the buffer is never referenced after return (MPI completion ok).
         if state.data is not None:
             src = state.data
-            ptr = ctypes.cast(ctypes.c_char_p(src), _U8P)
+            addr = ctypes.cast(ctypes.c_char_p(src), ctypes.c_void_p).value
             n = len(src)
         elif state.keep is not None:
-            arr = state.keep.reshape(-1).view(np.uint8)
-            ptr = arr.ctypes.data_as(_U8P)
-            n = arr.nbytes
+            src = state.keep.reshape(-1).view(np.uint8)
+            addr = src.ctypes.data
+            n = src.nbytes
         else:
-            ptr, n = None, 0
+            src, addr, n = b"", 0, 0
         if not n:
             state.req.complete()
             return
-        rc = self._lib.mx_send_frags(self._mxh, dst, rreq, ptr, n,
-                                     self._shm.max_send_size)
-        if rc < 0:
-            state.req.complete(RuntimeError(
-                f"fragment stream to rank {dst} failed "
-                f"({'dead shm ring' if rc == -3 else 'frame cannot fit'})"))
-            return
+        from .pml import _striping_on
+        primary = self._shm
+        paths = self.layer.paths_for_peer(dst) if _striping_on() \
+            else [primary]
+        work = list(self._stripe_plan(n, paths, primary))
+        while work:
+            t, base, ln = work.pop(0)
+            try:
+                if t is self._shm:
+                    ptr = ctypes.cast(ctypes.c_void_p(addr + base), _U8P)
+                    rc = self._lib.mx_send_frags(
+                        self._mxh, dst, rreq, ptr, ln,
+                        self._shm.max_send_size, base)
+                    if rc < 0:
+                        raise RuntimeError(
+                            "dead shm ring" if rc == -3
+                            else "frame cannot fit the shm ring")
+                else:
+                    # secondary share (tcp): one owned copy of ITS range
+                    if isinstance(src, np.ndarray):
+                        rng = src[base:base + ln].tobytes()
+                    else:
+                        rng = src[base:base + ln]
+                    self._send_range(dst, rreq, rng, 0, ln, t,
+                                     off_base=base)
+            except Exception as exc:
+                self.layer.mark_failed(dst, t)
+                survivors = self.layer.paths_for_peer(dst)
+                if not survivors:
+                    state.req.complete(exc)
+                    return
+                work.append((survivors[0], base, ln))
         state.req.complete()
+
+    def _handle_frag(self, rreq: int, off: int, payload: bytes) -> None:
+        """A fragment that arrived on a python-side transport while the
+        C++ engine holds the sink (striping): unpack here, credit the
+        shared coverage, complete when the union covers the message."""
+        state = self._pending_recv.get(rreq)
+        if state is None:
+            return               # late duplicate after completion
+        if not state.native_sink:
+            return super()._handle_frag(rreq, off, payload)
+        state.conv.set_position(off)
+        state.conv.unpack(payload)
+        if self._lib.mx_sink_credit(self._mxh, rreq, off,
+                                    len(payload)) == 1:
+            del self._pending_recv[rreq]
+            if state.finish is not None:
+                state.finish()
+            state.req.complete()
 
     # -- recv ---------------------------------------------------------------
 

@@ -9,7 +9,8 @@ from ..core.component import component
 from . import transport as T
 
 
-@component("transport", "self", priority=100)
+@component("transport", "self", priority=100)  # bandwidth default unused:
+# loopback is sole-path by construction (reachable only for self)
 class SelfTransport(T.Transport):
     name = "self"
 

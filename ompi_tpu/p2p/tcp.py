@@ -79,6 +79,7 @@ class _Conn:
 @component("transport", "tcp", priority=10)
 class TcpTransport(T.Transport):
     name = "tcp"
+    bandwidth = 20           # striping weight (loopback ~0.6 GB/s class)
 
     def __init__(self) -> None:
         super().__init__()

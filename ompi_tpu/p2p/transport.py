@@ -36,6 +36,10 @@ class Transport(Component):
     component whose query() instantiates a fresh module (the reference's
     component-vs-module split, docs/mca.rst:14-28)."""
 
+    # relative bandwidth class for fragment striping (≙ btl_bandwidth,
+    # bml.h:57-72 weighting); overridden per transport
+    bandwidth = 10
+
     def __init__(self) -> None:
         self.eager_limit = _var.register(
             "transport", self.name or "base", "eager_limit", 65536, type=int,
@@ -87,31 +91,64 @@ class Transport(Component):
 
 
 class TransportLayer:
-    """Per-peer transport choice (≙ BML r2's per-peer BTL arrays).
+    """Per-peer transport choice (≙ BML r2's per-peer BTL arrays,
+    bml_r2.c).
 
-    The highest-priority transport that reports the peer reachable owns that
-    peer. No striping in v1 (the reference stripes across equal-priority
-    BTLs; single-transport-per-peer keeps ordering trivially correct).
+    The highest-priority transport that reports the peer reachable OWNS
+    the peer: every control/ordered frame rides it (per-channel FIFO stays
+    trivially correct, like single-BTL ordering). Large-message fragment
+    trains may additionally STRIPE across every eligible transport
+    (``paths_for_peer``), weighted by ``bandwidth`` — the bml.h:57-72
+    scheduling — and ``mark_failed`` retires a path so the pml re-routes
+    outstanding fragments over the survivors (r2 failover).
     """
 
     def __init__(self, transports: List[Transport]) -> None:
         self.transports = sorted(transports, key=lambda t: -t.priority)
         self._by_peer: Dict[int, Transport] = {}
+        self._paths: Dict[int, List[Transport]] = {}
+        self._failed: Dict[int, set] = {}
         self._lock = threading.Lock()
         self.guard = None     # async-progress RLock (Context wires it)
+        # mark_failed listeners: upper layers with their own per-peer
+        # routing caches (the native pml's fast-path table) invalidate here
+        self.on_path_failed: List = []
 
     def for_peer(self, peer: int) -> Transport:
         with self._lock:
             t = self._by_peer.get(peer)
             if t is None:
+                failed = self._failed.get(peer, ())
                 for cand in self.transports:
-                    if cand.reachable(peer):
+                    if cand.name not in failed and cand.reachable(peer):
                         t = cand
                         break
                 if t is None:
                     raise RuntimeError(f"no transport reaches rank {peer}")
                 self._by_peer[peer] = t
             return t
+
+    def paths_for_peer(self, peer: int) -> List[Transport]:
+        """Every live transport that reaches the peer, primary first
+        (≙ the r2 per-peer BTL array for btl_send)."""
+        with self._lock:
+            paths = self._paths.get(peer)
+            if paths is None:
+                failed = self._failed.get(peer, ())
+                paths = [t for t in self.transports
+                         if t.name not in failed and t.reachable(peer)]
+                self._paths[peer] = paths
+            return paths
+
+    def mark_failed(self, peer: int, transport: Transport) -> None:
+        """Retire a transport for a peer (error mid-stream): for_peer and
+        paths_for_peer re-select from the survivors."""
+        with self._lock:
+            self._failed.setdefault(peer, set()).add(transport.name)
+            self._by_peer.pop(peer, None)
+            self._paths.pop(peer, None)
+        for cb in list(self.on_path_failed):
+            cb(peer, transport)
 
     def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes = b"") -> None:
         # guard: serialize against the async progress thread when enabled
