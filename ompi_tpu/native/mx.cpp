@@ -689,6 +689,7 @@ int mx_sink_credit(int h, int64_t rreq, uint64_t off, uint64_t len) {
   if (!e) return -1;
   auto it = e->sinks.find(rreq);
   if (it == e->sinks.end()) return -1;
+  if (off + len > it->second.total) return -2;  // out-of-range fragment
   sink_cover(it->second, off, len);
   if (it->second.received >= it->second.total) {
     e->sinks.erase(it);

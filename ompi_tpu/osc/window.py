@@ -172,17 +172,33 @@ class Window:
 
     # -- origin-side operations --------------------------------------------
 
+    def _addr(self, h: dict, target_disp, byte_disp, target_stride,
+              region) -> dict:
+        """Fold the addressing mode into a frame header (element disp /
+        byte disp / stride / dynamic region)."""
+        if byte_disp is not None:
+            h["bdisp"] = int(byte_disp)
+        else:
+            h["disp"] = int(target_disp)
+        if target_stride != 1:
+            h["tst"] = int(target_stride)
+        if region is not None:
+            h["reg"] = int(region)
+        return h
+
     def put(self, origin: np.ndarray, target_rank: int,
-            target_disp: int = 0, region: int = None) -> Request:
+            target_disp: int = 0, region: int = None,
+            byte_disp: int = None, target_stride: int = 1) -> Request:
         """Nonblocking put; completion = accepted+applied at target.
-        ``region`` addresses a dynamic window's attached buffer."""
+        ``region`` addresses a dynamic window's attached buffer;
+        ``byte_disp``/``target_stride`` give byte-addressed and strided
+        targeting (the symmetric-heap / shmem_iput path)."""
         a = np.ascontiguousarray(origin)
         req = Request()
         oreq = self.eng.next_oreq(req)
-        h = {"k": "put", "win": self.win_id, "disp": int(target_disp),
-             "dt": a.dtype.str, "shape": list(a.shape), "oreq": oreq}
-        if region is not None:
-            h["reg"] = int(region)
+        h = self._addr({"k": "put", "win": self.win_id, "dt": a.dtype.str,
+                        "shape": list(a.shape), "oreq": oreq},
+                       target_disp, byte_disp, target_stride, region)
         from .. import monitoring
         monitoring.osc_event(self.comm.ctx, "put",
                              self._target_world(target_rank), a.nbytes)
@@ -191,17 +207,18 @@ class Window:
         return self._track(target_rank, req)
 
     def get(self, origin: np.ndarray, target_rank: int,
-            target_disp: int = 0, region: int = None) -> Request:
+            target_disp: int = 0, region: int = None,
+            byte_disp: int = None, target_stride: int = 1) -> Request:
         """Nonblocking get into ``origin`` (shape/dtype define the request)."""
         req = Request()
 
         def land(data: bytes) -> None:
             np.copyto(origin.reshape(-1), np.frombuffer(data, dtype=origin.dtype))
         oreq = self.eng.next_oreq(req, sink=land)
-        h = {"k": "get", "win": self.win_id, "disp": int(target_disp),
-             "dt": origin.dtype.str, "count": int(origin.size), "oreq": oreq}
-        if region is not None:
-            h["reg"] = int(region)
+        h = self._addr({"k": "get", "win": self.win_id,
+                        "dt": origin.dtype.str, "count": int(origin.size),
+                        "oreq": oreq},
+                       target_disp, byte_disp, target_stride, region)
         from .. import monitoring
         monitoring.osc_event(self.comm.ctx, "get",
                              self._target_world(target_rank), origin.nbytes)
@@ -211,15 +228,15 @@ class Window:
 
     def accumulate(self, origin: np.ndarray, target_rank: int,
                    target_disp: int = 0, op: Op = SUM,
-                   region: int = None) -> Request:
+                   region: int = None, byte_disp: int = None,
+                   target_stride: int = 1) -> Request:
         a = np.ascontiguousarray(origin)
         req = Request()
         oreq = self.eng.next_oreq(req)
-        h = {"k": "acc", "win": self.win_id, "disp": int(target_disp),
-             "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
-             "oreq": oreq}
-        if region is not None:
-            h["reg"] = int(region)
+        h = self._addr({"k": "acc", "win": self.win_id, "dt": a.dtype.str,
+                        "shape": list(a.shape), "op": op.name,
+                        "oreq": oreq},
+                       target_disp, byte_disp, target_stride, region)
         from .. import monitoring
         monitoring.osc_event(self.comm.ctx, "accumulate",
                              self._target_world(target_rank), a.nbytes)
@@ -231,7 +248,9 @@ class Window:
 
     def get_accumulate(self, origin: np.ndarray, result: np.ndarray,
                        target_rank: int, target_disp: int = 0,
-                       op: Op = SUM, region: int = None) -> Request:
+                       op: Op = SUM, region: int = None,
+                       byte_disp: int = None,
+                       target_stride: int = 1) -> Request:
         """Atomically fetch target data into ``result`` and combine origin
         into the target (MPI_Get_accumulate; op=NO_OP → pure atomic fetch)."""
         a = np.ascontiguousarray(origin)
@@ -241,27 +260,26 @@ class Window:
             np.copyto(result.reshape(-1),
                       np.frombuffer(data, dtype=result.dtype))
         oreq = self.eng.next_oreq(req, sink=land)
-        h = {"k": "getacc", "win": self.win_id, "disp": int(target_disp),
-             "dt": a.dtype.str, "shape": list(a.shape), "op": op.name,
-             "oreq": oreq}
-        if region is not None:
-            h["reg"] = int(region)
+        h = self._addr({"k": "getacc", "win": self.win_id,
+                        "dt": a.dtype.str, "shape": list(a.shape),
+                        "op": op.name, "oreq": oreq},
+                       target_disp, byte_disp, target_stride, region)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
                                  h, a.tobytes())
         return self._track(target_rank, req)
 
     def fetch_and_op(self, value, result: np.ndarray, target_rank: int,
                      target_disp: int = 0, op: Op = SUM,
-                     region: int = None) -> Request:
+                     region: int = None, byte_disp: int = None) -> Request:
         """Single-element get_accumulate (MPI_Fetch_and_op)."""
         origin = np.asarray([value], dtype=result.dtype) \
             if np.ndim(value) == 0 else np.asarray(value, dtype=result.dtype)
         return self.get_accumulate(origin, result, target_rank, target_disp,
-                                   op, region=region)
+                                   op, region=region, byte_disp=byte_disp)
 
     def compare_and_swap(self, compare, origin, result: np.ndarray,
                          target_rank: int, target_disp: int = 0,
-                         region: int = None) -> Request:
+                         region: int = None, byte_disp: int = None) -> Request:
         dt = result.dtype
         payload = (np.asarray([compare], dt).tobytes()
                    + np.asarray([origin], dt).tobytes())
@@ -270,10 +288,9 @@ class Window:
         def land(data: bytes) -> None:
             np.copyto(result.reshape(-1), np.frombuffer(data, dtype=dt))
         oreq = self.eng.next_oreq(req, sink=land)
-        h = {"k": "cas", "win": self.win_id, "disp": int(target_disp),
-             "dt": dt.str, "oreq": oreq}
-        if region is not None:
-            h["reg"] = int(region)
+        h = self._addr({"k": "cas", "win": self.win_id, "dt": dt.str,
+                        "oreq": oreq},
+                       target_disp, byte_disp, 1, region)
         self.comm.ctx.layer.send(self._target_world(target_rank), T.AM_OSC,
                                  h, payload)
         return self._track(target_rank, req)
@@ -289,25 +306,51 @@ class Window:
                 f"only valid on win_create_dynamic windows")
         return self.local.reshape(-1).view(self.local.dtype)
 
+    def _resolve(self, h: Dict[str, Any], count: int) -> np.ndarray:
+        """Typed (possibly strided) writable view of the addressed target
+        region. Classic headers use ``disp`` in window-element units; the
+        symmetric-heap path uses ``bdisp`` — a BYTE displacement typed by
+        the payload's dtype (one byte-addressed window backs many typed
+        allocations, ≙ osc/rdma's byte addressing over registered memory);
+        ``tst`` adds a target stride in elements (shmem_iput/iget)."""
+        stride = int(h.get("tst", 1))
+        if "bdisp" in h:
+            base = self._flat(h).view(np.uint8)
+            dt = np.dtype(h["dt"])
+            off = int(h["bdisp"])
+            span = ((count - 1) * stride + 1) if count else 0
+            if off < 0 or off + span * dt.itemsize > base.nbytes:
+                raise _TargetAccessError(
+                    f"byte range [{off}, {off + span * dt.itemsize}) "
+                    f"outside window {self.name} ({base.nbytes}B)")
+            typed = np.frombuffer(base.data, dt, span, offset=off)
+            return typed[::stride] if stride != 1 else typed
+        flat = self._flat(h)
+        d = int(h["disp"])
+        span = ((count - 1) * stride + 1) if count else 0
+        view = flat[d:d + span]
+        return view[::stride] if stride != 1 else view
+
     def _serve(self, src: int, h: Dict[str, Any], payload: bytes) -> None:
         k = h["k"]
         layer = self.comm.ctx.layer
         if k == "put":
             arr = np.frombuffer(payload, dtype=np.dtype(h["dt"]))
             with self._apply_lock:
-                self._flat(h)[h["disp"]:h["disp"] + arr.size] = arr
+                self._resolve(h, arr.size)[...] = arr
             layer.send(src, T.AM_OSC, {"k": "ack", "oreq": h["oreq"]}, b"")
         elif k == "get":
             with self._apply_lock:
-                data = self._flat(h)[h["disp"]:h["disp"] + h["count"]].tobytes()
+                data = np.ascontiguousarray(
+                    self._resolve(h, h["count"])).tobytes()
             layer.send(src, T.AM_OSC, {"k": "getdata", "oreq": h["oreq"]}, data)
         elif k in ("acc", "getacc"):
             arr = np.frombuffer(payload, dtype=np.dtype(h["dt"]))
             op = _OPS[h["op"]]
             with self._apply_lock:
-                view = self._flat(h)[h["disp"]:h["disp"] + arr.size]
+                view = self._resolve(h, arr.size)
                 if k == "getacc":
-                    fetched = view.tobytes()
+                    fetched = np.ascontiguousarray(view).tobytes()
                 view[...] = op(arr, view.copy())
             if k == "acc":
                 layer.send(src, T.AM_OSC, {"k": "ack", "oreq": h["oreq"]}, b"")
@@ -319,10 +362,16 @@ class Window:
             cmp_v = np.frombuffer(payload[:dt.itemsize], dt)[0]
             new_v = np.frombuffer(payload[dt.itemsize:], dt)[0]
             with self._apply_lock:
-                view = self._flat(h)
-                old = view[h["disp"]]
-                if old == cmp_v:
-                    view[h["disp"]] = new_v
+                view = self._resolve(h, 1) if "bdisp" in h else None
+                if view is not None:
+                    old = view[0]
+                    if old == cmp_v:
+                        view[0] = new_v
+                else:
+                    flat = self._flat(h)
+                    old = flat[h["disp"]]
+                    if old == cmp_v:
+                        flat[h["disp"]] = new_v
             layer.send(src, T.AM_OSC, {"k": "fetched", "oreq": h["oreq"]},
                        np.asarray([old], dt).tobytes())
         elif k == "lock":

@@ -601,11 +601,24 @@ class P2P:
         primary = self.layer.for_peer(dst)
         paths = self.layer.paths_for_peer(dst) if _striping_on() \
             else [primary]
-        work = list(self._stripe_plan(len(data), paths, primary))
+        plan = self._stripe_plan(len(data), paths, primary)
+        self._run_with_failover(
+            dst, state, plan,
+            lambda t, base, n: self._send_range(dst, rreq, data, base, n,
+                                                t))
+
+    def _run_with_failover(self, dst: int, state: _SendState, plan,
+                           send_range) -> None:
+        """Execute a stripe plan with r2 failover: a failed range retires
+        its transport and replays (idempotently) on the best survivor;
+        no survivors → the send request carries the error. Shared by the
+        python and native pmls — ONE copy of the retry policy."""
+        work = list(plan)
         while work:
             t, base, n = work.pop(0)
             try:
-                self._send_range(dst, rreq, data, base, n, t)
+                send_range(t, base, n)
+                t.confirm(dst)    # surface async transport errors NOW
             except Exception as exc:
                 self.layer.mark_failed(dst, t)
                 survivors = self.layer.paths_for_peer(dst)

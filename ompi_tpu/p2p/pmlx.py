@@ -343,35 +343,27 @@ class NativeP2P(P2P):
         primary = self._shm
         paths = self.layer.paths_for_peer(dst) if _striping_on() \
             else [primary]
-        work = list(self._stripe_plan(n, paths, primary))
-        while work:
-            t, base, ln = work.pop(0)
-            try:
-                if t is self._shm:
-                    ptr = ctypes.cast(ctypes.c_void_p(addr + base), _U8P)
-                    rc = self._lib.mx_send_frags(
-                        self._mxh, dst, rreq, ptr, ln,
-                        self._shm.max_send_size, base)
-                    if rc < 0:
-                        raise RuntimeError(
-                            "dead shm ring" if rc == -3
-                            else "frame cannot fit the shm ring")
+        plan = self._stripe_plan(n, paths, primary)
+
+        def send_range(t, base, ln):
+            if t is self._shm:
+                ptr = ctypes.cast(ctypes.c_void_p(addr + base), _U8P)
+                rc = self._lib.mx_send_frags(
+                    self._mxh, dst, rreq, ptr, ln,
+                    self._shm.max_send_size, base)
+                if rc < 0:
+                    raise RuntimeError(
+                        "dead shm ring" if rc == -3
+                        else "frame cannot fit the shm ring")
+            else:
+                # secondary share (tcp): one owned copy of ITS range
+                if isinstance(src, np.ndarray):
+                    rng = src[base:base + ln].tobytes()
                 else:
-                    # secondary share (tcp): one owned copy of ITS range
-                    if isinstance(src, np.ndarray):
-                        rng = src[base:base + ln].tobytes()
-                    else:
-                        rng = src[base:base + ln]
-                    self._send_range(dst, rreq, rng, 0, ln, t,
-                                     off_base=base)
-            except Exception as exc:
-                self.layer.mark_failed(dst, t)
-                survivors = self.layer.paths_for_peer(dst)
-                if not survivors:
-                    state.req.complete(exc)
-                    return
-                work.append((survivors[0], base, ln))
-        state.req.complete()
+                    rng = src[base:base + ln]
+                self._send_range(dst, rreq, rng, 0, ln, t, off_base=base)
+
+        self._run_with_failover(dst, state, plan, send_range)
 
     def _handle_frag(self, rreq: int, off: int, payload: bytes) -> None:
         """A fragment that arrived on a python-side transport while the
@@ -382,6 +374,14 @@ class NativeP2P(P2P):
             return               # late duplicate after completion
         if not state.native_sink:
             return super()._handle_frag(rreq, off, payload)
+        if off + len(payload) > state.total:
+            # corrupt offset: fail the request with a diagnostic instead
+            # of letting a sink-extending unpack mask missing real bytes
+            del self._pending_recv[rreq]
+            state.req.complete(RuntimeError(
+                f"fragment [{off}, {off + len(payload)}) outside the "
+                f"{state.total}-byte message"))
+            return
         state.conv.set_position(off)
         state.conv.unpack(payload)
         if self._lib.mx_sink_credit(self._mxh, rreq, off,

@@ -10,7 +10,8 @@ from . import transport as T
 
 
 @component("transport", "self", priority=100)  # bandwidth default unused:
-# loopback is sole-path by construction (reachable only for self)
+# TransportLayer.paths_for_peer makes loopback sole-PATH whenever it is
+# the primary, so self-sends never stripe through the kernel tcp stack
 class SelfTransport(T.Transport):
     name = "self"
 

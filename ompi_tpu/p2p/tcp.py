@@ -143,7 +143,33 @@ class TcpTransport(T.Transport):
         self._flush(conn)
 
     def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes) -> None:
+        if peer in self.failed_peers:
+            # a prior flush hit a hard error: surface it instead of
+            # silently re-dropping (bml failover relies on seeing this)
+            raise OSError(f"tcp connection to rank {peer} has failed")
         self._enqueue(self._tx_conn(peer), wire.encode(tag, header), payload)
+
+    def confirm(self, peer: int) -> None:
+        """Drain the peer's outbuf to the kernel, raising if the
+        connection failed — the synchronous error surface striping needs:
+        _flush swallows OSError asynchronously (send() only ENQUEUES), so
+        a fragment range is only 'handed to the transport' once this
+        returns (≙ the reference btl's des_cbfunc completion callback)."""
+        import time
+        conn = self._tx.get(peer)
+        deadline = time.monotonic() + 30.0
+        while conn is not None and conn.outbuf:
+            if peer in self.failed_peers:
+                break
+            self._flush(conn)
+            if conn.outbuf:
+                if time.monotonic() > deadline:
+                    raise OSError(
+                        f"tcp to rank {peer}: outbuf not draining "
+                        f"({conn.out_bytes} bytes stuck)")
+                time.sleep(0.0002)     # kernel buffer full: let it drain
+        if peer in self.failed_peers:
+            raise OSError(f"tcp connection to rank {peer} has failed")
 
     def _flush(self, conn: _Conn) -> int:
         sent = 0

@@ -78,6 +78,12 @@ class Transport(Component):
     def progress(self) -> int:
         return 0
 
+    def confirm(self, peer: int) -> None:
+        """Block until frames previously accepted for ``peer`` are handed
+        off (or raise on a failed path). Transports whose send() already
+        guarantees handoff (shm rings, loopback) need nothing here; tcp
+        overrides it to drain its outbuf and surface async errors."""
+
     def pending_count(self, exclude: frozenset = frozenset()) -> int:
         """Frames accepted by send() but not yet on the wire, not counting
         peers in ``exclude`` (dead ranks never drain their ring). Finalize
@@ -130,13 +136,17 @@ class TransportLayer:
 
     def paths_for_peer(self, peer: int) -> List[Transport]:
         """Every live transport that reaches the peer, primary first
-        (≙ the r2 per-peer BTL array for btl_send)."""
+        (≙ the r2 per-peer BTL array for btl_send). Loopback is sole-path:
+        striping a self-send through the kernel tcp stack only adds
+        copies, so when `self` owns the peer it is the ONLY path."""
         with self._lock:
             paths = self._paths.get(peer)
             if paths is None:
                 failed = self._failed.get(peer, ())
                 paths = [t for t in self.transports
                          if t.name not in failed and t.reachable(peer)]
+                if paths and paths[0].name == "self":
+                    paths = paths[:1]
                 self._paths[peer] = paths
             return paths
 

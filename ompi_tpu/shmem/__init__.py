@@ -8,10 +8,17 @@ stack the same way OSHMEM maps onto OMPI:
 
   * ``init`` reuses the MPI-side runtime exactly as ``shmem_init`` calls
     ``ompi_mpi_init(reinit_ok=true)`` (oshmem/runtime/oshmem_shmem_init.c:134);
-  * the symmetric heap (≙ memheap framework) is a collective allocator:
-    every PE calls ``smalloc`` in the same order, so allocation i refers to
-    the same window on every PE — backing each allocation with an osc
-    Window gives put/get/atomics the AM-RDMA path (≙ spml over ucx);
+  * the symmetric heap (≙ memheap framework) is ONE shared window carved
+    by a buddy allocator (≙ oshmem/mca/memheap/buddy): collective
+    same-order ``smalloc`` calls yield SYMMETRIC offsets on every PE, and
+    freed blocks coalesce and get reused; RMA/atomics address the heap
+    window byte-wise (the osc ``bdisp`` path, ≙ spml over ucx);
+  * strided RMA (``iput``/``iget`` ≙ oshmem/shmem/c/shmem_iput.c) rides
+    the window's target-stride addressing;
+  * teams (OpenSHMEM 1.5 ``shmem_team_*``) map onto comm.split with
+    team-scoped collectives; distributed locks
+    (``set_lock``/``test_lock``/``clear_lock`` ≙ shmem/c/shmem_lock.c)
+    arbitrate by window CAS at PE 0;
   * SHMEM collectives (≙ scoll framework) delegate to the coll framework,
     the same trick as scoll/mpi;
   * ``quiet`` flushes outstanding RMA (≙ spml quiet), ``fence`` is ordering
@@ -26,16 +33,63 @@ facade is the control-scale API, like everything host-side here.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import var as _var
 from ..core.progress import get_engine
 from ..op import MAX, MIN, PROD, SUM, Op
 from ..osc.window import Window
 from ..p2p.request import Request
 
+_var.register("shmem", "memheap", "size", 1 << 22, type=int, level=4,
+              help="Bytes of symmetric heap per PE (one shared window, "
+                   "buddy-allocated — ≙ oshmem/mca/memheap/buddy). "
+                   "Oversize allocations fall back to dedicated windows.")
+
 _tls = threading.local()
+
+
+class _Buddy:
+    """Buddy allocator over one byte range (≙ oshmem/mca/memheap/buddy/
+    memheap_buddy.c): power-of-two blocks, split on alloc, coalesce with
+    the buddy on free. Deterministic, so collective same-order calls give
+    SYMMETRIC offsets on every PE — the memheap contract."""
+
+    MIN_ORDER = 6                      # 64-byte quantum (≥ any alignment)
+
+    def __init__(self, total: int) -> None:
+        self.max_order = max(int(total).bit_length() - 1, self.MIN_ORDER)
+        self.free: Dict[int, List[int]] = {self.max_order: [0]}
+
+    def alloc(self, nbytes: int) -> Optional[int]:
+        order = max((max(nbytes, 1) - 1).bit_length(), self.MIN_ORDER)
+        if order > self.max_order:
+            return None
+        o = order
+        while o <= self.max_order and not self.free.get(o):
+            o += 1
+        if o > self.max_order:
+            return None                # fragmented/full
+        off = self.free[o].pop()
+        while o > order:               # split down, keep upper halves free
+            o -= 1
+            self.free.setdefault(o, []).append(off + (1 << o))
+        return off
+
+    def release(self, off: int, nbytes: int) -> None:
+        order = max((max(nbytes, 1) - 1).bit_length(), self.MIN_ORDER)
+        while order < self.max_order:
+            buddy = off ^ (1 << order)
+            peers = self.free.get(order, [])
+            if buddy in peers:
+                peers.remove(buddy)    # coalesce and try the next order
+                off = min(off, buddy)
+                order += 1
+            else:
+                break
+        self.free.setdefault(order, []).append(off)
 
 
 class _PEState:
@@ -44,6 +98,20 @@ class _PEState:
         self.comm = ctx.comm_world
         self.heap: List["SymmetricArray"] = []     # allocation order = id
         self.pending: List[Request] = []           # outstanding RMA (quiet)
+        self.heap_win: Optional[Window] = None     # the symmetric heap
+        self.buddy: Optional[_Buddy] = None
+
+    def ensure_heap(self) -> None:
+        """Collective lazy creation of THE symmetric-heap window. The
+        buddy allocator manages power-of-two totals, so a non-power-of-two
+        size var rounds DOWN (allocating the unmanaged tail would waste
+        it silently)."""
+        if self.heap_win is None:
+            size = int(_var.get("shmem_memheap_size", 1 << 22))
+            size = 1 << max(size.bit_length() - 1, _Buddy.MIN_ORDER)
+            self.heap_win = Window(self.comm, np.zeros(size, np.uint8),
+                                   name="shmem_memheap")
+            self.buddy = _Buddy(size)
 
 
 def _state() -> _PEState:
@@ -75,9 +143,14 @@ def finalize() -> None:
         r.wait()
     st.comm.coll.barrier(st.comm)
     for arr in st.heap:
-        if arr is not None and arr._win is not None:   # sfree leaves Nones
+        # dedicated windows only — heap-backed slices share heap_win
+        if arr is not None and arr._win is not None \
+                and arr._heap_off is None:             # sfree leaves Nones
             arr._win.free()
             arr._win = None
+    if st.heap_win is not None:
+        st.heap_win.free()
+        st.heap_win = None
 
 
 def my_pe() -> int:
@@ -97,41 +170,71 @@ def pe_accessible(pe: int) -> bool:
 # -- symmetric heap (≙ oshmem/mca/memheap) ----------------------------------
 
 class SymmetricArray:
-    """One symmetric allocation: same shape/dtype on every PE, remotely
-    addressable. ``.local`` is this PE's backing numpy array."""
+    """One symmetric allocation: same shape/dtype at the same heap offset
+    on every PE, remotely addressable. ``.local`` is this PE's slice of
+    the heap (or a dedicated window for oversize allocations)."""
 
-    def __init__(self, win: Window, shape, dtype) -> None:
+    def __init__(self, win: Window, shape, dtype,
+                 heap_off: Optional[int] = None) -> None:
         self._win = win
         self.shape = tuple(shape)
         self.dtype = np.dtype(dtype)
+        self._heap_off = heap_off     # byte offset; None = dedicated window
 
     @property
     def local(self) -> np.ndarray:
-        return self._win.local.reshape(self.shape)
+        if self._heap_off is not None:
+            n = int(np.prod(self.shape)) if self.shape else 1
+            raw = self._win.local[self._heap_off:
+                                  self._heap_off + n * self.dtype.itemsize]
+            return raw.view(self.dtype).reshape(self.shape)
+        return self._win.local.view(self.dtype).reshape(self.shape)
 
     def __array__(self, dtype=None):
         a = self.local
         return a.astype(dtype) if dtype is not None else a
 
+    # byte displacement of element `offset` for the window RMA calls
+    def _bd(self, offset: int) -> Optional[int]:
+        if self._heap_off is None:
+            return None
+        return self._heap_off + int(offset) * self.dtype.itemsize
+
 
 def smalloc(shape, dtype=np.float64) -> SymmetricArray:
-    """shmem_malloc: COLLECTIVE over all PEs (the symmetric-heap contract:
-    every PE allocates in the same order)."""
+    """shmem_malloc: COLLECTIVE over all PEs. Allocations carve the ONE
+    symmetric-heap window through the buddy allocator (same order on every
+    PE → same offset everywhere — ≙ memheap); oversize requests fall back
+    to a dedicated window."""
     st = _state()
     shape = (shape,) if np.isscalar(shape) else tuple(shape)
     count = int(np.prod(shape)) if shape else 1
-    win = Window(st.comm, np.zeros(count, np.dtype(dtype)),
-                 name=f"shmem#{len(st.heap)}")
-    arr = SymmetricArray(win, shape, dtype)
+    dt = np.dtype(dtype)
+    st.ensure_heap()
+    off = st.buddy.alloc(count * dt.itemsize)
+    if off is not None:
+        st.heap_win.local[off:off + count * dt.itemsize] = 0
+        arr = SymmetricArray(st.heap_win, shape, dt, heap_off=off)
+    else:
+        win = Window(st.comm, np.zeros(count, dt),
+                     name=f"shmem#{len(st.heap)}")
+        arr = SymmetricArray(win, shape, dt)
     st.heap.append(arr)
     barrier_all()              # allocation is usable on return, everywhere
     return arr
 
 
 def sfree(arr: SymmetricArray) -> None:
+    """shmem_free: collective; heap blocks return to the buddy allocator
+    (coalescing with their buddy) and are immediately reusable."""
     st = _state()
     barrier_all()
-    if arr._win is not None:
+    if arr._heap_off is not None:
+        n = int(np.prod(arr.shape)) if arr.shape else 1
+        st.buddy.release(arr._heap_off, n * arr.dtype.itemsize)
+        arr._heap_off = None
+        arr._win = None
+    elif arr._win is not None:
         arr._win.free()
         arr._win = None
     if arr in st.heap:
@@ -140,12 +243,22 @@ def sfree(arr: SymmetricArray) -> None:
 
 # -- RMA (≙ oshmem/mca/spml) -------------------------------------------------
 
+def _rma_kw(arr: SymmetricArray, offset: int, stride: int = 1) -> dict:
+    """Window addressing for this allocation: heap slices go byte-addressed
+    (one window, many typed allocations), dedicated windows by element."""
+    bd = arr._bd(offset)
+    kw = {"byte_disp": bd} if bd is not None else {"target_disp": offset}
+    if stride != 1:
+        kw["target_stride"] = int(stride)
+    return kw
+
+
 def put(dest: SymmetricArray, value, pe: int, offset: int = 0) -> None:
     """shmem_put: blocking remote store (returns when applied — stronger
     than the standard's local-completion minimum). Already complete on
     return, so it never enters the quiet() pending list."""
     a = np.ascontiguousarray(np.asarray(value, dest.dtype))
-    dest._win.put(a, pe, offset).wait()
+    dest._win.put(a, pe, **_rma_kw(dest, offset)).wait()
 
 
 def _track(st: _PEState, req: Request) -> Request:
@@ -160,7 +273,7 @@ def _track(st: _PEState, req: Request) -> Request:
 def put_nbi(dest: SymmetricArray, value, pe: int, offset: int = 0) -> Request:
     st = _state()
     a = np.ascontiguousarray(np.asarray(value, dest.dtype))
-    return _track(st, dest._win.put(a, pe, offset))
+    return _track(st, dest._win.put(a, pe, **_rma_kw(dest, offset)))
 
 
 def get(src: SymmetricArray, pe: int, count: Optional[int] = None,
@@ -168,14 +281,36 @@ def get(src: SymmetricArray, pe: int, count: Optional[int] = None,
     """shmem_get: blocking remote load."""
     n = int(np.prod(src.shape)) - offset if count is None else int(count)
     out = np.empty(n, src.dtype)
-    src._win.get(out, pe, offset).wait()
+    src._win.get(out, pe, **_rma_kw(src, offset)).wait()
     return out
 
 
 def get_nbi(src: SymmetricArray, out: np.ndarray, pe: int,
             offset: int = 0) -> Request:
     st = _state()
-    return _track(st, src._win.get(out, pe, offset))
+    return _track(st, src._win.get(out, pe, **_rma_kw(src, offset)))
+
+
+def iput(dest: SymmetricArray, value, dst_stride: int, src_stride: int,
+         nelems: int, pe: int, offset: int = 0) -> None:
+    """shmem_iput: strided remote store — every ``dst_stride``-th element
+    of the target starting at ``offset`` receives every ``src_stride``-th
+    element of ``value`` (≙ oshmem/shmem/c/shmem_iput.c)."""
+    src = np.asarray(value, dest.dtype).reshape(-1)[::src_stride][:nelems]
+    dest._win.put(np.ascontiguousarray(src), pe,
+                  **_rma_kw(dest, offset, stride=dst_stride)).wait()
+
+
+def iget(src: SymmetricArray, dst_stride: int, src_stride: int,
+         nelems: int, pe: int, offset: int = 0) -> np.ndarray:
+    """shmem_iget: strided remote load; returns a dense array of the
+    fetched elements expanded by ``dst_stride`` (caller's layout)."""
+    got = np.empty(nelems, src.dtype)
+    src._win.get(got, pe, **_rma_kw(src, offset, stride=src_stride)).wait()
+    out = np.zeros(((nelems - 1) * dst_stride + 1) if nelems else 0,
+                   src.dtype)
+    out[::dst_stride] = got
+    return out
 
 
 # -- ordering (≙ spml fence/quiet) ------------------------------------------
@@ -198,14 +333,15 @@ def fence() -> None:
 # -- atomics (≙ oshmem/mca/atomic) ------------------------------------------
 
 def atomic_add(dest: SymmetricArray, value, pe: int, offset: int = 0) -> None:
-    dest._win.accumulate(np.asarray([value], dest.dtype), pe, offset).wait()
+    dest._win.accumulate(np.asarray([value], dest.dtype), pe,
+                         **_rma_kw(dest, offset)).wait()
 
 
 def atomic_fetch_add(dest: SymmetricArray, value, pe: int,
                      offset: int = 0):
     out = np.empty(1, dest.dtype)
     dest._win.fetch_and_op(np.asarray(value, dest.dtype), out, pe,
-                           offset, SUM).wait()
+                           op=SUM, **_rma_kw(dest, offset)).wait()
     return out[0]
 
 
@@ -220,9 +356,10 @@ def atomic_fetch_inc(dest: SymmetricArray, pe: int, offset: int = 0):
 def atomic_compare_swap(dest: SymmetricArray, cond, value, pe: int,
                         offset: int = 0):
     out = np.empty(1, dest.dtype)
+    kw = _rma_kw(dest, offset)
     dest._win.compare_and_swap(np.asarray(cond, dest.dtype),
                                np.asarray(value, dest.dtype), out, pe,
-                               offset).wait()
+                               **kw).wait()
     return out[0]
 
 
@@ -230,7 +367,7 @@ def atomic_swap(dest: SymmetricArray, value, pe: int, offset: int = 0):
     from ..op import REPLACE
     out = np.empty(1, dest.dtype)
     dest._win.fetch_and_op(np.asarray(value, dest.dtype), out, pe,
-                           offset, REPLACE).wait()
+                           op=REPLACE, **_rma_kw(dest, offset)).wait()
     return out[0]
 
 
@@ -291,3 +428,111 @@ def reduce_to_all(src, op: str = "sum") -> np.ndarray:
 def alltoall(src) -> np.ndarray:
     st = _state()
     return np.asarray(st.comm.coll.alltoall(st.comm, np.asarray(src)))
+
+
+# -- teams (≙ OpenSHMEM 1.5 shmem_team_* — oshmem/shmem/c/shmem_team.c) ------
+
+class Team:
+    """A PE subset with its own collective context; built on comm.split so
+    team handles are symmetric across members."""
+
+    def __init__(self, comm, parent: "Team" = None) -> None:
+        self._comm = comm
+        self._parent = parent
+
+    @property
+    def my_pe(self) -> int:
+        return self._comm.rank
+
+    @property
+    def n_pes(self) -> int:
+        return self._comm.size
+
+    def translate_pe(self, pe: int, dest: "Team") -> int:
+        """Team-relative rank → dest-team rank (-1 when not a member)."""
+        world = self._comm.group.world_of_rank(pe)
+        try:
+            return dest._comm.group.rank_of_world(world)
+        except Exception:
+            return -1
+
+    def split_strided(self, start: int, stride: int, size: int) -> \
+            Optional["Team"]:
+        """shmem_team_split_strided: COLLECTIVE over this team; members
+        with team-pe in {start + i*stride} form the child; others get
+        None (≙ SHMEM_TEAM_INVALID)."""
+        members = {start + i * stride for i in range(size)}
+        color = 0 if self._comm.rank in members else None
+        child = self._comm.split(color, key=self._comm.rank)
+        return Team(child, self) if child is not None else None
+
+    def sync(self) -> None:
+        """shmem_team_sync: barrier over the team (+ quiet, like
+        barrier_all but team-scoped)."""
+        quiet()
+        self._comm.coll.barrier(self._comm)
+
+    # team collectives (scoll over the team's comm)
+    def broadcast(self, value, root: int = 0) -> np.ndarray:
+        return np.asarray(self._comm.coll.bcast(
+            self._comm, np.asarray(value), root=root))
+
+    def reduce(self, value, op: str = "sum") -> np.ndarray:
+        return np.asarray(self._comm.coll.allreduce(
+            self._comm, np.asarray(value), op=_REDUCE_OPS[op]))
+
+    def fcollect(self, value) -> np.ndarray:
+        return np.asarray(self._comm.coll.allgather(
+            self._comm, np.asarray(value)))
+
+
+def team_world() -> Team:
+    """SHMEM_TEAM_WORLD."""
+    st = _state()
+    return Team(st.comm)
+
+
+# -- locks (≙ oshmem/shmem/c/shmem_lock.c) -----------------------------------
+#
+# A lock is a symmetric int64 variable; ownership is arbitrated at PE 0
+# via window CAS (the reference arbitrates at the lock's owner PE with
+# AMO + signal — same shape). Value 0 = free, 1+pe = held by pe.
+
+def set_lock(lock: SymmetricArray, offset: int = 0,
+             timeout: float = 60.0) -> None:
+    """shmem_set_lock: blocking acquire (spins under the progress engine
+    with backoff so the holder's clear can land)."""
+    st = _state()
+    me = st.comm.rank + 1
+    import time
+    deadline = time.monotonic() + timeout
+    delay = 0.0
+    while True:
+        old = atomic_compare_swap(lock, 0, me, pe=0, offset=offset)
+        if old == 0:
+            return
+        if time.monotonic() > deadline:
+            raise TimeoutError("shmem set_lock: not acquired within "
+                               f"{timeout}s (held by PE {int(old) - 1})")
+        st.ctx.engine.progress()
+        time.sleep(delay)
+        delay = min(delay * 2 + 1e-5, 0.001)
+
+
+def test_lock(lock: SymmetricArray, offset: int = 0) -> bool:
+    """shmem_test_lock: one acquire attempt; True = acquired."""
+    st = _state()
+    me = st.comm.rank + 1
+    return bool(atomic_compare_swap(lock, 0, me, pe=0, offset=offset) == 0)
+
+
+def clear_lock(lock: SymmetricArray, offset: int = 0) -> None:
+    """shmem_clear_lock: release (quiet first — the standard orders the
+    critical section's RMA before the release becomes visible)."""
+    quiet()
+    st = _state()
+    me = st.comm.rank + 1
+    old = atomic_compare_swap(lock, me, 0, pe=0, offset=offset)
+    if old != me:
+        raise RuntimeError(
+            f"shmem clear_lock: lock not held by this PE (state {old})")
