@@ -159,6 +159,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.mx_probe.restype = i
     lib.mx_add_sink.argtypes = [i, i64, u8p, u64]
     lib.mx_add_sink.restype = None
+    lib.mx_remove_sink.argtypes = [i, i64]
+    lib.mx_remove_sink.restype = i
     lib.mx_arrived.argtypes = [i, i32, i64, i64, u32, u64, i, i64, i64,
                                chp, u64]
     lib.mx_arrived.restype = None

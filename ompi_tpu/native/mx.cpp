@@ -680,6 +680,15 @@ void mx_add_sink(int h, int64_t rreq, uint8_t* buf, uint64_t total) {
   if (e) e->sinks[rreq] = {buf, total, 0, {}};
 }
 
+// cancel a sink (the receiver hit an error path): later fragments for the
+// rreq fall through to python instead of landing in a buffer the
+// application may have reclaimed
+int mx_remove_sink(int h, int64_t rreq) {
+  Engine* e = eng_of(h);
+  if (!e) return 0;
+  return (int)e->sinks.erase(rreq);
+}
+
 // credit coverage delivered OUTSIDE the engine (a striped fragment that
 // arrived on a python-side transport and was unpacked there). Returns 1
 // when the sink just completed (caller finishes the request; no

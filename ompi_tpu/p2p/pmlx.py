@@ -376,8 +376,12 @@ class NativeP2P(P2P):
             return super()._handle_frag(rreq, off, payload)
         if off + len(payload) > state.total:
             # corrupt offset: fail the request with a diagnostic instead
-            # of letting a sink-extending unpack mask missing real bytes
+            # of letting a sink-extending unpack mask missing real bytes.
+            # The C++ sink must go too — in-flight shm fragments for this
+            # rreq would otherwise keep landing in a buffer the
+            # application may reclaim after seeing the error.
             del self._pending_recv[rreq]
+            self._lib.mx_remove_sink(self._mxh, rreq)
             state.req.complete(RuntimeError(
                 f"fragment [{off}, {off + len(payload)}) outside the "
                 f"{state.total}-byte message"))

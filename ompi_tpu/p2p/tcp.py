@@ -143,11 +143,32 @@ class TcpTransport(T.Transport):
         self._flush(conn)
 
     def send(self, peer: int, tag: int, header: Dict[str, Any], payload: bytes) -> None:
-        if peer in self.failed_peers:
-            # a prior flush hit a hard error: surface it instead of
-            # silently re-dropping (bml failover relies on seeing this)
-            raise OSError(f"tcp connection to rank {peer} has failed")
+        # Failed peers keep the historical silent-drop semantics (AM reply
+        # paths run inside the progress loop with no handler for a raise);
+        # the striping path learns about failures through confirm().
         self._enqueue(self._tx_conn(peer), wire.encode(tag, header), payload)
+
+    def _absorb_rx(self) -> None:
+        """Pull bytes off every readable socket into its inbuf WITHOUT
+        parsing or delivery. confirm()'s drain loop calls this so a peer
+        in the same situation can empty ITS kernel tx window (mutual
+        large sends would otherwise deadlock on full buffers) — and
+        because nothing is dispatched, there is no re-entrant AM handling;
+        the next progress() pass parses what landed here."""
+        for key, _mask in self._sel.select(timeout=0):
+            kind, conn = key.data
+            if kind == "accept":
+                continue               # leave accepts to progress()
+            try:
+                while True:
+                    chunk = conn.sock.recv(1 << 18)
+                    if not chunk:
+                        break          # EOF — progress() will close it
+                    conn.inbuf.extend(chunk)
+            except (BlockingIOError, InterruptedError):
+                pass
+            except OSError:
+                pass
 
     def confirm(self, peer: int) -> None:
         """Drain the peer's outbuf to the kernel, raising if the
@@ -167,7 +188,8 @@ class TcpTransport(T.Transport):
                     raise OSError(
                         f"tcp to rank {peer}: outbuf not draining "
                         f"({conn.out_bytes} bytes stuck)")
-                time.sleep(0.0002)     # kernel buffer full: let it drain
+                self._absorb_rx()      # keep rx moving: no mutual-send
+                time.sleep(0.0002)     # deadlock on full kernel buffers
         if peer in self.failed_peers:
             raise OSError(f"tcp connection to rank {peer} has failed")
 
@@ -221,6 +243,11 @@ class TcpTransport(T.Transport):
                 self._sel.register(sock, selectors.EVENT_READ, ("rx", c))
                 continue
             events += self._drain(conn)
+        # frames absorbed during confirm() sit in inbufs with no further
+        # socket readability to re-trigger select — parse them now
+        for conn in list(self._rx) + list(self._tx.values()):
+            if conn.inbuf:
+                events += self._parse(conn)
         # drain pending sends even when sockets never became readable
         for conn in self._tx.values():
             if conn.outbuf:
@@ -242,6 +269,12 @@ class TcpTransport(T.Transport):
             pass
         except OSError:
             eof = True
+        delivered = self._parse(conn)
+        if eof:
+            self._close(conn)
+        return delivered
+
+    def _parse(self, conn: _Conn) -> int:
         delivered = 0
         buf = conn.inbuf
         while len(buf) >= _HDR.size:
@@ -257,8 +290,6 @@ class TcpTransport(T.Transport):
             else:
                 self.deliver(conn.peer, tag, header, payload)
                 delivered += 1
-        if eof:
-            self._close(conn)
         return delivered
 
     def _close(self, conn: _Conn) -> None:

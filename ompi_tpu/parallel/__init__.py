@@ -64,6 +64,18 @@ def attach_mesh(comm, mesh, axis: str) -> None:
 
         comm.coll = InterXlaColl()
         return
+    if axis is None:
+        # topology-only attach: the comm's ranks tile the WHOLE (possibly
+        # multi-axis) mesh — records the machine hierarchy for topology
+        # mapping (topo.cart_create reorder / hierarchy.auto_levels)
+        # without electing a collective axis
+        if comm.size != 1 and mesh.size != comm.size:
+            raise ValueError(
+                f"mesh has {mesh.size} devices but comm {comm.name} has "
+                f"{comm.size} ranks")
+        comm.device_mesh = mesh
+        comm.device_axis = None
+        return
     if comm.size != 1 and mesh.shape[axis] != comm.size:
         raise ValueError(
             f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "

@@ -160,18 +160,113 @@ class DistGraphTopo:
 # communicator-level constructors (≙ ompi/mpi/c/cart_create.c etc.)
 # ---------------------------------------------------------------------------
 
+def _affinity_matrix(comm, topo) -> "np.ndarray":
+    """Symmetric rank-affinity weights, agreed on every rank (COLLECTIVE —
+    one allgather). Observed traffic (spc peer matrix, ≙ the monitoring
+    component treematch feeds on) wins; with no history the upcoming
+    topology's adjacency is the predictor (each grid edge weight 1)."""
+    import numpy as np
+    n = comm.size
+    mine = np.zeros(n, np.int64)
+    spc = getattr(comm.ctx, "spc", None)
+    if spc is not None:
+        mat = spc.matrix()
+        for direction in ("tx", "rx"):
+            for world_peer, (_msgs, nbytes) in mat[direction].items():
+                try:
+                    r = comm.group.rank_of_world(world_peer)
+                except Exception:
+                    continue
+                if 0 <= r < n:
+                    mine[r] += nbytes
+    rows = np.asarray(comm.coll.allgather(comm, mine))     # (n, n)
+    w = rows + rows.T                                      # symmetric
+    if not w.any():
+        for r in range(min(topo.size, n)):                 # predicted halo
+            for nb in topo.neighbors(r):
+                w[r, nb] += 1
+                w[nb, r] += 1
+    return w
+
+
+def _treematch_perm(w, n_groups: int, group_size: int) -> List[int]:
+    """Greedy bottom-up grouping (the treematch core idea,
+    topo_treematch_dist_graph_create.c): heaviest-affinity ranks land in
+    the same group so their traffic stays on the fast (ICI) level.
+    Deterministic: edges sort by (-weight, i, j), groups by smallest
+    member. Returns perm: new position → old rank."""
+    n = n_groups * group_size
+    parent = list(range(n))
+    sizes = [1] * n
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    edges = sorted(((int(w[i, j]), i, j)
+                    for i in range(n) for j in range(i + 1, n)
+                    if w[i, j] > 0), key=lambda e: (-e[0], e[1], e[2]))
+    for _wt, i, j in edges:
+        a, b = find(i), find(j)
+        if a != b and sizes[a] + sizes[b] <= group_size:
+            parent[b] = a
+            sizes[a] += sizes[b]
+    clusters: dict = {}
+    for r in range(n):
+        clusters.setdefault(find(r), []).append(r)
+    # pack clusters into exactly n_groups bins (first-fit decreasing);
+    # a cluster that fits no bin (e.g. sizes 3+3+2 into 4+4) SPLITS — the
+    # grouping is best-effort, never a failure (treematch does the same
+    # when the affinity tree doesn't tile the machine tree)
+    bins: List[List[int]] = [[] for _ in range(n_groups)]
+    for cl in sorted(clusters.values(), key=lambda c: (-len(c), c[0])):
+        tgt = next((b for b in bins if len(b) + len(cl) <= group_size),
+                   None)
+        if tgt is not None:
+            tgt.extend(cl)
+            continue
+        for r in cl:                   # split across remaining space
+            next(b for b in bins if len(b) < group_size).append(r)
+    bins.sort(key=lambda b: b[0] if b else n)
+    return [r for b in bins for r in sorted(b)]
+
+
 def cart_create(comm, dims: Sequence[int], periods: Optional[Sequence[bool]]
                 = None, reorder: bool = False, name: str = "cart"):
     """MPI_Cart_create: returns a new communicator with ``comm.topo`` set,
-    or None for ranks beyond the grid. ``reorder`` is accepted and ignored
-    (rank order already matches the mesh axis order — see module docstring)."""
+    or None for ranks beyond the grid.
+
+    ``reorder=True`` runs the treematch analog
+    (≙ ompi/mca/topo/treematch/topo_treematch_dist_graph_create.c): rank
+    affinity (observed spc traffic, else the grid's own adjacency) is
+    grouped bottom-up onto the communicator's device-mesh hierarchy
+    (auto_levels: ICI axes inner, DCN outer — parallel/hierarchy.py), so
+    heavy-traffic pairs land in the same inner (ICI) block and cross-outer
+    (DCN) bytes shrink. Without an attached mesh there is no hierarchy to
+    map onto and the order is kept."""
     periods = [False] * len(dims) if periods is None else list(periods)
     topo = CartTopo(dims, periods)
     if topo.size > comm.size:
         raise ValueError(f"cartesian grid {dims} needs {topo.size} ranks, "
                          f"comm has {comm.size}")
+    key = comm.rank
+    mesh = getattr(comm, "device_mesh", None)
+    # reorder only when the grid covers the whole comm: with excluded
+    # ranks the permutation's bin structure would not survive the carve
+    # (excluded ranks leave holes in the inner blocks)
+    if reorder and mesh is not None and comm.size > 1 \
+            and topo.size == comm.size:
+        from .parallel.hierarchy import auto_levels
+        _inner, outer = auto_levels(mesh)
+        n_groups = int(mesh.shape[outer])
+        if comm.size % n_groups == 0 and n_groups > 1:
+            w = _affinity_matrix(comm, topo)
+            perm = _treematch_perm(w, n_groups, comm.size // n_groups)
+            key = perm.index(comm.rank)
     color = 0 if comm.rank < topo.size else None
-    newcomm = comm.split(color, key=comm.rank, name=name)
+    newcomm = comm.split(color, key=key, name=name)
     if newcomm is not None:
         newcomm.topo = topo
     return newcomm

@@ -129,3 +129,50 @@ def test_halo_exchange_2d_stencil():
             np.testing.assert_array_equal(halos[dim], np.full(4, float(src)))
         return True
     assert all(runtime.run_ranks(4, body))
+
+
+def test_cart_create_reorder_treematch_reduces_cross_outer_bytes():
+    """Treematch analog (round-2 verdict item 7): with observed traffic
+    concentrated on pairs that the row-major mapping splits across the
+    outer ('slice') mesh axis, cart_create(reorder=True) regroups ranks so
+    heavy pairs share an inner (ICI) block — structural assert: cross-outer
+    affinity bytes strictly drop vs the unreordered mapping."""
+    from ompi_tpu.core import var
+    var.registry.set_cli("monitoring_enabled", "1")   # the comm matrix
+    var.registry.reset_cache()
+
+    def body(ctx):
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+        c = ctx.comm_world                       # 8 ranks
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        attach_mesh(c, mesh, None)               # hierarchy: 2 slices of 4
+        # traffic: rank r talks ONLY to r^4 — every pair straddles the
+        # outer axis under the identity mapping (r//4 differs)
+        peer = ctx.rank ^ 4
+        for _ in range(3):
+            c.sendrecv(np.arange(256, dtype=np.float64), peer,
+                       np.zeros(256), peer)
+        cart = topo.cart_create(c, dims=[8], reorder=True, name="tm")
+        assert cart is not None
+        # reconstruct the agreed mapping: old world rank at each new rank
+        order = np.asarray(cart.coll.allgather(
+            cart, np.array([ctx.rank], np.int64))).reshape(-1)
+
+        def cross_outer(mapping):
+            groups = {int(r): p // 4 for p, r in enumerate(mapping)}
+            return sum(1 for r in range(8) if groups[r] != groups[r ^ 4])
+
+        before = cross_outer(list(range(8)))
+        after = cross_outer(order)
+        assert before == 8                       # identity splits all pairs
+        assert after == 0, (order, after)        # reorder heals them all
+        # the cart comm still works as a communicator
+        tok = cart.coll.allreduce(cart, np.array([1.0]))
+        assert float(np.asarray(tok)[0]) == 8.0
+        return True
+
+    try:
+        assert all(runtime.run_ranks(8, body, timeout=240))
+    finally:
+        var.registry.clear_cli("monitoring_enabled")
+        var.registry.reset_cache()
