@@ -106,7 +106,7 @@ def _ensure_components() -> None:
     analog of the reference opening a framework's components before any
     selection (mca_base_framework.c:161)."""
     import importlib
-    for m in ("basic", "selfcoll", "tuned", "xla", "nbc"):
+    for m in ("basic", "selfcoll", "tuned", "xla", "nbc", "adapt"):
         try:
             importlib.import_module(f"{__package__}.{m}")
         except ImportError:  # pragma: no cover — reduced build

@@ -285,3 +285,75 @@ def test_ineighbor_schedules_on_cart():
         return True
 
     assert all(runtime.run_ranks(3, fn))
+
+
+class TestAdaptColl:
+    """Event-driven adaptive-segmentation collectives (coll/adapt analog,
+    coll_adapt_bcast.c) — round-2 verdict item 9."""
+
+    def test_adapt_bcast_correct_and_adapts(self):
+        import numpy as np
+        from ompi_tpu import runtime
+        from ompi_tpu.coll import adapt as A
+
+        def fn(ctx):
+            c = ctx.comm_world
+            n = 1 << 18                      # 2 MB
+            buf = (np.arange(n, dtype=np.float64) if ctx.rank == 1
+                   else np.zeros(n, np.float64))
+            inst = A._AdaptBcast(c, buf, 1, -1250)
+            inst.start().wait(timeout=120)
+            assert np.array_equal(buf, np.arange(n))
+            if ctx.rank == 1:
+                # the controller moved: fast completions must have grown
+                # the segment beyond the floor (the 'adapt' in adapt)
+                assert inst.seg > inst.seg_min, (inst.seg, inst.seg_min)
+                assert inst.segments_sent < n * 8 // inst.seg_min
+            return True
+
+        assert all(runtime.run_ranks(3, fn, timeout=240))
+
+    def test_adapt_reduce_correct(self):
+        import numpy as np
+        from ompi_tpu import runtime
+        from ompi_tpu.coll.adapt import ireduce_adapt
+        from ompi_tpu.op import MAX
+
+        def fn(ctx):
+            c = ctx.comm_world
+            n = 1 << 16
+            r = ireduce_adapt(c, np.full(n, float(ctx.rank + 1)), root=2)
+            r.wait(timeout=120)
+            if ctx.rank == 2:
+                assert np.array_equal(r.result, np.full(n, 6.0))  # 1+2+3
+            r2 = ireduce_adapt(c, np.full(4, float(ctx.rank)), op=MAX,
+                               root=0)
+            r2.wait(timeout=60)
+            if ctx.rank == 0:
+                assert np.array_equal(r2.result, np.full(4, 2.0))
+            return True
+
+        assert all(runtime.run_ranks(3, fn, timeout=240))
+
+    def test_adapt_component_selectable(self):
+        from ompi_tpu import runtime
+        from ompi_tpu.core import var
+
+        var.registry.set_cli("coll_adapt_priority", "90")
+        var.registry.reset_cache()
+        try:
+            import numpy as np
+
+            def fn(ctx):
+                c = ctx.comm_world
+                assert c.coll.provider("ibcast") == "adapt"
+                buf = (np.arange(64, dtype=np.float64) if ctx.rank == 0
+                       else np.zeros(64))
+                c.coll.ibcast(c, buf, root=0).wait(timeout=60)
+                np.testing.assert_array_equal(buf, np.arange(64))
+                return True
+
+            assert all(runtime.run_ranks(2, fn, timeout=120))
+        finally:
+            var.registry.clear_cli("coll_adapt_priority")
+            var.registry.reset_cache()
