@@ -44,7 +44,8 @@ import numpy as np
 NORTH_STAR_COUNT = 4 * 1024 * 1024          # float32[4M] per rank
 SIZES = [2, 256, 16 * 1024, 262_144, NORTH_STAR_COUNT, 16 * 1024 * 1024]
 # counts of float32 → 8B, 1KB, 64KB, 1MB, 16MB, 64MB per rank
-COLLS = ["allreduce", "bcast", "allgather", "alltoall"]
+COLLS = ["allreduce", "bcast", "allgather", "alltoall",
+         "allgatherv", "alltoallv"]
 
 
 def pick_platform(probe_timeout: float = 120.0) -> str:
@@ -234,6 +235,16 @@ def run_sweep(platform: str) -> dict:
             xi.block_until_ready()
         max_reps = (len(xs) - 2) if _PARANOID_BARRIER else 50
 
+        # ragged-collective fixtures (VERDICT r3 item 2): an uneven
+        # circulant split of the per-rank count — column sums conserved,
+        # the dropless-MoE routing shape. Shared by allgatherv/alltoallv.
+        per = count // rows
+        vbase = [(per - per // 2) if j % 2 == 0 else (per + per // 2)
+                 for j in range(rows)]
+        if vbase:
+            vbase[-1] += count - sum(vbase)     # exact total at odd rows
+        vC = np.stack([np.roll(vbase, -i) for i in range(rows)])
+
         for coll in COLLS:
             if coll == "allgather" and rows * rows * nbytes > 1 << 30:
                 # R²× output blowup would exceed the 1 GB footprint cap —
@@ -253,6 +264,7 @@ def run_sweep(platform: str) -> dict:
                                f"ranks"})
                 continue
 
+            row_nbytes = nbytes        # per-rank bytes actually moved
             if coll == "allreduce":
                 dev = lambda k: _settle(dc.allreduce(xs[k % len(xs)], SUM))
                 ref = host_rows.sum(axis=0, dtype=np.float32)
@@ -283,7 +295,7 @@ def run_sweep(platform: str) -> dict:
                     _settle(jax.device_put(
                         jnp.asarray(np.broadcast_to(cat, (rows, rows * count))),
                         dc.sharding()))
-            else:                             # alltoall
+            elif coll == "alltoall":
                 dev = lambda k: _settle(dc.alltoall(
                     xs[k % len(xs)].reshape(rows, rows, count // rows)))
                 ref = None
@@ -294,6 +306,77 @@ def run_sweep(platform: str) -> dict:
                     tr = np.ascontiguousarray(np.swapaxes(h, 0, 1))
                     _settle(jax.device_put(
                         jnp.asarray(tr.reshape(rows, count)), dc.sharding()))
+            elif coll == "allgatherv":
+                if per < 1:
+                    results.append({
+                        "collective": coll, "bytes_per_rank": nbytes,
+                        "ranks": rows,
+                        "skipped": f"count {count} < {rows} ranks"})
+                    continue
+                # vbase splits `count` ACROSS ranks → per-rank bytes is
+                # count/rows, not count (unlike allgather where every rank
+                # sends count); record it honestly
+                row_nbytes = per * 4
+                vxs, counts_list = [], None
+                for i in range(len(xs)):
+                    v, counts_list = dc.pad_ragged(
+                        [host_rows[rr, :c] + np.float32(i)
+                         for rr, c in enumerate(vbase)])
+                    vxs.append(v)
+                for v in vxs:
+                    v.block_until_ready()
+                dev = lambda k: _settle(
+                    dc.allgatherv(vxs[k % len(vxs)], counts_list))
+                ref = None
+
+                def staged(k):
+                    h = np.asarray(jax.device_get(vxs[k % len(vxs)]))
+                    cat = np.concatenate(
+                        [h[rr, :c] for rr, c in enumerate(vbase)])
+                    _settle(jax.device_put(
+                        jnp.asarray(np.broadcast_to(cat, (rows, len(cat)))),
+                        dc.sharding()))
+            else:                             # alltoallv (the MoE/EP shape)
+                vcap = dc._bucket(int(vC.max())) if per >= 1 else 0
+                if per < 1 or rows * rows * vcap * 4 > 1 << 27:
+                    results.append({
+                        "collective": coll, "bytes_per_rank": nbytes,
+                        "ranks": rows,
+                        "skipped": (f"count {count} < {rows} ranks"
+                                    if per < 1 else
+                                    f"padded blocks {rows}x{rows}x{vcap}x4B "
+                                    f"= {rows * rows * vcap * 4 >> 20} MiB "
+                                    f"exceed the 128 MiB per-input cap")})
+                    continue
+                bxs = []
+                for i in range(len(xs)):
+                    blk = np.zeros((rows, rows, vcap), np.float32)
+                    for rr in range(rows):
+                        off = 0
+                        for jj in range(rows):
+                            c = int(vC[rr, jj])
+                            blk[rr, jj, :c] = host_rows[rr, off:off + c] \
+                                + np.float32(i)
+                            off += c
+                    bxs.append(jax.device_put(jnp.asarray(blk),
+                                              dc.sharding()))
+                for v in bxs:
+                    v.block_until_ready()
+                dev = lambda k: _settle(
+                    dc.alltoallv(bxs[k % len(bxs)], vC)[0])
+                ref = None
+                out_cap = dc._bucket(int(vC.sum(axis=0).max()))
+
+                def staged(k):
+                    h = np.asarray(jax.device_get(bxs[k % len(bxs)]))
+                    out = np.zeros((rows, out_cap), np.float32)
+                    for jj in range(rows):
+                        pos = 0
+                        for ii in range(rows):
+                            c = int(vC[ii, jj])
+                            out[jj, pos:pos + c] = h[ii, jj, :c]
+                            pos += c
+                    _settle(jax.device_put(jnp.asarray(out), dc.sharding()))
 
             # correctness cross-check — including the north-star shape the
             # headline number is published from
@@ -308,12 +391,12 @@ def run_sweep(platform: str) -> dict:
             staged_t = _time_op(staged, max_reps=max_reps)
             results.append({
                 "collective": coll,
-                "bytes_per_rank": nbytes,
+                "bytes_per_rank": row_nbytes,
                 "ranks": rows,
                 "device_us": round(dev_t * 1e6, 1),
                 "staged_us": round(staged_t * 1e6, 1),
-                "device_GBps": round(nbytes / dev_t / 1e9, 3),
-                "staged_GBps": round(nbytes / staged_t / 1e9, 3),
+                "device_GBps": round(row_nbytes / dev_t / 1e9, 3),
+                "staged_GBps": round(row_nbytes / staged_t / 1e9, 3),
                 "speedup_vs_staged": round(staged_t / dev_t, 2),
             })
     # device-resident one-sided: steady-state fence latency for a halo-ish

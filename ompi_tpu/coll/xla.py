@@ -93,12 +93,14 @@ class XlaModule(CollModule):
         self.host.barrier(comm)
         self.dc.barrier()
 
-    # -- long-tail entries without a native ICI program (v-variants,
-    # rooted gathers/scatters): the coll/accelerator staging discipline
-    # (coll_accelerator_allreduce.c:31-60) — stage device buffers to host
-    # EXPLICITLY (SPC-accounted, never an implicit np.asarray deep in a
-    # host algorithm), then run the host algorithm chain. Native ICI
-    # versions can supersede these entry-by-entry later.
+    # -- ragged / rooted entries: NATIVE ICI programs when the caller
+    # presents the canonical padded device layout (DeviceComm docstring),
+    # staged-host fallback otherwise. The reference implements these as
+    # first-class host algorithms (coll_base_alltoallv.c:194 pairwise,
+    # coll_base_allgatherv.c:95 bruck, coll_base_gather.c:41 binomial,
+    # coll_base_scatter.c:63); the TPU-first shape is padded blocks + a
+    # gather-map device argument (parallel/collectives.py ragged section),
+    # so the EP/MoE alltoallv hot path never leaves ICI.
 
     def _to_host(self, x):
         from .. import accelerator
@@ -112,33 +114,89 @@ class XlaModule(CollModule):
             spc.inc("coll_staged_fallbacks")
         return np.asarray(x)
 
+    def _rows_ok(self, x, need_ndim: int) -> bool:
+        """Canonical-layout gate: device buffer whose row dim covers the
+        mesh axis (R % n == 0). Per-rank host-style buffers (the size>1
+        process regime) miss the gate and stage — the same buffer-type
+        dispatch check_addr does for host vs device."""
+        if not _is_device(x) or x.ndim < need_ndim:
+            return False
+        R = x.shape[0]
+        return R > 0 and R % self.dc.n == 0
+
     def allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
                    displs=None):
+        if (counts is not None and displs is None and recvbuf is None
+                and self._rows_ok(sendbuf, 2)
+                and len(counts) == sendbuf.shape[0]
+                and sendbuf.shape[1] >= max(int(c) for c in counts)):
+            return self.dc.allgatherv(sendbuf, counts)
         return self.host.allgatherv(comm, self._to_host(sendbuf), recvbuf,
                                     counts, displs)
 
     def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        if recvbuf is None and self._rows_ok(sendbuf, 2):
+            return self.dc.gather(sendbuf, root)
         return self.host.gather(comm, self._to_host(sendbuf), recvbuf, root)
 
     def gatherv(self, comm, sendbuf, recvbuf=None, counts=None, displs=None,
                 root: int = 0):
+        if (counts is not None and displs is None and recvbuf is None
+                and self._rows_ok(sendbuf, 2)
+                and len(counts) == sendbuf.shape[0]
+                and sendbuf.shape[1] >= max(int(c) for c in counts)):
+            return self.dc.gatherv(sendbuf, counts, root)
         return self.host.basic.gatherv(comm, self._to_host(sendbuf), recvbuf,
                                        counts, displs, root)
 
     def scatter(self, comm, sendbuf, recvbuf=None, root: int = 0):
+        if (recvbuf is None and self._rows_ok(sendbuf, 3)
+                and sendbuf.shape[0] == sendbuf.shape[1]):
+            return self.dc.scatter(sendbuf, root)
         return self.host.scatter(comm, self._to_host(sendbuf), recvbuf, root)
 
     def scatterv(self, comm, sendbuf, recvbuf, counts, displs=None,
                  root: int = 0):
+        if (recvbuf is None and displs is None
+                and self._rows_ok(sendbuf, 3)
+                and sendbuf.shape[0] == sendbuf.shape[1]
+                and len(counts) == sendbuf.shape[0]
+                and sendbuf.shape[2] >= max(int(c) for c in counts)):
+            return self.dc.scatterv(sendbuf, counts, root)
         return self.host.basic.scatterv(comm, self._to_host(sendbuf),
                                         recvbuf, counts, displs, root)
 
     def alltoallv(self, comm, sendbuf, recvbuf, sendcounts, recvcounts,
                   sdispls=None, rdispls=None):
+        C = np.asarray(sendcounts)
+        if (recvbuf is None and sdispls is None and rdispls is None
+                and C.ndim == 2 and C.shape[0] == C.shape[1]
+                and self._rows_ok(sendbuf, 3)
+                and sendbuf.shape[0] == sendbuf.shape[1] == C.shape[0]
+                and sendbuf.shape[2] >= int(C.max())):
+            if recvcounts is not None:
+                RC = np.asarray(recvcounts)
+                # accept either the per-destination totals vector or the
+                # stacked per-rank matrix (row j = what j receives from
+                # each source, i.e. C.T)
+                ok = (np.array_equal(RC, C.T) if RC.ndim == 2
+                      else np.array_equal(RC.ravel(), C.sum(axis=0)))
+                if not ok:
+                    raise ValueError(
+                        "alltoallv: recvcounts disagree with sendcounts "
+                        f"({recvcounts} vs column sums "
+                        f"{C.sum(axis=0).tolist()})")
+            out, _tot = self.dc.alltoallv(sendbuf, C)
+            return out
         return self.host.alltoallv(comm, self._to_host(sendbuf), recvbuf,
                                    sendcounts, recvcounts, sdispls, rdispls)
 
     def reduce_scatter(self, comm, sendbuf, recvbuf, counts, op: Op = None):
+        op = op or SUM
+        if (recvbuf is None and self._rows_ok(sendbuf, 2)
+                and len(counts) == sendbuf.shape[0]
+                and int(np.sum(counts)) == sendbuf.shape[1]):
+            return self.dc.reduce_scatter_v(sendbuf, counts, op)
         return self.host.reduce_scatter(comm, self._to_host(sendbuf),
                                         recvbuf, counts, op)
 

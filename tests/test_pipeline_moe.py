@@ -122,3 +122,70 @@ class TestMoE:
         cfg2 = Config(**{**cfg.__dict__, "moe_aux_weight": 1.0})
         l1 = float(loss_fn(p, tokens, cfg2))
         assert l1 > l0      # aux contributes
+
+
+class TestRaggedEP:
+    """Dropless EP routing over the native device alltoallv (VERDICT r3
+    item 2): uneven per-expert token counts, zero host staging of token
+    payloads, and executable reuse across routing patterns."""
+
+    def _dc(self, n=8):
+        from ompi_tpu.parallel import DeviceComm
+        return DeviceComm(make_mesh({"x": n}), "x")
+
+    def test_route_and_combine_roundtrip_through_experts(self):
+        dc = self._dc()
+        R, T, d = 8, 16, 4
+        rng = np.random.default_rng(0)
+        owner = rng.integers(0, R, size=(R, T))
+        tokens_h = rng.normal(size=(R, T, d)).astype(np.float32)
+        tokens = dc.from_ranks(list(tokens_h))
+
+        recv, recv_counts, ctx = moe_mod.ragged_ep_route(dc, tokens, owner)
+        assert recv_counts == [int(c) for c in
+                              np.bincount(owner.ravel(), minlength=R)]
+        # "expert" on rank j scales by (j + 1); padding rows are zeros so
+        # scaling is safe without masking
+        scale = np.arange(1, R + 1, dtype=np.float32)
+        outputs = recv * dc.from_ranks(
+            [np.full((recv.shape[1], d), s, np.float32) for s in scale])
+        back = moe_mod.ragged_ep_combine(dc, outputs, ctx)
+        got = np.asarray(jax.device_get(back))
+        expect = tokens_h * (owner[..., None] + 1.0)
+        np.testing.assert_allclose(got, expect, rtol=1e-5)
+
+    def test_routing_change_reuses_executables(self):
+        dc = self._dc()
+        R, T, d = 8, 8, 2
+        rng = np.random.default_rng(1)
+        tokens = dc.from_ranks(
+            list(rng.normal(size=(R, T, d)).astype(np.float32)))
+        # two different routings with the same per-dest totals (circulant)
+        base = np.arange(R) % R
+
+        def route(shift):
+            owner = np.stack([(base + i + shift) % R for i in range(R)])
+            recv, cnt, ctx = moe_mod.ragged_ep_route(dc, tokens, owner)
+            moe_mod.ragged_ep_combine(dc, recv, ctx)
+
+        route(0)
+        entries = dc.cache_info()["entries"]
+        route(1)
+        route(3)
+        assert dc.cache_info()["entries"] == entries
+
+    def test_uneven_counts_no_drop(self):
+        """All tokens of a heavily skewed routing arrive (dropless —
+        the case capacity-factor moe_block drops)."""
+        dc = self._dc()
+        R, T, d = 8, 8, 2
+        owner = np.zeros((R, T), int)           # everyone routes to rank 0
+        tokens_h = np.arange(R * T * d, dtype=np.float32).reshape(R, T, d)
+        recv, cnt, ctx = moe_mod.ragged_ep_route(
+            dc, dc.from_ranks(list(tokens_h)), owner)
+        assert cnt == [R * T] + [0] * (R - 1)
+        row0 = np.asarray(jax.device_get(recv))[0]
+        np.testing.assert_allclose(row0[:R * T], tokens_h.reshape(-1, d))
+        back = moe_mod.ragged_ep_combine(dc, recv, ctx)
+        np.testing.assert_allclose(np.asarray(jax.device_get(back)),
+                                   tokens_h)
