@@ -1,0 +1,82 @@
+"""Device-payload p2p channel — the ICI path for send/recv of HBM arrays.
+
+≙ the role split of the reference's GPU p2p: a device-direct transport when
+both endpoints share a fabric (opal/mca/btl/smcuda/btl_smcuda.c — GPU-IPC
+transfers that never touch host) with host staging as the universal
+fallback (ompi/mca/pml/ob1/pml_ob1_accelerator.c). Here the "fabric" is
+the JAX runtime itself:
+
+* **In-process ranks** (threaded run_ranks, single-controller drivers): the
+  sender parks its immutable jax array in a process-local exchange table;
+  the receiver claims it at match time and, if its posted template lives
+  under a different sharding, moves it with ``jax.device_put`` — a PJRT
+  buffer-to-buffer copy (D2D on real hardware), never a host round trip.
+  Eligibility is advertised per (job, rank) at pml init, so a sender knows
+  locally whether the destination shares its process.
+
+* **Cross-process ranks**: the table misses at send time and the pml keeps
+  the explicit staged path (stage_out → wire → stage_in), exactly the
+  reference's accelerator-staging protocol. Rank-per-chip SPMD programs
+  move rows with ``DeviceComm.push_row`` (one-hop collective-permute)
+  instead of two-sided sends — the compilation-space shape of this
+  channel (SURVEY.md §7 phase 4c).
+
+The table holds strong references only between isend and the matching
+recv; entries are keyed by the same (cid, src, dst, seq) tuple the
+matching engine orders on, so MPI non-overtaking holds automatically.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_lock = threading.Lock()
+_procs: Dict[Tuple[str, int], int] = {}       # (job_id, rank) → pid/thread
+_table: Dict[Tuple[str, int, int, int, int], Any] = {}
+
+
+def register(job_id: str, rank: int) -> None:
+    with _lock:
+        _procs[(job_id, rank)] = 1
+
+
+def unregister(job_id: str, rank: int) -> None:
+    with _lock:
+        _procs.pop((job_id, rank), None)
+        stale = [k for k in _table if k[0] == job_id
+                 and (k[2] == rank or k[3] == rank)]
+        for k in stale:
+            del _table[k]
+
+
+def same_process(job_id: str, rank: int) -> bool:
+    """True when ``rank`` of this job runs in this OS process (its pml
+    registered here) — the eligibility gate for the in-process D2D hop."""
+    return (job_id, rank) in _procs
+
+
+def offer(job_id: str, cid: int, src: int, dst: int, seq: int,
+          arr: Any) -> None:
+    with _lock:
+        _table[(job_id, cid, src, dst, seq)] = arr
+
+
+def take(job_id: str, cid: int, src: int, dst: int,
+         seq: int) -> Optional[Any]:
+    with _lock:
+        return _table.pop((job_id, cid, src, dst, seq), None)
+
+
+def deliver(arr, template) -> Any:
+    """Land a claimed device array on the receiver's side: reshard with a
+    PJRT copy only when the posted template pins a different sharding;
+    otherwise the immutable array is the result as-is (zero copies)."""
+    if template is None:
+        return arr
+    import jax
+
+    tgt = getattr(template, "sharding", None)
+    if tgt is None or tgt == getattr(arr, "sharding", None):
+        return arr
+    return jax.device_put(arr, tgt)

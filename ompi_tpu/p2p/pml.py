@@ -221,9 +221,18 @@ class P2P:
         self._rreq = itertools.count(1)
         self._pending_send: Dict[int, _SendState] = {}
         self._pending_recv: Dict[int, _RecvState] = {}
+        # comms with an attached device mesh (parallel.attach_mesh) — their
+        # device payloads ride the ICI channel instead of staging
+        self.device_cids: set = set()
+        from . import devchan
+        devchan.register(bootstrap.job_id, self.rank)
         for t in layer.transports:
             t.dispatch[T.AM_P2P] = self._am_handler
             engine.register(t.progress)
+
+    def finalize(self) -> None:
+        from . import devchan
+        devchan.unregister(self.bootstrap.job_id, self.rank)
 
     # -- send ---------------------------------------------------------------
 
@@ -233,6 +242,42 @@ class P2P:
               sync: bool = False) -> Request:
         info = _accel.check_addr(buf)
         raw = None            # contiguous host array: CMA single-copy donor
+        if info is not None and cid in self.device_cids \
+                and datatype is None and count is None and not sync:
+            from . import devchan
+            if devchan.same_process(self.bootstrap.job_id, dst):
+                # ICI device channel: the payload never leaves HBM — park
+                # the immutable array, ship a header-only match (≙ the
+                # device-direct btl/smcuda path; staging below remains the
+                # cross-process fallback, ≙ pml_ob1_accelerator.c)
+                arr = buf.array if isinstance(buf, _accel.DeviceBuffer) \
+                    else buf
+                seq = self._send_seq[(cid, dst)]
+                self._send_seq[(cid, dst)] = seq + 1
+                devchan.offer(self.bootstrap.job_id, cid, self.rank, dst,
+                              seq, arr)
+                req = Request()
+                req.status.source = self.rank
+                req.status.tag = tag
+                req.status.count = info.nbytes
+                if peruse.active:
+                    peruse.fire(peruse.REQ_ACTIVATE, kind="send", peer=dst,
+                                tag=tag, cid=cid, nbytes=info.nbytes)
+                # rides as an EXTENDED RNDV header (like cma): the native
+                # engine preserves those losslessly via its token path,
+                # where plain-match headers are reconstructed from the wire
+                # struct and would drop the flag
+                self.layer.for_peer(dst).send(
+                    dst, T.AM_P2P,
+                    {"k": "rndv", "cid": cid, "tag": tag, "seq": seq,
+                     "size": info.nbytes, "sreq": 0, "dev": 1}, b"")
+                req.complete()   # array is immutable: complete at park time
+                self.spc.inc("isends")
+                self.spc.inc("bytes_sent", info.nbytes)  # tx/rx invariant
+                self.spc.inc("device_channel_msgs")
+                self.spc.inc("device_channel_bytes", info.nbytes)
+                self.spc.peer_traffic("tx", dst, info.nbytes)
+                return req
         if info is not None:   # explicit device staging, never np.asarray
             if datatype is not None and count is None:
                 count = _capacity_count(info.nbytes, datatype)
@@ -347,6 +392,41 @@ class P2P:
             capacity = dt.size * cnt
             req.status.source = u.src
             req.status.tag = u.tag
+            if u.header.get("dev"):
+                # ICI device channel: claim the parked HBM array — no wire
+                # payload, no ACK (the sender completed at park time; its
+                # sreq is a placeholder). Truncation completes in error
+                # without the rndv NACK.
+                from . import devchan
+                darr = devchan.take(self.bootstrap.job_id, u.header["cid"],
+                                    u.src, self.rank, u.header["seq"])
+                if darr is None:
+                    req.complete(RuntimeError(
+                        "device-channel message lost: sender finalized "
+                        "before the receive matched"))
+                    return
+                if u.header["size"] > capacity:
+                    show_help.show("truncate", capacity, u.header["size"],
+                                   u.tag, u.src)
+                    req.complete(TruncateError(
+                        f"recv buffer {capacity}B < device message "
+                        f"{u.header['size']}B"))
+                    return
+                if dinfo is not None:
+                    result = devchan.deliver(darr, template)
+                    if isinstance(buf, _accel.DeviceBuffer):
+                        buf.array = result
+                    req.result = result
+                else:
+                    # receiver posted a host buffer: ONE explicit D2H (the
+                    # asarray); unpack reads the view without re-copying
+                    hostv = np.asarray(darr).reshape(-1).view(np.uint8)
+                    Convertor(arr, dt, cnt).unpack(hostv)
+                    self.spc.inc("device_stage_in_bytes", len(hostv))
+                self.spc.inc("device_channel_msgs")
+                req.status.count = u.header["size"]
+                req.complete()
+                return
             if u.header["size"] > capacity:
                 show_help.show("truncate", capacity, u.header["size"],
                                u.tag, u.src)

@@ -141,8 +141,10 @@ class NativeMatching:
                               payload, len(payload))
         else:
             token = -1
-            if "cma" in header:   # only extended headers need a token; a
-                # plain rndv reconstructs losslessly from the event fields
+            if "cma" in header or "dev" in header:
+                # only extended headers need a token (cma advertisement,
+                # device-channel flag); a plain rndv reconstructs
+                # losslessly from the event fields
                 token = next(p._token_ids)
                 p._tokens[token] = header
             p._lib.mx_arrived(p._mxh, src, cid, tag, seq, header["size"],
@@ -211,6 +213,7 @@ class NativeP2P(P2P):
         engine.register(self._mx_progress)
 
     def finalize(self) -> None:
+        super().finalize()
         if self._mxh >= 0:
             self._lib.mx_destroy(self._mxh)
             self._mxh = -1

@@ -50,7 +50,7 @@ def encode(am_tag: int, header: Dict[str, Any]) -> bytes:
     if k == "match":
         return _P2P.pack(_FMT_P2P, am_tag, _K_MATCH, header["cid"],
                          header["tag"], header["seq"], header["size"], 0, 0)
-    if k == "rndv" and "cma" not in header:
+    if k == "rndv" and "cma" not in header and "dev" not in header:
         # a CMA-advertising rndv (and its fin reply) carries extra fields;
         # it rides the generic format — one frame per LARGE message, so
         # codec cost is irrelevant there, unlike the per-fragment fast path

@@ -83,6 +83,10 @@ def attach_mesh(comm, mesh, axis: str) -> None:
     comm.device_mesh = mesh
     comm.device_axis = axis
     comm.device_comm = DeviceComm(mesh, axis)
+    # device payloads on this comm ride the ICI p2p channel (p2p/devchan)
+    p2p = getattr(getattr(comm, "ctx", None), "p2p", None)
+    if p2p is not None:
+        p2p.device_cids.add(comm.cid)
     from ..coll.framework import attach_coll
 
     attach_coll(comm)

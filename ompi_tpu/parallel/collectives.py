@@ -392,6 +392,35 @@ class DeviceComm:
 
         return self._compiled(key, build)(x)
 
+    def push_row(self, x: jax.Array, src: int, dst: int) -> jax.Array:
+        """ICI p2p: (R, *e) → (R, *e) with row dst ← row src's data, other
+        rows unchanged — the one-hop collective-permute program behind
+        device-payload send/recv on mesh comms (≙ the device-direct role of
+        btl/smcuda GPU-IPC vs pml_ob1_accelerator.c host staging; SURVEY §7
+        phase 4c). Only the one row crosses ICI; the executable is cached
+        per (src, dst, shape, dtype), so a pipeline's stage→stage handoff
+        compiles once."""
+        R = x.shape[0]
+        r = R // self.n
+        key = ("push_row", int(src), int(dst), x.shape, str(x.dtype))
+
+        def build():
+            src_dev, src_loc = divmod(int(src), r)
+            dst_dev, dst_loc = divmod(int(dst), r)
+
+            def inner(xs):           # (r, *e)
+                row = xs[src_loc]
+                if src_dev != dst_dev:
+                    row = lax.ppermute(row, self.axis,
+                                       [(src_dev, dst_dev)])
+                i = lax.axis_index(self.axis)
+                updated = lax.dynamic_update_index_in_dim(
+                    xs, row.astype(xs.dtype), dst_loc, 0)
+                return jnp.where(i == dst_dev, updated, xs)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
     def scan(self, x: jax.Array, op: Op = SUM, exclusive: bool = False
              ) -> jax.Array:
         """Prefix reduction across ranks: row i ← op(rows 0..i)."""

@@ -175,3 +175,164 @@ ctx.finalize()
         if head is not None and head.poll() is None:
             head.kill()
         os.unlink(prog.name)
+
+
+class TestDeviceChannel:
+    """ICI p2p channel for device payloads (VERDICT r3 item 3): on a
+    mesh-attached comm, isend/irecv of an HBM array never stages to host —
+    in-process it is a parked-array handoff (+ PJRT reshard when needed);
+    the SPMD shape is DeviceComm.push_row, whose HLO must be free of host
+    transfers (the DeviceWindow check reused)."""
+
+    def test_push_row_hlo_no_host_transfer(self):
+        import jax
+        import jax.numpy as jnp
+        from ompi_tpu.parallel import DeviceComm, make_mesh
+
+        dc = DeviceComm(make_mesh({"x": 8}), "x")
+        x = dc.from_ranks([np.full(16, float(i), np.float32)
+                           for i in range(8)])
+        out = dc.push_row(x, src=2, dst=6)
+        rows = dc.to_ranks(out)
+        np.testing.assert_allclose(rows[6], np.full(16, 2.0))
+        np.testing.assert_allclose(rows[5], np.full(16, 5.0))   # untouched
+        # compile-level evidence: no host custom-calls in the one-hop
+        # program (same assertion style as the DeviceWindow fence check)
+        key = ("push_row", 2, 6, x.shape, str(x.dtype))
+        hlo = dc._cache[key].lower(x).compile().as_text()
+        host_ops = [ln for ln in hlo.splitlines()
+                    if "custom-call" in ln and "host" in ln.lower()]
+        assert not host_ops, host_ops
+
+    def test_push_row_same_device_and_multirow(self):
+        import jax
+        from ompi_tpu.parallel import DeviceComm, make_mesh
+
+        # 4 devices, 8 rows → r=2: intra-device move (src,dst on same dev)
+        # and cross-device move both correct
+        dc = DeviceComm(make_mesh({"x": 4}, devices=jax.devices()[:4]), "x")
+        x = dc.from_ranks([np.full(4, float(i), np.float32)
+                           for i in range(8)])
+        same = dc.push_row(x, src=2, dst=3)       # dev 1 → dev 1
+        np.testing.assert_allclose(dc.to_ranks(same)[3], np.full(4, 2.0))
+        cross = dc.push_row(x, src=0, dst=7)      # dev 0 → dev 3
+        np.testing.assert_allclose(dc.to_ranks(cross)[7], np.full(4, 0.0))
+        np.testing.assert_allclose(dc.to_ranks(cross)[6], np.full(4, 6.0))
+
+    def test_inprocess_send_recv_no_staging(self):
+        import jax
+        import jax.numpy as jnp
+        from ompi_tpu import accelerator, runtime
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 2}, devices=jax.devices()[:2])
+            attach_mesh(c, mesh, "x")
+            spc = ctx.spc
+            if ctx.rank == 0:
+                payload = jnp.arange(1024.0, dtype=jnp.float32) * 3
+                c.send(payload, 1, tag=7)
+                return (spc._v.get("device_stage_out_bytes", 0),
+                        spc._v.get("device_channel_msgs", 0))
+            buf = accelerator.DeviceBuffer(
+                jnp.zeros(1024, jnp.float32))
+            req = c.irecv(buf, 0, tag=7)
+            req.wait()
+            got = req.result
+            assert isinstance(got, jax.Array), type(got)
+            np.testing.assert_allclose(
+                np.asarray(got), np.arange(1024.0) * 3)
+            return (spc._v.get("device_stage_in_bytes", 0),
+                    spc._v.get("device_channel_msgs", 0))
+
+        res = runtime.run_ranks(2, fn)
+        (out_bytes, tx_msgs), (in_bytes, rx_msgs) = res
+        assert out_bytes == 0, "sender staged to host"
+        assert in_bytes == 0, "receiver staged from host"
+        assert tx_msgs >= 1 and rx_msgs >= 1
+
+    def test_host_receiver_gets_explicit_d2h(self):
+        import jax.numpy as jnp
+        from ompi_tpu import runtime
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+        import jax
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 2}, devices=jax.devices()[:2])
+            attach_mesh(c, mesh, "x")
+            if ctx.rank == 0:
+                c.send(jnp.full(32, 9.0, jnp.float32), 1, tag=1)
+                return True
+            host = np.zeros(32, np.float32)
+            c.recv(host, 0, tag=1)
+            np.testing.assert_allclose(host, np.full(32, 9.0))
+            # the ONE explicit D2H is accounted
+            return ctx.spc._v.get("device_stage_in_bytes", 0) == 32 * 4
+
+        assert all(runtime.run_ranks(2, fn))
+
+    def test_ordering_with_host_messages(self):
+        """Device-channel and host messages share one seq stream per
+        (cid, dst): interleaved sends arrive in order (MPI non-overtaking
+        across the transport split)."""
+        import jax.numpy as jnp
+        from ompi_tpu import accelerator, runtime
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+        import jax
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 2}, devices=jax.devices()[:2])
+            attach_mesh(c, mesh, "x")
+            if ctx.rank == 0:
+                c.send(np.full(4, 1.0, np.float32), 1, tag=5)
+                c.send(jnp.full(4, 2.0, jnp.float32), 1, tag=5)
+                c.send(np.full(4, 3.0, np.float32), 1, tag=5)
+                return True
+            vals = []
+            for _ in range(3):
+                buf = accelerator.DeviceBuffer(jnp.zeros(4, jnp.float32))
+                r = c.irecv(buf, 0, tag=5)
+                r.wait()
+                vals.append(float(np.asarray(r.result)[0]))
+            return vals == [1.0, 2.0, 3.0]
+
+        assert all(runtime.run_ranks(2, fn))
+
+    def test_cross_process_falls_back_to_staging(self):
+        """Two tpurun processes: device payloads cannot share a process →
+        the pml keeps the explicit staged path (the pml_ob1_accelerator.c
+        fallback), and the message still arrives intact."""
+        out = _tpurun(2, """
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import jax.numpy as jnp
+            from ompi_tpu import accelerator, runtime
+            from ompi_tpu.parallel import attach_mesh, make_mesh
+
+            ctx = runtime.init()
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 1}, devices=jax.devices()[:1])
+            # size-2 comm on 1-dev mesh is rejected; attach per-rank SELF
+            # meshes is out of spec — instead mark the cid device-eligible
+            # directly to exercise the same-process gate
+            ctx.p2p.device_cids.add(c.cid)
+            if ctx.rank == 0:
+                c.send(jnp.full(16, 4.0, jnp.float32), 1, tag=2)
+                print("SENT", ctx.spc._v.get("device_stage_out_bytes", 0))
+            else:
+                buf = accelerator.DeviceBuffer(jnp.zeros(16, jnp.float32))
+                r = c.irecv(buf, 0, tag=2)
+                r.wait()
+                assert np.allclose(np.asarray(r.result), 4.0)
+                print("GOT", ctx.spc._v.get("device_stage_in_bytes", 0))
+            ctx.finalize()
+        """)
+        sent = [ln for ln in out.splitlines() if ln.startswith("SENT")]
+        got = [ln for ln in out.splitlines() if ln.startswith("GOT")]
+        assert sent and got
+        assert int(sent[0].split()[1]) == 64      # staged out (fallback)
+        assert int(got[0].split()[1]) == 64       # staged in
