@@ -17,8 +17,11 @@ MCA priority over coll/tuned for device buffers.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
+from ..core import var as _var
 from ..core.component import Component, component
 from ..op import SUM, Op
 from .framework import CollModule
@@ -31,6 +34,69 @@ def _is_device(x) -> bool:
     return accelerator.check_addr(x) is not None
 
 
+# -- device decision layer (≙ coll_tuned_decision_fixed.c:55-104 +
+#    coll_tuned_dynamic_file.c:58, applied to the DEVICE path) --------------
+#
+# The host components pick an algorithm per (comm size, msg size); the
+# device component picks a MODE per (collective, device count, msg size):
+# "native" runs the ICI program, "staged" takes the explicit D2H → host op
+# → H2D round trip (the coll/accelerator shim as a *measured choice*, not
+# a fallback). Fixed defaults come from the recorded sweep
+# (BENCH_SWEEP_cpu_8dev.json): on the CPU test fabric the shard_map
+# dispatch overhead loses to one memcpy for dense alltoall below ~32 MB
+# (0.8-0.99x), while every other entry wins native at every size; on real
+# accelerator platforms staging crosses the host bridge so native always
+# wins — the platform gates the default.
+
+_var.register("coll", "xla", "mode", "", type=str, level=3,
+              help="Force device-collective mode for every entry: "
+                   "native|staged (empty = per-entry decision).")
+_var.register("coll", "xla", "dynamic_rules", "", type=str, level=4,
+              help="Path to a device decision rules file: lines of "
+                   "'<coll> <min_ndev> <min_bytes> <native|staged>'.")
+
+_DECIDED = ("allreduce", "reduce", "bcast", "allgather", "alltoall",
+            "reduce_scatter_block", "scan", "exscan", "allgatherv",
+            "gather", "gatherv", "scatter", "scatterv", "alltoallv",
+            "reduce_scatter")
+for _c in _DECIDED:
+    _var.register("coll", "xla", f"{_c}_mode", "", type=str, level=3,
+                  help=f"Force the {_c} device mode (native|staged; "
+                       "empty = auto).")
+
+
+def _load_device_rules():
+    path = _var.get("coll_xla_dynamic_rules", "")
+    rules = []
+    if path and os.path.exists(path):
+        with open(path) as fh:
+            for lineno, line in enumerate(fh, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    coll, min_ndev, min_bytes, mode = line.split()
+                    min_ndev, min_bytes = int(min_ndev), int(min_bytes)
+                except ValueError as exc:
+                    raise ValueError(
+                        f"{path}:{lineno}: bad device rule {line!r} "
+                        "(want '<coll> <min_ndev> <min_bytes> "
+                        f"<native|staged>'): {exc}") from None
+                if mode not in ("native", "staged"):
+                    raise ValueError(
+                        f"{path}:{lineno}: unknown device mode {mode!r} "
+                        "(want native or staged)")
+                rules.append((coll, min_ndev, min_bytes, mode))
+    return rules
+
+
+# numpy reduction kernels for the staged arm (standard MPI ops only; a
+# custom op keeps the native path regardless of decision — its fn is
+# jax-traceable, not a host kernel)
+_NP_FOLD = {"sum": np.add.reduce, "max": np.maximum.reduce,
+            "min": np.minimum.reduce, "prod": np.multiply.reduce}
+
+
 class XlaModule(CollModule):
     def __init__(self, comm) -> None:
         from ..parallel.collectives import DeviceComm
@@ -38,53 +104,145 @@ class XlaModule(CollModule):
         self.dc: "DeviceComm" = comm.device_comm
         self.dc.spc = getattr(comm.ctx, "spc", None)
         self.host = TunedModule(comm)   # fallback for host buffers
+        self._rules = _load_device_rules()
+        self._platform = next(iter(self.dc.mesh.devices.flat)).platform
 
     # Device layout contract: x is (n, *elem) sharded on dim 0 over the comm
     # axis — row i is "rank i"'s buffer (parallel/collectives.py docstring).
+
+    # -- decision (native ICI program vs measured host staging) -------------
+
+    def _mode(self, coll: str, x) -> str:
+        """Pick per (collective, PER-RANK bytes) — the unit the sweep
+        measures and the rules file records (a canonical array's row 0 is
+        one rank's buffer), so thresholds line up with the evidence."""
+        forced = _var.get("coll_xla_mode", "") or \
+            _var.get(f"coll_xla_{coll}_mode", "")
+        if forced:
+            return forced
+        nbytes = x.nbytes // max(x.shape[0], 1)
+        if self._platform == "cpu":
+            # sweep-derived (BENCH_SWEEP_cpu_8dev.json): dense alltoall
+            # staged wins 1KB-16MB/rank on the CPU fabric; all else native
+            pick = "staged" if (coll == "alltoall"
+                                and nbytes < (32 << 20)) else "native"
+        else:
+            pick = "native"       # staging crosses the host bridge
+        for c, mn, mb, mode in self._rules:
+            if c == coll and self.dc.n >= mn and nbytes >= mb:
+                pick = mode
+        return pick
+
+    def _stage_out(self, x) -> np.ndarray:
+        """The explicit D2H half of the staged arm (SPC-accounted);
+        accepts a raw jax array or a DeviceBuffer holder."""
+        import jax
+
+        from .. import accelerator
+
+        if isinstance(x, accelerator.DeviceBuffer):
+            x = x.array
+        spc = self.dc.spc
+        h = np.asarray(jax.device_get(x))
+        if spc is not None:
+            spc.inc("device_stage_out_bytes", h.nbytes)
+            spc.inc("coll_staged_fallbacks")
+        return h
+
+    def _stage_in(self, h: np.ndarray):
+        """H2D back onto the canonical sharding."""
+        import jax
+        import jax.numpy as jnp
+
+        spc = self.dc.spc
+        if spc is not None:
+            spc.inc("device_stage_in_bytes", h.nbytes)
+        return jax.device_put(jnp.asarray(h), self.dc.sharding())
 
     def allreduce(self, comm, sendbuf, recvbuf=None, op: Op = None):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.allreduce(comm, sendbuf, recvbuf, op)
+        if op.name in _NP_FOLD and \
+                self._mode("allreduce", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)
+            red = _NP_FOLD[op.name](h, axis=0)
+            return self._stage_in(np.broadcast_to(red, h.shape))
         return self.dc.allreduce(sendbuf, op)
 
     def reduce(self, comm, sendbuf, recvbuf=None, op: Op = None, root: int = 0):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.reduce(comm, sendbuf, recvbuf, op, root)
+        if op.name in _NP_FOLD and \
+                self._mode("reduce", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)
+            red = _NP_FOLD[op.name](h, axis=0)
+            return self._stage_in(np.broadcast_to(red, h.shape))
         return self.dc.reduce(sendbuf, op, root)
 
     def bcast(self, comm, buf, root: int = 0):
         if not _is_device(buf):
             return self.host.bcast(comm, buf, root)
+        if self._mode("bcast", buf) == "staged":
+            h = self._stage_out(buf)
+            return self._stage_in(np.broadcast_to(h[root], h.shape))
         return self.dc.bcast(buf, root)
 
     def allgather(self, comm, sendbuf, recvbuf=None):
         if not _is_device(sendbuf):
             return self.host.allgather(comm, sendbuf, recvbuf)
+        if self._mode("allgather", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)
+            flat = h.reshape((-1,) + h.shape[2:]) if h.ndim > 2 \
+                else h.reshape(-1)
+            return self._stage_in(np.broadcast_to(
+                flat[None], (h.shape[0],) + flat.shape))
         return self.dc.allgather(sendbuf)
 
     def alltoall(self, comm, sendbuf, recvbuf=None):
         if not _is_device(sendbuf):
             return self.host.alltoall(comm, sendbuf, recvbuf)
+        if self._mode("alltoall", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)           # (R, R, b, *e)
+            return self._stage_in(np.ascontiguousarray(
+                np.swapaxes(h, 0, 1)))
         return self.dc.alltoall(sendbuf)
 
     def reduce_scatter_block(self, comm, sendbuf, recvbuf=None, op: Op = None):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.reduce_scatter_block(comm, sendbuf, recvbuf, op)
+        if op.name in _NP_FOLD and self._mode(
+                "reduce_scatter_block", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)           # (R, R*b, *e)
+            R = h.shape[0]
+            b = h.shape[1] // R
+            red = _NP_FOLD[op.name](h, axis=0)
+            return self._stage_in(red.reshape((R, b) + h.shape[2:]))
         return self.dc.reduce_scatter(sendbuf, op)
 
     def scan(self, comm, sendbuf, recvbuf=None, op: Op = None):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.scan(comm, sendbuf, recvbuf, op)
+        if op.name in ("sum", "prod") and \
+                self._mode("scan", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)
+            fn = np.cumsum if op.name == "sum" else np.cumprod
+            return self._stage_in(fn(h, axis=0))
         return self.dc.scan(sendbuf, op)
 
     def exscan(self, comm, sendbuf, recvbuf=None, op: Op = None):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.exscan(comm, sendbuf, recvbuf, op)
+        if op.name == "sum" and \
+                self._mode("exscan", sendbuf) == "staged":
+            h = self._stage_out(sendbuf)
+            out = np.zeros_like(h)
+            out[1:] = np.cumsum(h, axis=0)[:-1]
+            return self._stage_in(out)
         return self.dc.scan(sendbuf, op, exclusive=True)
 
     def barrier(self, comm):
@@ -103,16 +261,9 @@ class XlaModule(CollModule):
     # so the EP/MoE alltoallv hot path never leaves ICI.
 
     def _to_host(self, x):
-        from .. import accelerator
-
-        info = accelerator.check_addr(x)
-        if info is None:
-            return x
-        spc = self.dc.spc
-        if spc is not None:
-            spc.inc("device_stage_out_bytes", info.nbytes)
-            spc.inc("coll_staged_fallbacks")
-        return np.asarray(x)
+        """Host view of a maybe-device buffer: non-canonical layouts keep
+        the host algorithm chain; ONE accounting path with _stage_out."""
+        return self._stage_out(x) if _is_device(x) else x
 
     def _rows_ok(self, x, need_ndim: int) -> bool:
         """Canonical-layout gate: device buffer whose row dim covers the
@@ -130,12 +281,26 @@ class XlaModule(CollModule):
                 and self._rows_ok(sendbuf, 2)
                 and len(counts) == sendbuf.shape[0]
                 and sendbuf.shape[1] >= max(int(c) for c in counts)):
+            if self._mode("allgatherv", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)
+                cat = np.concatenate(
+                    [h[i, :int(c)] for i, c in enumerate(counts)])
+                return self._stage_in(np.broadcast_to(
+                    cat[None], (h.shape[0],) + cat.shape))
             return self.dc.allgatherv(sendbuf, counts)
         return self.host.allgatherv(comm, self._to_host(sendbuf), recvbuf,
                                     counts, displs)
 
     def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
         if recvbuf is None and self._rows_ok(sendbuf, 2):
+            if self._mode("gather", sendbuf) == "staged":
+                # inline (NOT via self.allgather, whose own decision would
+                # override this entry's staged pick)
+                h = self._stage_out(sendbuf)
+                flat = h.reshape((-1,) + h.shape[2:]) if h.ndim > 2 \
+                    else h.reshape(-1)
+                return self._stage_in(np.broadcast_to(
+                    flat[None], (h.shape[0],) + flat.shape))
             return self.dc.gather(sendbuf, root)
         return self.host.gather(comm, self._to_host(sendbuf), recvbuf, root)
 
@@ -145,6 +310,12 @@ class XlaModule(CollModule):
                 and self._rows_ok(sendbuf, 2)
                 and len(counts) == sendbuf.shape[0]
                 and sendbuf.shape[1] >= max(int(c) for c in counts)):
+            if self._mode("gatherv", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)
+                cat = np.concatenate(
+                    [h[i, :int(c)] for i, c in enumerate(counts)])
+                return self._stage_in(np.broadcast_to(
+                    cat[None], (h.shape[0],) + cat.shape))
             return self.dc.gatherv(sendbuf, counts, root)
         return self.host.basic.gatherv(comm, self._to_host(sendbuf), recvbuf,
                                        counts, displs, root)
@@ -152,6 +323,9 @@ class XlaModule(CollModule):
     def scatter(self, comm, sendbuf, recvbuf=None, root: int = 0):
         if (recvbuf is None and self._rows_ok(sendbuf, 3)
                 and sendbuf.shape[0] == sendbuf.shape[1]):
+            if self._mode("scatter", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)       # (R, R, b, *e)
+                return self._stage_in(np.ascontiguousarray(h[root]))
             return self.dc.scatter(sendbuf, root)
         return self.host.scatter(comm, self._to_host(sendbuf), recvbuf, root)
 
@@ -162,6 +336,9 @@ class XlaModule(CollModule):
                 and sendbuf.shape[0] == sendbuf.shape[1]
                 and len(counts) == sendbuf.shape[0]
                 and sendbuf.shape[2] >= max(int(c) for c in counts)):
+            if self._mode("scatterv", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)
+                return self._stage_in(np.ascontiguousarray(h[root]))
             return self.dc.scatterv(sendbuf, counts, root)
         return self.host.basic.scatterv(comm, self._to_host(sendbuf),
                                         recvbuf, counts, displs, root)
@@ -186,6 +363,19 @@ class XlaModule(CollModule):
                         "alltoallv: recvcounts disagree with sendcounts "
                         f"({recvcounts} vs column sums "
                         f"{C.sum(axis=0).tolist()})")
+            if self._mode("alltoallv", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)       # (R, R, cap, *e)
+                R = h.shape[0]
+                recv_tot = C.sum(axis=0)
+                out_cap = self.dc._bucket(int(recv_tot.max()) if R else 1)
+                out = np.zeros((R, out_cap) + h.shape[3:], h.dtype)
+                for j in range(R):
+                    pos = 0
+                    for i in range(R):
+                        c = int(C[i, j])
+                        out[j, pos:pos + c] = h[i, j, :c]
+                        pos += c
+                return self._stage_in(out)
             out, _tot = self.dc.alltoallv(sendbuf, C)
             return out
         return self.host.alltoallv(comm, self._to_host(sendbuf), recvbuf,
@@ -196,6 +386,17 @@ class XlaModule(CollModule):
         if (recvbuf is None and self._rows_ok(sendbuf, 2)
                 and len(counts) == sendbuf.shape[0]
                 and int(np.sum(counts)) == sendbuf.shape[1]):
+            if op.name in _NP_FOLD and self._mode(
+                    "reduce_scatter", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)       # (R, total, *e)
+                red = _NP_FOLD[op.name](h, axis=0)
+                cap = self.dc._bucket(max(int(c) for c in counts))
+                out = np.zeros((h.shape[0], cap) + h.shape[2:], h.dtype)
+                off = 0
+                for i, c in enumerate(int(c) for c in counts):
+                    out[i, :c] = red[off:off + c]
+                    off += c
+                return self._stage_in(out)
             return self.dc.reduce_scatter_v(sendbuf, counts, op)
         return self.host.reduce_scatter(comm, self._to_host(sendbuf),
                                         recvbuf, counts, op)

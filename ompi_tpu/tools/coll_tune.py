@@ -128,12 +128,156 @@ def _run_case(coll: str, alg: str, nbytes: int, ranks: int, iters: int
         var.registry.reset_cache()
 
 
+DEVICE_SIZES = [1024, 64 << 10, 1 << 20, 16 << 20]    # bytes per rank
+
+
+def run_device_sweep(iters: int, sizes=None):
+    """Native-ICI vs staged-host timing per (collective, size) on the
+    current device mesh — the DEVICE analog of the host sweep, feeding the
+    coll/xla decision layer (≙ coll_tuned_decision_fixed.c driven by
+    measurement). Returns (rows, winners[coll][bytes] = native|staged)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.parallel import DeviceComm, make_mesh
+
+    ndev = len(jax.devices())
+    rows_n = ndev if ndev > 1 else 8
+    dc = DeviceComm(make_mesh({"x": ndev}), "x")
+    sizes = sizes or DEVICE_SIZES
+    rng = np.random.default_rng(0)
+    rows, winners = [], {}
+
+    def timed(fn):
+        fn()                                   # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters * 1e6
+
+    for nbytes in sizes:
+        count = max(rows_n, nbytes // 4)
+        count -= count % rows_n          # alltoall reshapes (R, R, c/R)
+        host = rng.standard_normal((rows_n, count)).astype(np.float32)
+        x = jax.device_put(jnp.asarray(host), dc.sharding())
+        x.block_until_ready()
+        per = count // rows_n
+        vbase = [(per - per // 2) if j % 2 == 0 else (per + per // 2)
+                 for j in range(rows_n)]
+        C = np.stack([np.roll(vbase, -i) for i in range(rows_n)])
+        cases = {
+            "allreduce": (
+                lambda: dc.allreduce(x).block_until_ready(),
+                lambda: jax.device_put(jnp.asarray(np.broadcast_to(
+                    np.asarray(jax.device_get(x)).sum(axis=0),
+                    host.shape)), dc.sharding()).block_until_ready()),
+            "bcast": (
+                lambda: dc.bcast(x, 0).block_until_ready(),
+                lambda: jax.device_put(jnp.asarray(np.broadcast_to(
+                    np.asarray(jax.device_get(x))[0], host.shape)),
+                    dc.sharding()).block_until_ready()),
+            "alltoall": (
+                lambda: dc.alltoall(
+                    x.reshape(rows_n, rows_n, count // rows_n)
+                ).block_until_ready(),
+                lambda: jax.device_put(jnp.asarray(np.ascontiguousarray(
+                    np.swapaxes(np.asarray(jax.device_get(x)).reshape(
+                        rows_n, rows_n, count // rows_n), 0, 1))),
+                    dc.sharding()).block_until_ready()),
+        }
+        if per >= 1:
+            xp, counts_list = dc.pad_ragged(
+                [host[r, :c] for r, c in enumerate(vbase)])
+            cases["allgatherv"] = (
+                lambda: dc.allgatherv(xp, counts_list).block_until_ready(),
+                lambda: jax.device_put(jnp.asarray(np.broadcast_to(
+                    np.concatenate([np.asarray(jax.device_get(xp))[r, :c]
+                                    for r, c in enumerate(vbase)])[None],
+                    (rows_n, sum(vbase)))),
+                    dc.sharding()).block_until_ready())
+            cap = dc._bucket(int(C.max()))
+            if rows_n * rows_n * cap * 4 <= 1 << 27:
+                blk = np.zeros((rows_n, rows_n, cap), np.float32)
+                for rr in range(rows_n):
+                    off = 0
+                    for jj in range(rows_n):
+                        c = int(C[rr, jj])
+                        blk[rr, jj, :c] = host[rr, off:off + c]
+                        off += c
+                xb = jax.device_put(jnp.asarray(blk), dc.sharding())
+                out_cap = dc._bucket(int(C.sum(axis=0).max()))
+
+                def staged_a2av():
+                    h = np.asarray(jax.device_get(xb))
+                    out = np.zeros((rows_n, out_cap), np.float32)
+                    for jj in range(rows_n):
+                        pos = 0
+                        for ii in range(rows_n):
+                            c = int(C[ii, jj])
+                            out[jj, pos:pos + c] = h[ii, jj, :c]
+                            pos += c
+                    jax.device_put(jnp.asarray(out),
+                                   dc.sharding()).block_until_ready()
+
+                cases["alltoallv"] = (
+                    lambda: dc.alltoallv(xb, C)[0].block_until_ready(),
+                    staged_a2av)
+        for coll, (native, staged) in cases.items():
+            nus = timed(native)
+            sus = timed(staged)
+            mode = "native" if nus <= sus else "staged"
+            rows.append({"coll": coll, "bytes": nbytes,
+                         "native_us": round(nus, 1),
+                         "staged_us": round(sus, 1), "winner": mode})
+            winners.setdefault(coll, {})[nbytes] = mode
+            print(f"device {coll:12s} {nbytes:>9d}B  native {nus:9.1f}us "
+                  f"staged {sus:9.1f}us -> {mode}", flush=True)
+    return rows, winners
+
+
+def emit_device_rules(winners: dict, path: str) -> None:
+    """Winners → a coll/xla dynamic-rules file: one line per mode change
+    walking sizes ascending (rules apply at >= min_bytes, later lines win,
+    matching _load_device_rules/_mode semantics)."""
+    lines = ["# device decision rules measured by coll_tune --device",
+             "# <coll> <min_ndev> <min_bytes> <native|staged>"]
+    for coll, by_size in winners.items():
+        prev = None
+        for nbytes in sorted(by_size):
+            mode = by_size[nbytes]
+            if mode != prev:
+                lines.append(f"{coll} 2 {0 if prev is None else nbytes} "
+                             f"{mode}")
+                prev = mode
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ranks", type=int, default=4)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--out", default="TUNE_SWEEP.json")
+    ap.add_argument("--device", action="store_true",
+                    help="Sweep the DEVICE path (native ICI vs staged "
+                         "host) and emit coll/xla decision rules.")
+    ap.add_argument("--device-rules-out", default="DEVICE_RULES.txt")
     args = ap.parse_args(argv)
+
+    if args.device:
+        import jax
+
+        rows, winners = run_device_sweep(args.iters)
+        emit_device_rules(winners, args.device_rules_out)
+        out = {"ndev": len(jax.devices()), "iters": args.iters,
+               "winners": {c: {str(k): v for k, v in w.items()}
+                           for c, w in winners.items()},
+               "results": rows}
+        with open(args.out if args.out != "TUNE_SWEEP.json"
+                  else "TUNE_DEVICE.json", "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"wrote {args.device_rules_out}")
+        return 0
 
     rows = []
     winners: dict = {}

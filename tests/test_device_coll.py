@@ -399,3 +399,128 @@ def test_intercomm_device_collectives_two_meshes():
         return True
 
     assert all(runtime.run_ranks(2, fn))
+
+
+class TestDeviceDecision:
+    """The device decision layer (VERDICT r3 item 4): per (collective,
+    size) the xla module picks native-ICI vs measured host staging, with
+    the same force-var + dynamic-rules-file machinery the host tuned
+    component has (coll_tuned_decision_fixed.c / coll_tuned_dynamic_file.c
+    applied to the device path)."""
+
+    def _run(self, fn):
+        return runtime.run_ranks(1, fn)[0]
+
+    def test_cpu_default_stages_small_dense_alltoall(self):
+        """On the CPU fabric the sweep shows staged winning dense alltoall
+        below 32MB — the decision auto-selects it; allreduce stays native."""
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": N})
+            attach_mesh(c, mesh, "x")
+            dc = c.device_comm
+            x = dc.from_ranks([np.stack([np.full(2, 10.0 * i + j,
+                                                 np.float32)
+                                         for j in range(N)])
+                               for i in range(N)])
+            before = ctx.spc._v.get("coll_staged_fallbacks", 0)
+            out = c.coll.alltoall(c, x)
+            mid = ctx.spc._v.get("coll_staged_fallbacks", 0)
+            assert mid == before + 1          # staged by decision
+            assert isinstance(out, jax.Array)  # ...but still device-resident
+            got = np.asarray(jax.device_get(out))
+            np.testing.assert_allclose(got[3][5], np.full(2, 10.0 * 5 + 3))
+            r = c.coll.allreduce(
+                c, dc.from_ranks([np.ones(4, np.float32)] * N))
+            after = ctx.spc._v.get("coll_staged_fallbacks", 0)
+            assert after == mid               # allreduce stayed native
+            np.testing.assert_allclose(np.asarray(jax.device_get(r))[0],
+                                       np.full(4, float(N)))
+            return True
+
+        assert self._run(fn)
+
+    def test_force_var_overrides(self):
+        from ompi_tpu.core import var
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": N})
+            attach_mesh(c, mesh, "x")
+            dc = c.device_comm
+            x = dc.from_ranks([np.full(8, float(i), np.float32)
+                               for i in range(N)])
+            before = ctx.spc._v.get("coll_staged_fallbacks", 0)
+            out = c.coll.allreduce(c, x)      # forced staged
+            assert ctx.spc._v.get("coll_staged_fallbacks", 0) == before + 1
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(out))[2],
+                np.full(8, sum(range(N))))
+            return True
+
+        var.registry.set_cli("coll_xla_allreduce_mode", "staged")
+        var.registry.reset_cache()
+        try:
+            assert self._run(fn)
+        finally:
+            var.registry.set_cli("coll_xla_allreduce_mode", "")
+            var.registry.reset_cache()
+
+    def test_dynamic_rules_file(self, tmp_path):
+        from ompi_tpu.core import var
+
+        rules = tmp_path / "device_rules.txt"
+        rules.write_text("# device rules\n"
+                         "alltoall 2 0 native\n"      # beat the cpu default
+                         "allgatherv 2 0 staged\n")
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": N})
+            attach_mesh(c, mesh, "x")
+            dc = c.device_comm
+            before = ctx.spc._v.get("coll_staged_fallbacks", 0)
+            x = dc.from_ranks([np.stack([np.full(2, 1.0, np.float32)
+                                         for _ in range(N)])
+                               for _ in range(N)])
+            c.coll.alltoall(c, x)             # rule says native
+            assert ctx.spc._v.get("coll_staged_fallbacks", 0) == before
+            xp, counts = dc.pad_ragged(
+                [np.arange(i + 1, dtype=np.float32) for i in range(N)])
+            out = c.coll.allgatherv(c, xp, counts=counts)  # rule: staged
+            assert ctx.spc._v.get("coll_staged_fallbacks", 0) == before + 1
+            np.testing.assert_allclose(
+                np.asarray(jax.device_get(out))[0],
+                np.concatenate([np.arange(i + 1) for i in range(N)]))
+            return True
+
+        var.registry.set_cli("coll_xla_dynamic_rules", str(rules))
+        var.registry.reset_cache()
+        try:
+            assert self._run(fn)
+        finally:
+            var.registry.set_cli("coll_xla_dynamic_rules", "")
+            var.registry.reset_cache()
+
+    def test_coll_tune_emits_device_rules(self, tmp_path):
+        from ompi_tpu.tools import coll_tune
+
+        rows, winners = coll_tune.run_device_sweep(
+            iters=2, sizes=[1024, 64 << 10])
+        assert {"allreduce", "bcast", "alltoall"} <= set(winners)
+        path = tmp_path / "DEVICE_RULES.txt"
+        coll_tune.emit_device_rules(winners, str(path))
+        text = path.read_text()
+        assert "allreduce 2 0" in text
+        # the emitted file parses through the decision layer's loader
+        from ompi_tpu.coll.xla import _load_device_rules
+        from ompi_tpu.core import var
+        var.registry.set_cli("coll_xla_dynamic_rules", str(path))
+        var.registry.reset_cache()
+        try:
+            parsed = _load_device_rules()
+            assert all(r[3] in ("native", "staged") for r in parsed)
+            assert any(r[0] == "allreduce" for r in parsed)
+        finally:
+            var.registry.set_cli("coll_xla_dynamic_rules", "")
+            var.registry.reset_cache()
