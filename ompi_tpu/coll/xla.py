@@ -68,7 +68,13 @@ for _c in _DECIDED:
 def _load_device_rules():
     path = _var.get("coll_xla_dynamic_rules", "")
     rules = []
-    if path and os.path.exists(path):
+    if path and not os.path.exists(path):
+        # misconfiguration must be distinguishable from no configuration
+        # (the reference's dynamic-file loader reports a missing file,
+        # coll_tuned_dynamic_file.c:58)
+        raise ValueError(
+            f"coll_xla_dynamic_rules names a missing file: {path!r}")
+    if path:
         with open(path) as fh:
             for lineno, line in enumerate(fh, 1):
                 line = line.strip()
@@ -97,6 +103,20 @@ _NP_FOLD = {"sum": np.add.reduce, "max": np.maximum.reduce,
             "min": np.minimum.reduce, "prod": np.multiply.reduce}
 
 
+def _staged_allgather(h: np.ndarray) -> np.ndarray:
+    """Host allgather on the canonical layout (staged arm of both
+    allgather and gather — MPI promises only the root's row for gather)."""
+    flat = h.reshape((-1,) + h.shape[2:]) if h.ndim > 2 else h.reshape(-1)
+    return np.broadcast_to(flat[None], (h.shape[0],) + flat.shape)
+
+
+def _staged_allgatherv(h: np.ndarray, counts) -> np.ndarray:
+    """Host allgatherv on the padded canonical layout (also the gatherv
+    staged arm)."""
+    cat = np.concatenate([h[i, :int(c)] for i, c in enumerate(counts)])
+    return np.broadcast_to(cat[None], (h.shape[0],) + cat.shape)
+
+
 class XlaModule(CollModule):
     def __init__(self, comm) -> None:
         from ..parallel.collectives import DeviceComm
@@ -119,6 +139,10 @@ class XlaModule(CollModule):
         forced = _var.get("coll_xla_mode", "") or \
             _var.get(f"coll_xla_{coll}_mode", "")
         if forced:
+            if forced not in ("native", "staged"):
+                raise ValueError(
+                    f"coll_xla mode for {coll!r} is {forced!r} "
+                    "(want native or staged)")
             return forced
         nbytes = x.nbytes // max(x.shape[0], 1)
         if self._platform == "cpu":
@@ -193,11 +217,7 @@ class XlaModule(CollModule):
         if not _is_device(sendbuf):
             return self.host.allgather(comm, sendbuf, recvbuf)
         if self._mode("allgather", sendbuf) == "staged":
-            h = self._stage_out(sendbuf)
-            flat = h.reshape((-1,) + h.shape[2:]) if h.ndim > 2 \
-                else h.reshape(-1)
-            return self._stage_in(np.broadcast_to(
-                flat[None], (h.shape[0],) + flat.shape))
+            return self._stage_in(_staged_allgather(self._stage_out(sendbuf)))
         return self.dc.allgather(sendbuf)
 
     def alltoall(self, comm, sendbuf, recvbuf=None):
@@ -282,11 +302,8 @@ class XlaModule(CollModule):
                 and len(counts) == sendbuf.shape[0]
                 and sendbuf.shape[1] >= max(int(c) for c in counts)):
             if self._mode("allgatherv", sendbuf) == "staged":
-                h = self._stage_out(sendbuf)
-                cat = np.concatenate(
-                    [h[i, :int(c)] for i, c in enumerate(counts)])
-                return self._stage_in(np.broadcast_to(
-                    cat[None], (h.shape[0],) + cat.shape))
+                return self._stage_in(_staged_allgatherv(
+                    self._stage_out(sendbuf), counts))
             return self.dc.allgatherv(sendbuf, counts)
         return self.host.allgatherv(comm, self._to_host(sendbuf), recvbuf,
                                     counts, displs)
@@ -294,13 +311,10 @@ class XlaModule(CollModule):
     def gather(self, comm, sendbuf, recvbuf=None, root: int = 0):
         if recvbuf is None and self._rows_ok(sendbuf, 2):
             if self._mode("gather", sendbuf) == "staged":
-                # inline (NOT via self.allgather, whose own decision would
-                # override this entry's staged pick)
-                h = self._stage_out(sendbuf)
-                flat = h.reshape((-1,) + h.shape[2:]) if h.ndim > 2 \
-                    else h.reshape(-1)
-                return self._stage_in(np.broadcast_to(
-                    flat[None], (h.shape[0],) + flat.shape))
+                # shared helper, NOT self.allgather: its own decision
+                # would override this entry's staged pick
+                return self._stage_in(
+                    _staged_allgather(self._stage_out(sendbuf)))
             return self.dc.gather(sendbuf, root)
         return self.host.gather(comm, self._to_host(sendbuf), recvbuf, root)
 
@@ -311,11 +325,8 @@ class XlaModule(CollModule):
                 and len(counts) == sendbuf.shape[0]
                 and sendbuf.shape[1] >= max(int(c) for c in counts)):
             if self._mode("gatherv", sendbuf) == "staged":
-                h = self._stage_out(sendbuf)
-                cat = np.concatenate(
-                    [h[i, :int(c)] for i, c in enumerate(counts)])
-                return self._stage_in(np.broadcast_to(
-                    cat[None], (h.shape[0],) + cat.shape))
+                return self._stage_in(_staged_allgatherv(
+                    self._stage_out(sendbuf), counts))
             return self.dc.gatherv(sendbuf, counts, root)
         return self.host.basic.gatherv(comm, self._to_host(sendbuf), recvbuf,
                                        counts, displs, root)

@@ -46,6 +46,7 @@
 extern "C" {
 int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
                  const uint8_t* payload, uint32_t plen);
+int shmbox_probe(int h, uint32_t hlen, uint32_t plen);
 int shmbox_read_frame(int h, uint8_t* buf, uint32_t buflen,
                       uint32_t* body_out);
 int shmbox_peek_inplace(int h, const uint8_t** hdr, const uint8_t** payload,
@@ -187,6 +188,7 @@ struct Engine {
   uint64_t stats[8] = {0};    // 0 matches_posted 1 unexpected_arrivals
                               // 2 eager_tx 3 frames_rx 4 frags_sunk
                               // 5 bytes_sunk 6 pending_parks
+                              // 7 tx_dropped (ring died after park)
   bool peruse = false;
   uint64_t frame_cap = 1 << 21;
 };
@@ -231,6 +233,11 @@ int tx_frame(Engine& e, int32_t peer, const uint8_t* hdr, uint32_t hlen,
              const uint8_t* payload, uint64_t plen) {
   PeerTx& pt = e.tx[peer];
   if (!pt.pending.empty()) {
+    // backpressure queue is live: still reject frames that can NEVER
+    // drain (oversized / dead handle) — parking one would wedge the
+    // peer's FIFO forever (flush_pending used to break on it each pass)
+    int pr = shmbox_probe(pt.ring, hlen, (uint32_t)plen);
+    if (pr < 0) return pr;
     pt.pending.push_back({{hdr, hdr + hlen},
                           {payload, payload + plen}});
     e.stats[6]++;
@@ -252,7 +259,15 @@ int flush_pending(Engine& e) {
       PendingTx& f = pt.pending.front();
       int rc = shmbox_write(pt.ring, f.hdr.data(), (uint32_t)f.hdr.size(),
                             f.payload.data(), (uint32_t)f.payload.size());
-      if (rc < 0) break;
+      if (rc == -1) break;               // ring full: retry next pass
+      if (rc < 0) {
+        // -2/-3 can only appear here if the ring died or shrank after the
+        // frame was parked (tx_frame pre-screens): drop it so the queue
+        // keeps draining, and count the loss (stats[7])
+        pt.pending.pop_front();
+        e.stats[7]++;
+        continue;
+      }
       if (rc == 1 && pt.bell >= 0) doorbell_post(pt.bell);
       pt.pending.pop_front();
       n++;

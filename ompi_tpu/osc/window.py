@@ -138,6 +138,12 @@ class Window:
             raise ValueError("window buffer must be C-contiguous")
         self.name = name
         self.eng = _engine(comm.ctx)
+        # unconditional progress for passive-target RMA (VERDICT r3 item
+        # 7): the first window auto-starts the progress thread so a
+        # lock/flush against a compute-busy target is always serviced
+        from ..core import var as _wvar
+        if _wvar.get("runtime_async_progress_auto", True):
+            comm.ctx.ensure_async_progress()
         # deterministic collective id: (cid, per-comm window counter)
         seq = getattr(comm, "_win_seq", 0)
         comm._win_seq = seq + 1

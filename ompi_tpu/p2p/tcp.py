@@ -175,19 +175,32 @@ class TcpTransport(T.Transport):
         connection failed — the synchronous error surface striping needs:
         _flush swallows OSError asynchronously (send() only ENQUEUES), so
         a fragment range is only 'handed to the transport' once this
-        returns (≙ the reference btl's des_cbfunc completion callback)."""
+        returns (≙ the reference btl's des_cbfunc completion callback).
+
+        The stall deadline is a NO-PROGRESS window, not a total cap: any
+        bytes the kernel accepts push it out, so a slow-but-alive peer
+        (small windows, congested loopback) is never misdiagnosed as
+        failed and retired from the path set (ADVICE r3 item 3 — only a
+        connection making zero forward progress for the full window
+        raises, which failover then rightly treats as a dead path)."""
         import time
         conn = self._tx.get(peer)
-        deadline = time.monotonic() + 30.0
+        stall_window = 30.0
+        deadline = time.monotonic() + stall_window
+        last_out = conn.out_bytes if conn is not None else 0
         while conn is not None and conn.outbuf:
             if peer in self.failed_peers:
                 break
             self._flush(conn)
+            if conn.out_bytes < last_out:      # forward progress → extend
+                last_out = conn.out_bytes
+                deadline = time.monotonic() + stall_window
             if conn.outbuf:
                 if time.monotonic() > deadline:
                     raise OSError(
-                        f"tcp to rank {peer}: outbuf not draining "
-                        f"({conn.out_bytes} bytes stuck)")
+                        f"tcp to rank {peer}: no forward progress for "
+                        f"{stall_window:.0f}s ({conn.out_bytes} bytes "
+                        "stuck)")
                 self._absorb_rx()      # keep rx moving: no mutual-send
                 time.sleep(0.0002)     # deadlock on full kernel buffers
         if peer in self.failed_peers:

@@ -40,11 +40,19 @@ from .p2p.transport import TransportLayer
 
 _rtvar.register(
     "runtime", "", "async_progress", False, type=bool, level=3,
-    help="Run a per-rank progress thread (≙ the reference's opt-in "
-         "progress threads): passive-target RMA and rendezvous service "
-         "keep moving while the application thread computes. Library "
-         "entry points then serialize on the engine guard (small "
-         "per-call cost); default off = FUNNELED, unlocked.")
+    help="Start a per-rank progress thread at init (≙ the reference "
+         "servicing opal_progress unconditionally): passive-target RMA "
+         "and rendezvous service keep moving while the application "
+         "thread computes. Off by default — but windows AUTO-START the "
+         "thread (async_progress_auto), which is where unconditional "
+         "progress is load-bearing.")
+_rtvar.register(
+    "runtime", "", "async_progress_auto", True, type=bool, level=3,
+    help="Auto-start the progress thread when the first RMA window is "
+         "created, so passive-target synchronization never stalls on a "
+         "compute-busy target without opt-in (≙ opal_progress.c:216 "
+         "being unconditional in the reference). Disable to force the "
+         "strictly-funneled single-thread mode.")
 
 
 class Context:
@@ -76,13 +84,11 @@ class Context:
         from .core import var as _var0
         self._async_progress = bool(_var0.get("runtime_async_progress",
                                               False))
-        if self._async_progress:
-            # opt-in progress thread (≙ the reference's opal progress/btl
-            # progress threads): passive-target RMA and rendezvous service
-            # keep moving while the owner thread sits in long user compute.
-            # The engine guard serializes the thread against the owner's
-            # pml/TransportLayer entry points (FUNNELED otherwise).
-            self.engine.guard = threading.RLock()
+        # the guard is ALWAYS an RLock: the progress thread may start
+        # lazily (first window → ensure_async_progress), and transports
+        # capture the guard at init — measured cost on the p2p latency
+        # class is recorded in BASELINE.md (sub-µs per entry point)
+        self.engine.guard = threading.RLock()
         self._prog_thread = None
         self.am_table: dict = {}
         mods = []
@@ -116,18 +122,31 @@ class Context:
         hook.fire("init_bottom", self)   # ≙ mca/hook mpi_init hooks
         _ctx_opened()                    # interlib: a runtime is now live
         if self._async_progress:
-            import time as _time
+            self.ensure_async_progress()
 
-            def _pump() -> None:
-                while not self.finalized:
-                    n = self.engine.progress()
-                    # back off when idle: on oversubscribed hosts a hot
-                    # spinner starves the app thread it exists to serve
-                    _time.sleep(0 if n else 0.001)
+    def ensure_async_progress(self) -> None:
+        """Start the per-rank progress thread (idempotent). Called at init
+        when runtime_async_progress is set, and automatically by the first
+        RMA window (unless async_progress_auto is off) — the path where
+        the reference's unconditional opal_progress servicing
+        (opal_progress.c:216) is load-bearing: a lock/flush against a
+        compute-busy target must not stall until the target polls."""
+        if self._prog_thread is not None or self.finalized:
+            return
+        import time as _time
 
-            self._prog_thread = threading.Thread(
-                target=_pump, name=f"ompi-tpu-prog-{self.rank}", daemon=True)
-            self._prog_thread.start()
+        self._async_progress = True
+
+        def _pump() -> None:
+            while not self.finalized:
+                n = self.engine.progress()
+                # back off when idle: on oversubscribed hosts a hot
+                # spinner starves the app thread it exists to serve
+                _time.sleep(0 if n else 0.001)
+
+        self._prog_thread = threading.Thread(
+            target=_pump, name=f"ompi-tpu-prog-{self.rank}", daemon=True)
+        self._prog_thread.start()
 
     def _install_idle_hook(self, mods) -> None:
         """Wire the engine's blocking idle hook: block on the shm doorbell

@@ -246,7 +246,10 @@ def emit_device_rules(winners: dict, path: str) -> None:
         for nbytes in sorted(by_size):
             mode = by_size[nbytes]
             if mode != prev:
-                lines.append(f"{coll} 2 {0 if prev is None else nbytes} "
+                # min_ndev 1: the rules were measured on THIS mesh — they
+                # must also match when it has a single device (the 1-chip
+                # TPU box), so no device-count gate is encoded
+                lines.append(f"{coll} 1 {0 if prev is None else nbytes} "
                              f"{mode}")
                 prev = mode
     with open(path, "w") as fh:

@@ -511,7 +511,7 @@ class TestDeviceDecision:
         path = tmp_path / "DEVICE_RULES.txt"
         coll_tune.emit_device_rules(winners, str(path))
         text = path.read_text()
-        assert "allreduce 2 0" in text
+        assert "allreduce 1 0" in text
         # the emitted file parses through the decision layer's loader
         from ompi_tpu.coll.xla import _load_device_rules
         from ompi_tpu.core import var

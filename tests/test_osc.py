@@ -183,44 +183,71 @@ def test_window_create_from_existing_buffer():
 # ---------------------------------------------------------------------------
 
 def test_passive_target_progress_while_target_computes():
+    """NO opt-in (VERDICT r3 item 7): creating a window auto-starts the
+    progress thread, so passive-target RMA is serviced unconditionally —
+    the stance of opal_progress.c:216 in the reference."""
     import time
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.osc import win_allocate
+
+    def fn(ctx):
+        c = ctx.comm_world
+        win = win_allocate(c, 4, np.float64)
+        assert ctx._prog_thread is not None     # auto-started by the window
+        if c.rank == 1:
+            c.barrier()
+            # "long user compute": the owner thread never calls into
+            # the library; only the progress thread can serve RMA
+            time.sleep(1.5)
+            c.barrier()
+            val = float(win.local[0])
+            win.free()           # collective
+            return val
+        c.barrier()
+        t0 = time.time()
+        win.lock(1)
+        win.put(np.array([42.0]), 1)
+        win.unlock(1)          # completes only when target applied it
+        elapsed = time.time() - t0
+        c.barrier()
+        win.free()
+        # served by rank 1's progress THREAD, far before its sleep ends
+        assert elapsed < 1.0, f"passive target stalled {elapsed:.2f}s"
+        return elapsed
+
+    res = runtime.run_ranks(2, fn, timeout=60)
+    assert res[1] == 42.0
+    assert res[0] < 1.0
+
+
+def test_async_progress_auto_opt_out():
+    """async_progress_auto=0 restores the strictly-funneled mode: windows
+    do not spawn the thread."""
     import numpy as np
     from ompi_tpu import runtime
     from ompi_tpu.core import var
     from ompi_tpu.osc import win_allocate
 
-    var.registry.set_cli("runtime_async_progress", "1")
+    var.registry.set_cli("runtime_async_progress_auto", "0")
     var.registry.reset_cache()
     try:
         def fn(ctx):
             c = ctx.comm_world
-            win = win_allocate(c, 4, np.float64)
-            if c.rank == 1:
-                c.barrier()
-                # "long user compute": the owner thread never calls into
-                # the library; only the progress thread can serve RMA
-                time.sleep(1.5)
-                c.barrier()
-                val = float(win.local[0])
-                win.free()           # collective
-                return val
-            c.barrier()
-            t0 = time.time()
-            win.lock(1)
-            win.put(np.array([42.0]), 1)
-            win.unlock(1)          # completes only when target applied it
-            elapsed = time.time() - t0
-            c.barrier()
+            win = win_allocate(c, 2, np.float64)
+            alive = ctx._prog_thread is not None
+            win.fence()
+            win.put(np.array([1.0]), (c.rank + 1) % c.size)
+            win.fence()
+            ok = float(win.local[0]) == 1.0
             win.free()
-            # served by rank 1's progress THREAD, far before its sleep ends
-            assert elapsed < 1.0, f"passive target stalled {elapsed:.2f}s"
-            return elapsed
+            return (alive, ok)
 
         res = runtime.run_ranks(2, fn, timeout=60)
-        assert res[1] == 42.0
-        assert res[0] < 1.0
+        assert all(not alive for alive, _ in res)
+        assert all(ok for _, ok in res)
     finally:
-        var.registry.clear_cli("runtime_async_progress")
+        var.registry.clear_cli("runtime_async_progress_auto")
         var.registry.reset_cache()
 
 
@@ -457,3 +484,22 @@ class TestDeviceWindow:
         win = self._win()
         with _pytest.raises(RuntimeError, match="epoch"):
             win.put(0, np.zeros(8, np.float32))
+
+
+def test_async_progress_init_opt_in():
+    """runtime_async_progress=1 still starts the thread AT INIT (before
+    any window exists) — the explicit opt-in path of Context.__init__."""
+    from ompi_tpu import runtime
+    from ompi_tpu.core import var
+
+    var.registry.set_cli("runtime_async_progress", "1")
+    var.registry.reset_cache()
+    try:
+        def fn(ctx):
+            return ctx._prog_thread is not None and \
+                ctx._prog_thread.is_alive()
+
+        assert all(runtime.run_ranks(2, fn, timeout=60))
+    finally:
+        var.registry.clear_cli("runtime_async_progress")
+        var.registry.reset_cache()
