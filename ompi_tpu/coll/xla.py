@@ -376,17 +376,10 @@ class XlaModule(CollModule):
                         f"{C.sum(axis=0).tolist()})")
             if self._mode("alltoallv", sendbuf) == "staged":
                 h = self._stage_out(sendbuf)       # (R, R, cap, *e)
-                R = h.shape[0]
-                recv_tot = C.sum(axis=0)
-                out_cap = self.dc._bucket(int(recv_tot.max()) if R else 1)
-                out = np.zeros((R, out_cap) + h.shape[3:], h.dtype)
-                for j in range(R):
-                    pos = 0
-                    for i in range(R):
-                        c = int(C[i, j])
-                        out[j, pos:pos + c] = h[i, j, :c]
-                        pos += c
-                return self._stage_in(out)
+                out_cap = self.dc._bucket(
+                    int(C.sum(axis=0).max()) if h.shape[0] else 1)
+                return self._stage_in(
+                    self.dc.compact_ragged_blocks(h, C, out_cap))
             out, _tot = self.dc.alltoallv(sendbuf, C)
             return out
         return self.host.alltoallv(comm, self._to_host(sendbuf), recvbuf,

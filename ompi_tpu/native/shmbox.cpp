@@ -156,10 +156,6 @@ int shmbox_attach(const char* name, uint32_t capacity, int create) {
   return n;
 }
 
-// Write one frame. Returns 1 on success into an empty ring (receiver may
-// be blocked on its doorbell — post it), 0 on success into a non-empty
-// ring, -1 if the ring lacks space (caller queues and retries), -2 if the
-// frame can never fit, -3 for an invalid handle.
 // Can a frame of hlen+plen bytes EVER be written to this ring?
 // 0 yes, -2 exceeds ring capacity, -3 invalid/closed handle. Lets a
 // sender with a backed-up queue reject impossible frames immediately
@@ -170,6 +166,10 @@ int shmbox_probe(int h, uint32_t hlen, uint32_t plen) {
   return round8(8ull + hlen + plen) > cp->ctl->capacity ? -2 : 0;
 }
 
+// Write one frame. Returns 1 on success into an empty ring (receiver may
+// be blocked on its doorbell — post it), 0 on success into a non-empty
+// ring, -1 if the ring lacks space (caller queues and retries), -2 if the
+// frame can never fit, -3 for an invalid handle.
 int shmbox_write(int h, const uint8_t* hdr, uint32_t hlen,
                  const uint8_t* payload, uint32_t plen) {
   Chan* cp = chan_of(h);

@@ -25,16 +25,24 @@ TPU-first redesign maps MPI's *epoch* model onto XLA's *program* model:
 ``get`` returns a ``DeviceGetHandle`` whose ``.value`` is a device array
 valid after the closing sync — the MPI completion rule made explicit.
 
-Synchronization surface mirrors the host windows (fence, post/start/
-complete/wait, and lock/unlock degenerating to epochs): in the
-single-controller SPMD model every sync point is a program boundary, so
-active-target epochs map exactly; passive target keeps host-window
-semantics (use the AM-emulation `Window` for that — the reference keeps
-its AM fallback for the same reason, ``btl_base_am_rdma.c:1203``).
+Synchronization surface mirrors the host windows: fence and PSCW map to
+program boundaries exactly; **passive target** (lock/unlock/flush,
+≙ ``osc_rdma_passive_target.c``) is served by coordinator-mediated
+execution — a per-window arbiter (condition variable) grants
+shared/exclusive locks per target rank, each locking thread records its
+epoch into its own buffer, and ``flush``/``unlock`` executes the queued
+ops as one cached device program under the window's execution mutex.
+The arbiter plays the role the reference's target-side lock queue plays
+(``osc_rdma_passive_target.c`` lock exchange): origins never touch the
+array concurrently, and exclusivity is real across controller threads
+(the run_ranks regime). XLA has no one-sided verb, so the *transfer*
+is still a collective program — but lock semantics, flush-completes-gets,
+and shared/exclusive arbitration all hold.
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -43,6 +51,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..op import SUM, Op
+from .window import LOCK_EXCLUSIVE, LOCK_SHARED  # one source of truth
 
 # device kernels per wire name: numpy ufuncs reject tracers, so the epoch
 # program combines with jnp (≙ the op/avx table's device column, op.h:503)
@@ -112,14 +121,26 @@ class DeviceWindow:
         self._in_epoch = False
         self._cache: Dict[Tuple, Any] = {}
         self._pscw_targets: Optional[list] = None
+        # passive target: per-target lock table arbitrated by a condition
+        # variable (the coordinator role of the reference's target-side
+        # lock queue, osc_rdma_passive_target.c); per-thread epoch buffers
+        self._lock_cv = threading.Condition()
+        self._lock_table: Dict[int, Tuple[int, int]] = {}  # tgt→(type, n)
+        self._passive = threading.local()
+        self._exec_mu = threading.Lock()   # serializes array donation
 
     # -- epoch recording -----------------------------------------------------
 
+    def _passive_state(self):
+        st = getattr(self._passive, "st", None)
+        return st if st and st["locks"] else None
+
     def _record(self, entry: Tuple) -> None:
-        if not self._in_epoch:
+        st = self._passive_state()
+        if st is None and not self._in_epoch:
             raise RuntimeError(
-                "device-window RMA outside an access epoch (call fence() "
-                "or start() first)")
+                "device-window RMA outside an access epoch (call fence(), "
+                "start(), or lock() first)")
         # validate NOW, while target/offset are concrete python ints —
         # inside the program dynamic_slice CLAMPS out-of-range starts,
         # which would silently land the op on the wrong rank/range
@@ -133,6 +154,13 @@ class DeviceWindow:
             raise IndexError(
                 f"RMA range [{offset}, {offset + n}) outside the "
                 f"{flat_len}-element window slice")
+        if st is not None:
+            if target not in st["locks"]:
+                raise RuntimeError(
+                    f"RMA to rank {target} without holding its lock "
+                    "(passive-target epoch)")
+            st["ops"].append(entry)
+            return
         self._ops.append(entry)
 
     def _payload(self, data) -> jax.Array:
@@ -190,20 +218,27 @@ class DeviceWindow:
     def _run_epoch(self) -> None:
         ops = self._ops
         self._ops = []
+        self._execute(ops)
+
+    def _execute(self, ops: List[Tuple]) -> None:
+        """Run a recorded op list as one cached device program. The
+        execution mutex serializes the donated-array swap so passive
+        epochs from concurrent controller threads never race the buffer."""
         if not ops:
             return
         sig = self._signature(ops)
-        fn = self._cache.get(sig)
-        if fn is None:
-            fn = self._build(sig)
-            self._cache[sig] = fn
-        args = []
-        for e in ops:
-            args.append(jnp.int32(e[1]))           # target
-            args.append(jnp.int32(e[2]))           # offset
-            if e[0] in ("put", "acc", "getacc"):
-                args.append(e[4])                  # payload
-        self.array, gets = fn(self.array, *args)
+        with self._exec_mu:
+            fn = self._cache.get(sig)
+            if fn is None:
+                fn = self._build(sig)
+                self._cache[sig] = fn
+            args = []
+            for e in ops:
+                args.append(jnp.int32(e[1]))       # target
+                args.append(jnp.int32(e[2]))       # offset
+                if e[0] in ("put", "acc", "getacc"):
+                    args.append(e[4])              # payload
+            self.array, gets = fn(self.array, *args)
         gi = 0
         for e in ops:
             if e[0] == "get":
@@ -297,6 +332,83 @@ class DeviceWindow:
     def wait(self) -> None:
         """MPI_Win_wait — in this model the access side's complete() IS the
         program launch, after which all updates are visible."""
+
+    # -- passive target (≙ osc_rdma_passive_target.c) -----------------------
+
+    def lock(self, target: int, lock_type: int = LOCK_EXCLUSIVE) -> None:
+        """MPI_Win_lock: open a passive access epoch toward ``target``.
+        The window's arbiter blocks until the lock is grantable (exclusive
+        excludes everyone; shared excludes exclusive) — real mutual
+        exclusion across controller threads, the coordinator-mediated
+        role of the reference's target-side lock queue."""
+        if not 0 <= int(target) < self.nranks:
+            raise IndexError(f"lock target {target} outside "
+                             f"[0, {self.nranks})")
+        if lock_type not in (LOCK_SHARED, LOCK_EXCLUSIVE):
+            raise ValueError(f"unknown lock type {lock_type}")
+        st = getattr(self._passive, "st", None)
+        if st is None:
+            st = self._passive.st = {"locks": {}, "ops": []}
+        if target in st["locks"]:
+            raise RuntimeError(f"rank {target} already locked by this "
+                               "thread")
+        with self._lock_cv:
+            while True:
+                held = self._lock_table.get(int(target))
+                if held is None:
+                    self._lock_table[int(target)] = (lock_type, 1)
+                    break
+                htype, n = held
+                if htype == LOCK_SHARED and lock_type == LOCK_SHARED:
+                    self._lock_table[int(target)] = (htype, n + 1)
+                    break
+                self._lock_cv.wait()
+        st["locks"][int(target)] = lock_type
+
+    def lock_all(self, lock_type: int = LOCK_SHARED) -> None:
+        """MPI_Win_lock_all (shared by definition). Ascending target order
+        makes concurrent lock_all callers deadlock-free."""
+        for t in range(self.nranks):
+            self.lock(t, lock_type)
+
+    def flush(self, target: Optional[int] = None) -> None:
+        """MPI_Win_flush[_all]: execute this thread's queued ops (for one
+        target, or all) as one device program; gets complete NOW."""
+        st = self._passive_state()
+        if st is None:
+            raise RuntimeError("flush() outside a passive-target epoch")
+        if target is None:
+            ops, st["ops"] = st["ops"], []
+        else:
+            ops = [e for e in st["ops"] if e[1] == int(target)]
+            st["ops"] = [e for e in st["ops"] if e[1] != int(target)]
+        self._execute(ops)
+
+    def flush_all(self) -> None:
+        self.flush(None)
+
+    def unlock(self, target: int) -> None:
+        """MPI_Win_unlock: flush the target's queued ops and release its
+        lock (arbiter wakes any waiter)."""
+        st = self._passive_state()
+        if st is None or int(target) not in st["locks"]:
+            raise RuntimeError(f"unlock({target}) without lock()")
+        self.flush(target)
+        del st["locks"][int(target)]
+        with self._lock_cv:
+            htype, n = self._lock_table[int(target)]
+            if n > 1:
+                self._lock_table[int(target)] = (htype, n - 1)
+            else:
+                del self._lock_table[int(target)]
+            self._lock_cv.notify_all()
+
+    def unlock_all(self) -> None:
+        st = self._passive_state()
+        if st is None:
+            raise RuntimeError("unlock_all() without lock_all()")
+        for t in sorted(st["locks"]):
+            self.unlock(t)
 
     def free(self) -> None:
         self._cache.clear()

@@ -69,14 +69,37 @@ def take(job_id: str, cid: int, src: int, dst: int,
 
 
 def deliver(arr, template) -> Any:
-    """Land a claimed device array on the receiver's side: reshard with a
-    PJRT copy only when the posted template pins a different sharding;
-    otherwise the immutable array is the result as-is (zero copies)."""
-    if template is None:
-        return arr
+    """Land a claimed device array on the receiver's side with the SAME
+    result contract as the staged path (stage_in): the posted template's
+    shape/dtype survive.
+
+    Fast path — template matches the payload's shape and dtype (the normal
+    case: receivers post like-shaped buffers): the immutable array is the
+    result as-is, resharded with one PJRT copy only if the template pins a
+    different sharding. Zero host transfers.
+
+    Slow path — shape/dtype mismatch: reproduce stage_in's fill-front byte
+    semantics exactly (front of the template overwritten by the payload
+    bytes, tail preserved, dtype reinterpreted) via one host round trip.
+    Returns (result, staged_bytes) where staged_bytes > 0 only on the slow
+    path so the caller can account it."""
     import jax
 
-    tgt = getattr(template, "sharding", None)
-    if tgt is None or tgt == getattr(arr, "sharding", None):
-        return arr
-    return jax.device_put(arr, tgt)
+    if template is None:
+        return arr, 0
+    t_shape = getattr(template, "shape", None)
+    t_dtype = getattr(template, "dtype", None)
+    if t_shape == arr.shape and t_dtype == arr.dtype:
+        tgt = getattr(template, "sharding", None)
+        if tgt is None or tgt == getattr(arr, "sharding", None):
+            return arr, 0
+        return jax.device_put(arr, tgt), 0
+    import jax.numpy as jnp
+    import numpy as np
+
+    data = np.asarray(jax.device_get(arr)).reshape(-1).view(np.uint8)
+    tmpl = np.array(jax.device_get(template))      # writable host copy
+    flat = tmpl.reshape(-1).view(np.uint8)
+    n = min(len(data), len(flat))
+    flat[:n] = data[:n]
+    return jnp.asarray(tmpl), len(data)

@@ -413,7 +413,11 @@ class P2P:
                         f"{u.header['size']}B"))
                     return
                 if dinfo is not None:
-                    result = devchan.deliver(darr, template)
+                    result, staged = devchan.deliver(darr, template)
+                    if staged:
+                        # shape/dtype-mismatched delivery reproduced the
+                        # staged fill-front semantics via host — account it
+                        self.spc.inc("device_stage_in_bytes", staged)
                     if isinstance(buf, _accel.DeviceBuffer):
                         buf.array = result
                     req.result = result

@@ -490,6 +490,42 @@ class DeviceComm:
         """Next power-of-two capacity bucket (≥1)."""
         return 1 << max(int(n) - 1, 0).bit_length() if n > 1 else 1
 
+    @staticmethod
+    def pack_ragged_blocks(rows: np.ndarray, C: np.ndarray,
+                           cap: int) -> np.ndarray:
+        """Host helper: dense per-rank rows (R, total, *e) + counts matrix
+        C (C[i, j] = elements rank i sends to j, row sums ≤ total) → the
+        padded (R, R, cap, *e) block layout alltoallv consumes. One
+        implementation shared by the bench, the tuner, and tests."""
+        rows = np.asarray(rows)
+        R = C.shape[0]
+        out = np.zeros((R, R, cap) + rows.shape[2:], rows.dtype)
+        for i in range(R):
+            off = 0
+            for j in range(R):
+                c = int(C[i, j])
+                out[i, j, :c] = rows[i, off:off + c]
+                off += c
+        return out
+
+    @staticmethod
+    def compact_ragged_blocks(blocks: np.ndarray, C: np.ndarray,
+                              out_cap: int) -> np.ndarray:
+        """Host helper: the inverse compaction — padded (R, R, cap, *e)
+        blocks → (R, out_cap, *e) rows, row j the dense concatenation of
+        every source's valid elements for j (the staged arm of
+        alltoallv, and the expected-value oracle in tests)."""
+        blocks = np.asarray(blocks)
+        R = C.shape[0]
+        out = np.zeros((R, out_cap) + blocks.shape[3:], blocks.dtype)
+        for j in range(R):
+            pos = 0
+            for i in range(R):
+                c = int(C[i, j])
+                out[j, pos:pos + c] = blocks[i, j, :c]
+                pos += c
+        return out
+
     def pad_ragged(self, arrays: Sequence[np.ndarray]
                    ) -> Tuple[jax.Array, list]:
         """Per-rank ragged host buffers → ((R, cap_bucket, *e) padded device

@@ -185,9 +185,15 @@ def run_device_sweep(iters: int, sizes=None):
                         rows_n, rows_n, count // rows_n), 0, 1))),
                     dc.sharding()).block_until_ready()),
         }
+        # ragged rows are recorded under the PADDED per-rank bytes the
+        # decision layer's _mode computes on the canonical input — a rule
+        # emitted from this sweep must match the workload it measured
+        # (dense labels would be off by the padding factor)
+        eff_bytes = {}
         if per >= 1:
             xp, counts_list = dc.pad_ragged(
                 [host[r, :c] for r, c in enumerate(vbase)])
+            eff_bytes["allgatherv"] = int(xp.shape[1]) * 4
             cases["allgatherv"] = (
                 lambda: dc.allgatherv(xp, counts_list).block_until_ready(),
                 lambda: jax.device_put(jnp.asarray(np.broadcast_to(
@@ -197,27 +203,16 @@ def run_device_sweep(iters: int, sizes=None):
                     dc.sharding()).block_until_ready())
             cap = dc._bucket(int(C.max()))
             if rows_n * rows_n * cap * 4 <= 1 << 27:
-                blk = np.zeros((rows_n, rows_n, cap), np.float32)
-                for rr in range(rows_n):
-                    off = 0
-                    for jj in range(rows_n):
-                        c = int(C[rr, jj])
-                        blk[rr, jj, :c] = host[rr, off:off + c]
-                        off += c
-                xb = jax.device_put(jnp.asarray(blk), dc.sharding())
+                xb = jax.device_put(jnp.asarray(
+                    dc.pack_ragged_blocks(host, C, cap)), dc.sharding())
                 out_cap = dc._bucket(int(C.sum(axis=0).max()))
+                eff_bytes["alltoallv"] = rows_n * cap * 4
 
                 def staged_a2av():
                     h = np.asarray(jax.device_get(xb))
-                    out = np.zeros((rows_n, out_cap), np.float32)
-                    for jj in range(rows_n):
-                        pos = 0
-                        for ii in range(rows_n):
-                            c = int(C[ii, jj])
-                            out[jj, pos:pos + c] = h[ii, jj, :c]
-                            pos += c
-                    jax.device_put(jnp.asarray(out),
-                                   dc.sharding()).block_until_ready()
+                    jax.device_put(jnp.asarray(
+                        dc.compact_ragged_blocks(h, C, out_cap)),
+                        dc.sharding()).block_until_ready()
 
                 cases["alltoallv"] = (
                     lambda: dc.alltoallv(xb, C)[0].block_until_ready(),
@@ -226,11 +221,13 @@ def run_device_sweep(iters: int, sizes=None):
             nus = timed(native)
             sus = timed(staged)
             mode = "native" if nus <= sus else "staged"
-            rows.append({"coll": coll, "bytes": nbytes,
+            eff = eff_bytes.get(coll, nbytes)
+            rows.append({"coll": coll, "bytes": eff,
+                         "nominal_bytes": nbytes,
                          "native_us": round(nus, 1),
                          "staged_us": round(sus, 1), "winner": mode})
-            winners.setdefault(coll, {})[nbytes] = mode
-            print(f"device {coll:12s} {nbytes:>9d}B  native {nus:9.1f}us "
+            winners.setdefault(coll, {})[eff] = mode
+            print(f"device {coll:12s} {eff:>9d}B  native {nus:9.1f}us "
                   f"staged {sus:9.1f}us -> {mode}", flush=True)
     return rows, winners
 

@@ -336,3 +336,30 @@ class TestDeviceChannel:
         assert sent and got
         assert int(sent[0].split()[1]) == 64      # staged out (fallback)
         assert int(got[0].split()[1]) == 64       # staged in
+
+    def test_short_send_keeps_template_shape(self):
+        """A shorter payload into a larger posted DeviceBuffer keeps the
+        template's shape (fill-front, tail preserved) — identical contract
+        to the staged path's stage_in."""
+        import jax
+        import jax.numpy as jnp
+        from ompi_tpu import accelerator, runtime
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 2}, devices=jax.devices()[:2])
+            attach_mesh(c, mesh, "x")
+            if ctx.rank == 0:
+                c.send(jnp.full(512, 3.0, jnp.float32), 1, tag=9)
+                return True
+            buf = accelerator.DeviceBuffer(jnp.full(1024, -1.0, jnp.float32))
+            r = c.irecv(buf, 0, tag=9)
+            r.wait()
+            got = np.asarray(r.result)
+            assert got.shape == (1024,), got.shape
+            np.testing.assert_allclose(got[:512], 3.0)
+            np.testing.assert_allclose(got[512:], -1.0)   # tail preserved
+            return True
+
+        assert all(runtime.run_ranks(2, fn))
