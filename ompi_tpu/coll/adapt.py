@@ -25,6 +25,18 @@ Selection: registered as coll component ``adapt`` at priority 5 (below
 nbc), so the stock dispatch is unchanged; raise ``coll_adapt_priority``
 to let its ibcast/ireduce win selection, or call
 ``ibcast_adapt``/``ireduce_adapt`` directly.
+
+Status (round-4 measurement, BASELINE.md "coll/adapt on the DCN
+stand-in"): on every fabric this box can express — shm+CMA, and
+tcp-only 4-rank (the DCN stand-in) at 1/4/16 MB — whole-message
+binomial beats adapt by ~1.2-1.6×, because event-driven overlap needs
+CONCURRENT cores and this host has one: segment completion callbacks
+serialize, leaving only their per-segment overhead. The component is
+therefore demoted to a correctness-complete, measurement-pending
+implementation: its claimed habitat (multi-host DCN, a core per rank,
+per-hop bandwidth dominating) does not exist on this hardware, and the
+default priority keeps it unselected until a fabric where it measures a
+win is available.
 """
 
 from __future__ import annotations

@@ -120,6 +120,10 @@ def _fail_pending_recvs(ctx, failed_rank: int) -> None:
     ctx.p2p.matching.fail_src(
         failed_rank, ProcFailedError(failed_rank), any_source_cids=cids,
         pending_err=ProcFailedPendingError(failed_rank))
+    # in-flight operations too: rndv sends awaiting the corpse's ACK/FIN
+    # and fragment trains it was streaming (round-3 verdict item 10 — the
+    # C++-engine paths the posted-recv sweep above cannot reach)
+    ctx.p2p.fail_peer(failed_rank, ProcFailedError(failed_rank))
 
 
 def failure_ack(comm) -> None:

@@ -134,10 +134,10 @@ class _SendState:
 
 class _RecvState:
     __slots__ = ("req", "conv", "received", "total", "finish", "sink_buf",
-                 "native_sink", "_ivals")
+                 "native_sink", "_ivals", "src")
 
     def __init__(self, req: Request, conv, total: int,
-                 finish=None) -> None:
+                 finish=None, src: int = -1) -> None:
         self.req = req
         self.conv = conv
         self.received = 0
@@ -146,6 +146,7 @@ class _RecvState:
         self.sink_buf = None     # contiguous target for the native frag sink
         self.native_sink = False
         self._ivals: list = []   # merged covered [start, end) intervals
+        self.src = src           # streaming peer (ULFM mid-train failure)
 
     def cover(self, off: int, n: int) -> None:
         """Merge [off, off+n) into coverage; striping failover may replay
@@ -233,6 +234,29 @@ class P2P:
     def finalize(self) -> None:
         from . import devchan
         devchan.unregister(self.bootstrap.job_id, self.rank)
+
+    @_guarded
+    def fail_peer(self, peer: int, err: Exception) -> None:
+        """ULFM: complete every IN-FLIGHT operation whose remote end is the
+        failed rank — rendezvous sends awaiting ACK/FIN and mid-train
+        fragment receives — so nothing blocks on a corpse. Complements
+        matching.fail_src, which covers only still-POSTED receives
+        (≙ the reference failing active requests from
+        comm_ft_detector.c's error propagation)."""
+        for sreq, state in list(self._pending_send.items()):
+            if state.dst == peer:
+                del self._pending_send[sreq]
+                state.req.complete(err)
+        for rreq, state in list(self._pending_recv.items()):
+            if state.src == peer:
+                del self._pending_recv[rreq]
+                self._unregister_sink(rreq, state)
+                state.req.complete(err)
+
+    def _unregister_sink(self, rreq: int, state: "_RecvState") -> None:
+        """Hook: the native pml detaches the C++ fragment sink so late
+        ring frames from the corpse can't memcpy into a buffer the
+        application reclaimed after seeing the error."""
 
     # -- send ---------------------------------------------------------------
 
@@ -469,11 +493,12 @@ class P2P:
                 if dinfo is not None:
                     sink = _PackedSink(u.header["size"])
                     state = _RecvState(req, sink, u.header["size"],
-                                       finish=lambda: deliver(bytes(sink.data)))
+                                       finish=lambda: deliver(bytes(sink.data)),
+                                       src=u.src)
                     state.sink_buf = sink.data       # native-sink candidate
                 else:
                     state = _RecvState(req, Convertor(arr, dt, cnt),
-                                       u.header["size"])
+                                       u.header["size"], src=u.src)
                     if dt.is_contiguous and arr.flags["C_CONTIGUOUS"]:
                         state.sink_buf = arr         # native-sink candidate
                 self._pending_recv[rreq] = state
@@ -618,7 +643,11 @@ class P2P:
 
     # split out so the native pml's drained events reuse the exact protocol
     def _handle_ack(self, src: int, sreq: int, rreq: int) -> None:
-        state = self._pending_send.pop(sreq)
+        state = self._pending_send.pop(sreq, None)
+        if state is None:
+            # fail_peer already errored this send (the peer died after
+            # acking): a late in-flight ACK must not crash the survivor
+            return
         if rreq < 0:             # receiver matched but discarded (truncate)
             state.req.complete()
         else:
@@ -626,7 +655,9 @@ class P2P:
 
     def _handle_fin(self, sreq: int) -> None:
         """CMA single-copy done: nothing to stream."""
-        state = self._pending_send.pop(sreq)
+        state = self._pending_send.pop(sreq, None)
+        if state is None:
+            return               # errored by fail_peer; late FIN is benign
         state.keep = None
         state.req.complete()
 

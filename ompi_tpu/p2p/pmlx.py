@@ -253,6 +253,11 @@ class NativeP2P(P2P):
                       "sreq": imm.sreq_or_token}
         return Unexpected(imm.src, imm.tag, imm.seq, "rndv", header, b"")
 
+    def _unregister_sink(self, rreq: int, state) -> None:
+        if state.native_sink:
+            self._lib.mx_remove_sink(self._mxh, rreq)
+            state.native_sink = False
+
     def _register_sink(self, rreq: int, state, src: int) -> None:
         """Contiguous sinks land by C++ memcpy when the peer's frags come
         over an mx-owned ring (pml hook)."""

@@ -1,0 +1,99 @@
+"""ULFM under the native engine: SIGKILL a rank MID-LARGE-TRANSFER.
+
+Two modes (FT_MODE env), both with a real corpse (closed sockets, stale
+shm rings — not simulate_failure), run by test_ft.py under
+``tpurun --enable-recovery`` (≙ comm_ft_detector.c:49-86 recovery):
+
+* ``frag_rx`` — the victim is the RECEIVER of an 8 MB rendezvous and dies
+  before acking: the sender's pending rndv send must complete in ERROR
+  once the detector flags the corpse (p2p.fail_peer), never hang.
+* ``cma_tx`` — the victim is the SENDER of a CMA-advertised rendezvous
+  and dies right after the advertise: the receiver's pull hits a dead
+  pid, the fragment fallback gets no fragments, and the mid-train recv
+  state must complete in ERROR on detection.
+
+Survivors then shrink and run a collective (the standard ULFM recipe).
+"""
+
+import os
+import signal
+import sys
+import time
+
+import numpy as np
+
+from ompi_tpu import ft, runtime
+
+MODE = os.environ["FT_MODE"]
+VICTIM = 1
+NB = 8 << 20
+
+
+def main() -> int:
+    ctx = runtime.init()
+    ft.enable(ctx)
+    c = ctx.comm_world
+    if ctx.rank == 0:
+        # make the engine under test visible to the asserting test: the
+        # native=1 parametrization must FAIL loudly, not silently
+        # degrade, if the C++ engine did not come up
+        print(f"rank 0: ENGINE {type(ctx.p2p).__name__}", flush=True)
+    c.barrier()
+
+    if MODE == "frag_rx":
+        if ctx.rank == VICTIM:
+            os.kill(os.getpid(), signal.SIGKILL)
+        if ctx.rank == 0:
+            time.sleep(0.5)                    # let the corpse settle
+            try:
+                req = c.isend(np.arange(NB // 8, dtype=np.float64),
+                              VICTIM, 9)
+                req.wait(timeout=60)
+                print("rank 0: SEND-HUNG-COMPLETED", flush=True)
+                return 3                       # must not silently succeed
+            except TimeoutError:
+                print("rank 0: SEND-TIMEOUT", flush=True)
+                return 4
+            except Exception as exc:
+                print(f"rank 0: XFER-FAILED-OK {type(exc).__name__}",
+                      flush=True)
+    else:                                      # cma_tx
+        if ctx.rank == VICTIM:
+            c.isend(np.arange(NB // 8, dtype=np.float64), 0, 9)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if ctx.rank == 0:
+            # the kill races the pull on this 1-core box: EITHER the
+            # transfer wins (data must be intact) OR the corpse is hit
+            # mid-pull and the recv must ERROR. The only failure is a hang.
+            buf = np.zeros(NB // 8)
+            try:
+                rreq = c.irecv(buf, VICTIM, 9)
+                rreq.wait(timeout=60)
+                assert buf[-1] == NB // 8 - 1, "torn transfer delivered"
+                print("rank 0: XFER-COMPLETED-OK", flush=True)
+            except TimeoutError:
+                print("rank 0: RECV-TIMEOUT", flush=True)
+                return 4
+            except Exception as exc:
+                print(f"rank 0: XFER-FAILED-OK {type(exc).__name__}",
+                      flush=True)
+
+    # survivors: detect, shrink, and compute on the shrunken comm
+    deadline = time.monotonic() + 30
+    while VICTIM not in ft.failed_ranks(ctx):
+        ctx.engine.progress()
+        if time.monotonic() > deadline:
+            print(f"rank {ctx.rank}: DETECT-TIMEOUT", flush=True)
+            return 2
+    small = ft.shrink(c)
+    assert VICTIM not in small.group.world_ranks
+    out = small.coll.allreduce(small, np.full(4, 1.0))
+    assert float(np.asarray(out)[0]) == small.size == 3
+    print(f"rank {ctx.rank}: SHRINK-OK size={small.size}", flush=True)
+    # no finalize: the world fence would wait on the corpse (the ULFM
+    # recipe endpoint, same as ft_kill_victim.py)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -327,3 +327,48 @@ c.recv(buf, src=(ctx.rank + 1) % c.size, tag=99)
                                               r.stderr)
     finally:
         os.unlink(prog.name)
+
+
+@pytest.mark.parametrize("mode,native", [
+    ("frag_rx", "1"), ("frag_rx", "0"),
+    ("cma_tx", "1"), ("cma_tx", "0"),
+])
+def test_ft_kill_mid_transfer(mode, native):
+    """SIGKILL a rank mid-large-transfer (round-3 verdict item 10): the
+    peer's in-flight rndv send / mid-train recv must complete in ERROR on
+    detection (p2p.fail_peer), never hang — with the C++ engine forced on
+    AND off; survivors shrink and compute."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["FT_MODE"] = mode
+    env["OMPI_TPU_pml_base_native"] = native
+    env["OMPI_TPU_ft_detector_period"] = "0.1"
+    env["OMPI_TPU_ft_detector_timeout"] = "3.0"
+    proc = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.tpurun", "-np", "4",
+         "--enable-recovery", "--timeout", "150",
+         os.path.join(repo, "tests", "ft_kill_transfer_victim.py")],
+        capture_output=True, text=True, env=env, timeout=200)
+    out = proc.stdout + proc.stderr
+    # the engine under test must actually be the one requested (a silent
+    # fallback would leave the C++ paths uncovered with a green result)
+    from ompi_tpu import native as native_mod
+    if native == "1" and not native_mod.available():
+        import pytest as _pytest
+        _pytest.skip("native toolchain unavailable")
+    want = "ENGINE NativeP2P" if native == "1" else "ENGINE P2P"
+    assert want in out, out
+    # frag_rx is deterministic (corpse exists before the send); cma_tx
+    # races the kill against the pull — completed-with-intact-data and
+    # failed-on-detection are both legal, a hang/timeout is the bug
+    if mode == "frag_rx":
+        assert "XFER-FAILED-OK" in out, out
+    else:
+        assert "XFER-FAILED-OK" in out or "XFER-COMPLETED-OK" in out, out
+    assert out.count("SHRINK-OK size=3") == 3, out
+    assert proc.returncode == 0, (proc.returncode, out)
