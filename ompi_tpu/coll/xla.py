@@ -271,6 +271,30 @@ class XlaModule(CollModule):
         self.host.barrier(comm)
         self.dc.barrier()
 
+    # -- neighborhood collectives (halo exchange) ---------------------------
+    # Periodic cartesian topologies compile to 2·ndims ppermutes
+    # (DeviceComm cart section ≙ coll_basic_neighbor_*.c specialized to
+    # the torus); graph / non-periodic topologies keep the host path.
+
+    def _cart_ok(self, comm, x, need_ndim: int) -> bool:
+        topo = getattr(comm, "topo", None)
+        return (topo is not None and getattr(topo, "kind", "") == "cart"
+                and all(topo.periods) and self._rows_ok(x, need_ndim)
+                and topo.size == x.shape[0] == self.dc.n)
+
+    def neighbor_allgather(self, comm, sendbuf, recvbuf=None):
+        if recvbuf is None and self._cart_ok(comm, sendbuf, 2):
+            return self.dc.neighbor_allgather_cart(sendbuf, comm.topo)
+        return self.host.basic.neighbor_allgather(
+            comm, self._to_host(sendbuf), recvbuf)
+
+    def neighbor_alltoall(self, comm, sendbuf, recvbuf=None):
+        if recvbuf is None and self._cart_ok(comm, sendbuf, 3) \
+                and sendbuf.shape[1] == 2 * len(comm.topo.dims):
+            return self.dc.neighbor_alltoall_cart(sendbuf, comm.topo)
+        return self.host.basic.neighbor_alltoall(
+            comm, self._to_host(sendbuf), recvbuf)
+
     # -- ragged / rooted entries: NATIVE ICI programs when the caller
     # presents the canonical padded device layout (DeviceComm docstring),
     # staged-host fallback otherwise. The reference implements these as

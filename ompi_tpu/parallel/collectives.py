@@ -392,6 +392,87 @@ class DeviceComm:
 
         return self._compiled(key, build)(x)
 
+    # -- cartesian neighborhood exchange (halo / stencil) -------------------
+    #
+    # ≙ the neighborhood collectives (coll_basic_neighbor_*.c) specialized
+    # to PERIODIC cartesian topologies — the torus halo exchange stencil
+    # codes live on (BASELINE.json configs[4], HPCG/miniFE). On a periodic
+    # cart every neighbor slot (dim d, direction ±1) is ONE static ring
+    # permutation of the whole rank set, so the exchange compiles to
+    # 2·ndims ppermutes — no per-rank send/recv loops. Non-periodic carts
+    # have ragged boundary neighborhoods; those stay on the host path.
+
+    def _cart_perms(self, topo) -> list:
+        """[(dim, dir, [(src, dst), ...])] in the standard's slot order
+        (per dim: -1 then +1). Requires a fully periodic cart of exactly
+        R ranks."""
+        R = self.mesh.shape[self.axis]
+        rows = R  # perms act on mesh positions; rows==R enforced by caller
+        perms = []
+        for dim in range(len(topo.dims)):
+            for disp in (-1, 1):
+                pairs = []
+                for i in range(rows):
+                    c = topo.coords(i)
+                    c[dim] += disp           # periodic wrap in rank_of
+                    # value FROM the disp-neighbor lands AT i
+                    pairs.append((topo.rank_of(c), i))
+                perms.append((dim, disp, pairs))
+        return perms
+
+    def _check_cart(self, x, topo) -> None:
+        if not all(topo.periods):
+            raise ValueError("device cart exchange requires a fully "
+                             "periodic topology (host path otherwise)")
+        if topo.size != x.shape[0] or x.shape[0] != self.n:
+            raise ValueError(
+                f"cart size {topo.size} / rows {x.shape[0]} / mesh "
+                f"{self.n} disagree (rank-per-position layout required)")
+
+    def neighbor_allgather_cart(self, x: jax.Array, topo) -> jax.Array:
+        """(R, b, *e) → (R, k, b, *e): slot j of row i is neighbor j's
+        row (k = 2·ndims, dim-major, -1 then +1)."""
+        self._check_cart(x, topo)
+        key = ("neighbor_ag", tuple(topo.dims), x.shape, str(x.dtype))
+
+        def build():
+            # perm construction lives inside build(): the key (dims,
+            # shape) fully determines it, so cache hits on the stencil
+            # hot path skip the O(R·ndims) coordinate math entirely
+            perms = self._cart_perms(topo)
+
+            def inner(xs):           # (1, b, *e) per position (r == 1)
+                slots = [lax.ppermute(xs, self.axis, pairs)
+                         for _d, _s, pairs in perms]
+                return jnp.stack(slots, axis=1)   # (1, k, b, *e)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
+    def neighbor_alltoall_cart(self, x: jax.Array, topo) -> jax.Array:
+        """(R, k, b, *e) → (R, k, b, *e): block j of rank i travels to
+        neighbor j, landing in the MIRROR slot (dim's -1 block arrives in
+        the receiver's +1 slot) — the halo-exchange data motion."""
+        self._check_cart(x, topo)
+        k = 2 * len(topo.dims)
+        if x.shape[1] != k:
+            raise ValueError(f"block dim {x.shape[1]} != {k} neighbors")
+        key = ("neighbor_a2a", tuple(topo.dims), x.shape, str(x.dtype))
+
+        def build():
+            perms = self._cart_perms(topo)
+
+            def inner(xs):           # (1, k, b, *e)
+                slots = []
+                for j, (_d, _s, pairs) in enumerate(perms):
+                    mirror = j ^ 1   # (-1, +1) pair within the dim
+                    slots.append(lax.ppermute(xs[:, mirror], self.axis,
+                                              pairs))
+                return jnp.stack(slots, axis=1)
+            return self._shard_map(inner, self._spec, self._spec)
+
+        return self._compiled(key, build)(x)
+
     def push_row(self, x: jax.Array, src: int, dst: int) -> jax.Array:
         """ICI p2p: (R, *e) → (R, *e) with row dst ← row src's data, other
         rows unchanged — the one-hop collective-permute program behind

@@ -524,3 +524,77 @@ class TestDeviceDecision:
         finally:
             var.registry.set_cli("coll_xla_dynamic_rules", "")
             var.registry.reset_cache()
+
+
+class TestDeviceCartNeighbor:
+    """Device-native periodic-cart halo exchange: 2·ndims ppermutes
+    (≙ coll_basic_neighbor_* specialized to the torus — the stencil
+    workload of BASELINE.json configs[4])."""
+
+    def _topo(self, dims):
+        from ompi_tpu.topo import CartTopo
+        return CartTopo(dims, [True] * len(dims))
+
+    def test_neighbor_allgather_2d_torus(self):
+        dc = DeviceComm(make_mesh({"x": N}), "x")
+        topo = self._topo([2, 4])
+        x = dc.from_ranks([np.full(3, float(i), np.float32)
+                           for i in range(N)])
+        out = dc.neighbor_allgather_cart(x, topo)     # (8, 4, 3)
+        rows = np.asarray(jax.device_get(out))
+        for i in range(N):
+            nbrs = topo.neighbors(i)                  # [-d0, +d0, -d1, +d1]
+            assert len(nbrs) == 4
+            for j, nb in enumerate(nbrs):
+                np.testing.assert_allclose(rows[i, j], np.full(3, float(nb)),
+                                           err_msg=f"rank {i} slot {j}")
+
+    def test_neighbor_alltoall_1d_ring(self):
+        dc = DeviceComm(make_mesh({"x": N}), "x")
+        topo = self._topo([N])
+        # block 0 (-1 side) and block 1 (+1 side) per rank
+        x = dc.from_ranks([
+            np.stack([np.full(2, 100.0 * i, np.float32),       # to left
+                      np.full(2, 100.0 * i + 1, np.float32)])  # to right
+            for i in range(N)])
+        out = dc.neighbor_alltoall_cart(x, topo)
+        rows = np.asarray(jax.device_get(out))
+        for i in range(N):
+            left, right = (i - 1) % N, (i + 1) % N
+            # slot 0 (-1): from left neighbor, ITS +1 block (toward me)
+            np.testing.assert_allclose(rows[i, 0],
+                                       np.full(2, 100.0 * left + 1))
+            # slot 1 (+1): from right neighbor, its -1 block
+            np.testing.assert_allclose(rows[i, 1],
+                                       np.full(2, 100.0 * right))
+
+    def test_halo_exchange_via_coll_dispatch(self):
+        """The coll/xla module routes a canonical device layout on a
+        periodic-cart mesh comm through the native exchange."""
+        def fn2(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import CartTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            c.topo = CartTopo([2, 2], [True, True])
+            dcomm = c.device_comm
+            x = dcomm.from_ranks([np.arange(2, dtype=np.float32) + 10 * i
+                                  for i in range(4)])
+            dev = c.coll.neighbor_allgather(c, x)
+            assert isinstance(dev, jax.Array)
+            rows = np.asarray(jax.device_get(dev))
+            for i in range(4):
+                for j, nb in enumerate(c.topo.neighbors(i)):
+                    np.testing.assert_allclose(
+                        rows[i, j], np.arange(2) + 10 * nb)
+            return True
+
+        assert runtime.run_ranks(1, fn2)[0]
+
+    def test_non_periodic_falls_back(self):
+        dc = DeviceComm(make_mesh({"x": N}), "x")
+        from ompi_tpu.topo import CartTopo
+        topo = CartTopo([N], [False])
+        x = dc.from_ranks([np.zeros(2, np.float32)] * N)
+        with pytest.raises(ValueError, match="periodic"):
+            dc.neighbor_allgather_cart(x, topo)

@@ -342,6 +342,9 @@ def test_ft_kill_mid_transfer(mode, native):
     import subprocess
     import sys
 
+    from ompi_tpu import native as native_mod
+    if native == "1" and not native_mod.available():
+        pytest.skip("native toolchain unavailable")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
@@ -357,10 +360,6 @@ def test_ft_kill_mid_transfer(mode, native):
     out = proc.stdout + proc.stderr
     # the engine under test must actually be the one requested (a silent
     # fallback would leave the C++ paths uncovered with a green result)
-    from ompi_tpu import native as native_mod
-    if native == "1" and not native_mod.available():
-        import pytest as _pytest
-        _pytest.skip("native toolchain unavailable")
     want = "ENGINE NativeP2P" if native == "1" else "ENGINE P2P"
     assert want in out, out
     # frag_rx is deterministic (corpse exists before the send); cma_tx

@@ -206,7 +206,10 @@ def test_p2p_soak_native_on_off(native):
                 sreq.wait(timeout=60)
                 assert st.source == left
                 got = buf[: st.count // 8]
-                assert got[0] >= 0 and got.size >= 1
+                # exact-content check: a torn/reordered multi-fragment
+                # reassembly must FAIL the soak, not slip through
+                np.testing.assert_array_equal(
+                    got, np.arange(st.count // 8, dtype=np.float64) + it)
                 if it % 10 == 0:
                     c.barrier()
             c.barrier()
