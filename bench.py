@@ -125,7 +125,10 @@ def _peak_tflops(device) -> tuple:
 def run_flagship(platform: str) -> dict:
     """One flagship train step, steady state. On the cpu fallback a scaled-
     down config keeps the phase fast and proves the harness; MFU is only
-    claimed on a real accelerator."""
+    claimed on a real accelerator. On accel, an A/B block additionally
+    measures flash-attention off and the remat alternatives AT THE
+    FLAGSHIP'S OWN SHAPE (round-3 verdict items 1/9: the staircase the
+    tuning decisions rest on), at the batch the main run settled on."""
     import jax
     import jax.numpy as jnp
 
@@ -142,28 +145,16 @@ def run_flagship(platform: str) -> dict:
             vocab=2048, d_model=256, n_layers=2, n_heads=4, head_dim=64,
             d_ff=1024, seq=256, attn="flash", remat="dots")
         try:
-            params = init_params(jax.random.key(0), cfg)
-            init_opt, step = make_train_step(cfg)
-            opt_state = init_opt(params)
-            toks = [jnp.asarray(rng.integers(0, cfg.vocab,
-                                             (batch, cfg.seq + 1)), jnp.int32)
-                    for _ in range(4)]
-            # warmup: compile + first donation cycle
-            for k in range(2):
-                params, opt_state, loss = step(params, opt_state, toks[k])
-            float(jax.device_get(loss))          # sync before timing
             reps = 10 if on_accel else 3
-            t0 = time.perf_counter()
-            for k in range(reps):
-                params, opt_state, loss = step(params, opt_state,
-                                               toks[k % len(toks)])
-            final = float(jax.device_get(loss))  # device-value read barrier
-            dt = (time.perf_counter() - t0) / reps
-            tokens_per_s = batch * cfg.seq / dt
+            dt, tokens_per_s, n_params, final = _measure_steps(
+                cfg, batch, rng, reps=reps)
             fpt = train_flops_per_token(cfg)
             tf_s = tokens_per_s * fpt / 1e12
             peak, peak_src = _peak_tflops(jax.devices()[0])
-            n_params = sum(x.size for x in jax.tree.leaves(params))
+            # A/B runs AFTER the main run's params/optimizer are freed
+            # (inside _measure_steps) — each variant must see the same
+            # clean-HBM conditions as the baseline it is compared against
+            ab = _flagship_ab(cfg, batch, rng) if on_accel else None
             return {
                 "platform": platform,
                 "config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
@@ -180,18 +171,80 @@ def run_flagship(platform: str) -> dict:
                 "peak_source": peak_src,
                 "mfu": round(tf_s / peak, 4) if on_accel else None,
                 "loss_finite": bool(np.isfinite(final)),
+                "ab": ab,
                 "methodology": "chained donated steps (no cacheable "
                                "repeats), device-value read barrier, "
                                "counted model FLOPs only",
             }
         except Exception as exc:           # OOM at this batch → shrink
             last_err = exc
-            # drop this generation's ~GBs of params/optimizer before the
-            # smaller-batch retry allocates its own
-            params = opt_state = toks = loss = step = init_opt = None
             continue
     return {"platform": platform, "error": f"{type(last_err).__name__}: "
                                            f"{last_err}"}
+
+
+def _measure_steps(cfg, batch: int, rng, reps: int):
+    """ONE copy of the chained-donated-steps timing discipline, shared by
+    the main flagship run and every A/B variant: init, 2 warmup steps
+    (compile + donation cycle), `reps` timed chained steps, device-value
+    read barrier. Everything allocated here (params, optimizer, compiled
+    step) is dropped before return, so successive calls see clean HBM.
+    Returns (seconds_per_step, tokens_per_s, n_params, final_loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.models.transformer import init_params, make_train_step
+
+    params = opt_state = step = toks = loss = None
+    try:
+        params = init_params(jax.random.key(0), cfg)
+        init_opt, step = make_train_step(cfg)
+        opt_state = init_opt(params)
+        toks = [jnp.asarray(rng.integers(0, cfg.vocab,
+                                         (batch, cfg.seq + 1)), jnp.int32)
+                for _ in range(4)]
+        for k in range(2):
+            params, opt_state, loss = step(params, opt_state, toks[k])
+        float(jax.device_get(loss))            # sync before timing
+        t0 = time.perf_counter()
+        for k in range(reps):
+            params, opt_state, loss = step(params, opt_state,
+                                           toks[k % len(toks)])
+        final = float(jax.device_get(loss))    # device-value read barrier
+        dt = (time.perf_counter() - t0) / reps
+        n_params = sum(x.size for x in jax.tree.leaves(params))
+        return dt, batch * cfg.seq / dt, n_params, final
+    finally:
+        params = opt_state = step = toks = loss = None
+
+
+def _flagship_ab(base_cfg, batch: int, rng) -> list:
+    """Flash on/off and remat-policy A/B at the flagship's own shape,
+    through the SAME _measure_steps discipline as the baseline row;
+    OOM/compile failures are recorded, never silently dropped."""
+    from ompi_tpu.models.transformer import Config, train_flops_per_token
+
+    variants = [("attn=dense (flash OFF)", {"attn": "dense"}),
+                ("remat=none", {"remat": "none"}),
+                ("remat=full", {"remat": "full"})]
+    out = []
+    for label, delta in variants:
+        cfg = Config(**{**base_cfg.__dict__, **delta})
+        try:
+            dt, tokens_per_s, _n, _loss = _measure_steps(
+                cfg, batch, rng, reps=6)
+            out.append({"variant": label, "step_ms": round(dt * 1e3, 2),
+                        "tokens_per_s": round(tokens_per_s, 0),
+                        "tf_per_s": round(
+                            tokens_per_s * train_flops_per_token(cfg)
+                            / 1e12, 1)})
+        except Exception as exc:
+            # first line only, pipes escaped: this string lands in a
+            # markdown table cell (update_baseline_md)
+            msg = f"{type(exc).__name__}: {exc}".splitlines()[0]
+            out.append({"variant": label,
+                        "error": msg.replace("|", "\\|")[:200]})
+    return out
 
 
 def run_sweep(platform: str) -> dict:
@@ -503,6 +556,20 @@ def update_baseline_md(sweep: dict) -> None:
             f"Methodology: {flagship['methodology']}.",
             "",
         ]
+        if flagship.get("ab"):
+            lines += ["A/B at the flagship's own shape (same batch, "
+                      "chained donated steps):",
+                      "",
+                      "| variant | step ms | tokens/s | TF/s |",
+                      "|---|---|---|---|"]
+            for v in flagship["ab"]:
+                if "error" in v:
+                    lines.append(f"| {v['variant']} | *{v['error']}* | | |")
+                else:
+                    lines.append(
+                        f"| {v['variant']} | {v['step_ms']} | "
+                        f"{v['tokens_per_s']:.0f} | {v['tf_per_s']} |")
+            lines.append("")
     lines += [
         "Device-native (coll/xla) vs host-staging shim "
         "(`coll_accelerator_allreduce.c:31-60` design):",

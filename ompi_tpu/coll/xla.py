@@ -282,9 +282,23 @@ class XlaModule(CollModule):
                 and all(topo.periods) and self._rows_ok(x, need_ndim)
                 and topo.size == x.shape[0] == self.dc.n)
 
+    def _reject_canonical_noncart(self, comm, sendbuf) -> None:
+        """In the single-controller regime (comm size 1, mesh of R) a
+        canonical (R, ...) device layout that misses the cart gate cannot
+        take the host path — basic.neighbor_* would irecv from phantom
+        ranks of a size-1 comm and hang. Fail loudly. Multi-rank comms
+        with per-rank buffers keep the working host path."""
+        if comm.size == 1 and self._rows_ok(sendbuf, 2):
+            raise ValueError(
+                "device-canonical neighborhood exchange requires a fully "
+                "periodic cartesian topology matching the mesh "
+                "(graph/non-periodic topologies are host-path only, with "
+                "per-rank buffers and real rank processes)")
+
     def neighbor_allgather(self, comm, sendbuf, recvbuf=None):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 2):
             return self.dc.neighbor_allgather_cart(sendbuf, comm.topo)
+        self._reject_canonical_noncart(comm, sendbuf)
         return self.host.basic.neighbor_allgather(
             comm, self._to_host(sendbuf), recvbuf)
 
@@ -292,6 +306,7 @@ class XlaModule(CollModule):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 3) \
                 and sendbuf.shape[1] == 2 * len(comm.topo.dims):
             return self.dc.neighbor_alltoall_cart(sendbuf, comm.topo)
+        self._reject_canonical_noncart(comm, sendbuf)
         return self.host.basic.neighbor_alltoall(
             comm, self._to_host(sendbuf), recvbuf)
 

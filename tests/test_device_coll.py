@@ -598,3 +598,21 @@ class TestDeviceCartNeighbor:
         x = dc.from_ranks([np.zeros(2, np.float32)] * N)
         with pytest.raises(ValueError, match="periodic"):
             dc.neighbor_allgather_cart(x, topo)
+
+    def test_canonical_noncart_raises_not_hangs(self):
+        """Single-controller canonical layout + non-periodic topology:
+        the host path cannot express it (phantom recvs on a size-1 comm)
+        — must raise, not hang."""
+        def fn(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import CartTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            c.topo = CartTopo([4], [False])        # non-periodic
+            x = c.device_comm.from_ranks(
+                [np.zeros(2, np.float32)] * 4)
+            with pytest.raises(ValueError, match="periodic"):
+                c.coll.neighbor_allgather(c, x)
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
