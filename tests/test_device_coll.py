@@ -502,6 +502,26 @@ class TestDeviceDecision:
             var.registry.set_cli("coll_xla_dynamic_rules", "")
             var.registry.reset_cache()
 
+    def test_accelerator_platform_always_native(self):
+        """On a non-cpu platform the fixed default is native for EVERY
+        entry (staging crosses the host bridge); checked by patching the
+        platform probe — the rule the TPU run exercises for real."""
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": N}), "x")
+            mod = c.coll._entries["alltoall"]
+            assert type(mod).__name__ == "XlaModule"
+            mod._platform = "tpu"           # simulate the real chip
+            x = c.device_comm.from_ranks(
+                [np.stack([np.full(2, 1.0, np.float32)] * N)] * N)
+            before = ctx.spc._v.get("coll_staged_fallbacks", 0)
+            out = c.coll.alltoall(c, x)     # cpu default would stage this
+            assert ctx.spc._v.get("coll_staged_fallbacks", 0) == before
+            assert isinstance(out, jax.Array)
+            return True
+
+        assert self._run(fn)
+
     def test_coll_tune_emits_device_rules(self, tmp_path):
         from ompi_tpu.tools import coll_tune
 
