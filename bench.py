@@ -226,7 +226,8 @@ def _flagship_ab(base_cfg, batch: int, rng) -> list:
 
     variants = [("attn=dense (flash OFF)", {"attn": "dense"}),
                 ("remat=none", {"remat": "none"}),
-                ("remat=full", {"remat": "full"})]
+                ("remat=full", {"remat": "full"}),
+                ("adam mu=bf16", {"opt_moment_dtype": "bfloat16"})]
     out = []
     for label, delta in variants:
         cfg = Config(**{**base_cfg.__dict__, **delta})

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def cg_solver(mesh: Mesh, n: int, iters: int):
@@ -51,7 +51,7 @@ def cg_solver(mesh: Mesh, n: int, iters: int):
         return lax.psum(jnp.vdot(a, b), axis)
 
     @functools.partial(shard_map, mesh=mesh, in_specs=P(axis),
-                       out_specs=(P(axis), P()), check_rep=False)
+                       out_specs=(P(axis), P()), check_vma=False)
     def solve(b):
         x = jnp.zeros_like(b)
         r = b

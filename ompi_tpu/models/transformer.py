@@ -49,6 +49,10 @@ class Config:
     #   make_train_step: "full" recomputes each layer in the backward
     #   (cheapest memory, +~1 forward of FLOPs), "dots" saves matmul
     #   outputs and recomputes only elementwise ops (MXU work unchanged)
+    opt_moment_dtype: str = "float32"  # Adam first-moment dtype; "bfloat16"
+    #   halves the mu buffer's HBM (the MFU lever VERDICT r3 item 9 names:
+    #   less optimizer traffic on an HBM-bound chip). Second moment stays
+    #   fp32 — bf16's 8-bit mantissa loses v's small-magnitude accumulation
 
 
 def flagship_config(seq: int = 2048) -> Config:
@@ -262,7 +266,8 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
     data batch is dp-sharded and gradients allreduce over dp automatically."""
     import optax
 
-    tx = optax.adamw(learning_rate)
+    tx = optax.adamw(learning_rate,
+                     mu_dtype=jnp.dtype(cfg.opt_moment_dtype))
 
     def init_opt(params):
         return tx.init(params)

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 
 def stack_stage_params(layer_params: list, n_stages: int):
@@ -91,7 +91,7 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(par_spec, mb_spec),
-        out_specs=mb_spec, check_rep=False)
+        out_specs=mb_spec, check_vma=False)
     def run(params, mbs):
         # params leaves: (1, L/P, ...) — my stage's slice; mbs: (M, mb, ...)
         my = jax.tree.map(lambda x: x[0], params)

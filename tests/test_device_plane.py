@@ -363,3 +363,33 @@ class TestDeviceChannel:
             return True
 
         assert all(runtime.run_ranks(2, fn))
+
+    def test_exchange_table_empty_after_traffic(self):
+        """The device channel's parked-array table must not leak: every
+        offer is claimed by its matching recv (strong refs released)."""
+        import jax
+        import jax.numpy as jnp
+        from ompi_tpu import accelerator, runtime
+        from ompi_tpu.p2p import devchan
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        def fn(ctx):
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 2}, devices=jax.devices()[:2])
+            attach_mesh(c, mesh, "x")
+            for i in range(20):
+                if ctx.rank == 0:
+                    c.send(jnp.full(64, float(i)), 1, tag=4)
+                else:
+                    buf = accelerator.DeviceBuffer(jnp.zeros(64))
+                    r = c.irecv(buf, 0, tag=4)
+                    r.wait()
+            c.barrier()
+            # measure BEFORE finalize (whose unregister would sweep the
+            # job's entries and mask a recv-side leak), scoped to THIS job
+            mine = [k for k in devchan._table
+                    if k[0] == ctx.bootstrap.job_id]
+            return mine
+
+        residue = runtime.run_ranks(2, fn)
+        assert all(r == [] for r in residue), residue

@@ -110,3 +110,30 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert np.isfinite(np.asarray(jax.device_get(out))).all()
     g.dryrun_multichip(8)
+
+
+def test_bf16_adam_moments_train():
+    """opt_moment_dtype='bfloat16' (the HBM lever for the MFU staircase):
+    loss must still DECREASE over a few steps and the mu buffers must
+    actually be bf16."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ompi_tpu.models.transformer import (Config, init_params,
+                                             make_train_step)
+
+    cfg = Config(vocab=64, d_model=32, n_layers=2, n_heads=4, head_dim=8,
+                 d_ff=64, seq=16, opt_moment_dtype="bfloat16")
+    params = init_params(jax.random.key(0), cfg)
+    init_opt, step = make_train_step(cfg)
+    opt = init_opt(params)
+    mu_leaves = jax.tree.leaves(opt[0].mu)
+    assert all(x.dtype == jnp.bfloat16 for x in mu_leaves)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (4, cfg.seq + 1)),
+                       jnp.int32)
+    losses = []
+    for _ in range(8):
+        params, opt, loss = step(params, opt, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
