@@ -283,21 +283,30 @@ class XlaModule(CollModule):
                 and topo.size == x.shape[0] == self.dc.n)
 
     def _reject_canonical_noncart(self, comm, sendbuf) -> None:
-        """In the single-controller regime (comm size 1, mesh of R) a
-        canonical (R, ...) device layout that misses the cart gate cannot
-        take the host path — basic.neighbor_* would irecv from phantom
-        ranks of a size-1 comm and hang. Fail loudly. Multi-rank comms
-        with per-rank buffers keep the working host path."""
+        """In the single-controller regime (comm size 1, mesh of R) ANY
+        canonical (R·k, ...) device layout that found no device path must
+        not reach the host path — basic.neighbor_* would irecv from
+        phantom ranks of a size-1 comm and hang. Fail loudly. Multi-rank
+        comms with per-rank buffers keep the working host path."""
         if comm.size == 1 and self._rows_ok(sendbuf, 2):
             raise ValueError(
-                "device-canonical neighborhood exchange requires a fully "
-                "periodic cartesian topology matching the mesh "
-                "(graph/non-periodic topologies are host-path only, with "
-                "per-rank buffers and real rank processes)")
+                "no device path for this neighborhood exchange (needs a "
+                "periodic cart — or cart/graph for allgather — matching "
+                "the mesh, default recvbuf, and rank-per-position rows); "
+                "the host path cannot express a canonical device layout "
+                "on a single-controller comm")
 
     def neighbor_allgather(self, comm, sendbuf, recvbuf=None):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 2):
             return self.dc.neighbor_allgather_cart(sendbuf, comm.topo)
+        topo = getattr(comm, "topo", None)
+        if (recvbuf is None and topo is not None
+                and getattr(topo, "kind", "") in ("cart", "graph")
+                and self._rows_ok(sendbuf, 2)
+                and sendbuf.shape[0] == self.dc.n):
+            # arbitrary graphs / non-periodic carts: all_gather + masked
+            # gather-map (padded to max degree; zeros past each degree)
+            return self.dc.neighbor_allgather_graph(sendbuf, topo)
         self._reject_canonical_noncart(comm, sendbuf)
         return self.host.basic.neighbor_allgather(
             comm, self._to_host(sendbuf), recvbuf)

@@ -619,19 +619,71 @@ class TestDeviceCartNeighbor:
         with pytest.raises(ValueError, match="periodic"):
             dc.neighbor_allgather_cart(x, topo)
 
-    def test_canonical_noncart_raises_not_hangs(self):
-        """Single-controller canonical layout + non-periodic topology:
-        the host path cannot express it (phantom recvs on a size-1 comm)
-        — must raise, not hang."""
+    def test_nonperiodic_cart_takes_graph_path(self):
+        """Non-periodic carts route through the general graph exchange:
+        boundary ranks get zero-padded slots past their (ragged) degree."""
         def fn(ctx):
             c = ctx.comm_world
             from ompi_tpu.topo import CartTopo
             mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
             attach_mesh(c, mesh, "x")
-            c.topo = CartTopo([4], [False])        # non-periodic
+            c.topo = CartTopo([4], [False])        # open chain
+            x = c.device_comm.from_ranks(
+                [np.full(2, float(i), np.float32) for i in range(4)])
+            out = c.coll.neighbor_allgather(c, x)
+            rows = np.asarray(jax.device_get(out))
+            for i in range(4):
+                nbrs = c.topo.neighbors(i)         # ragged at boundaries
+                for j, nb in enumerate(nbrs):
+                    np.testing.assert_allclose(rows[i, j],
+                                               np.full(2, float(nb)))
+                for j in range(len(nbrs), rows.shape[1]):
+                    np.testing.assert_allclose(rows[i, j], 0.0)
+            # canonical neighbor_alltoall still has no graph device path
+            blocks = c.device_comm.from_ranks(
+                [np.zeros((2, 2), np.float32)] * 4)
+            with pytest.raises(ValueError, match="periodic"):
+                c.coll.neighbor_alltoall(c, blocks)
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
+
+    def test_graph_topology_device_exchange(self):
+        """Arbitrary GraphTopo on the device path (the generality of
+        coll_basic_neighbor_allgather.c, compiled)."""
+        def fn(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import GraphTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            # 0-1, 0-2, 1-3: degrees 2/2/1/1 (ragged)
+            c.topo = GraphTopo(index=[2, 4, 5, 6],
+                               edges=[1, 2, 0, 3, 0, 1])
+            x = c.device_comm.from_ranks(
+                [np.full(3, 10.0 * i, np.float32) for i in range(4)])
+            out = c.coll.neighbor_allgather(c, x)
+            rows = np.asarray(jax.device_get(out))
+            for i in range(4):
+                for j, nb in enumerate(c.topo.neighbors(i)):
+                    np.testing.assert_allclose(rows[i, j],
+                                               np.full(3, 10.0 * nb))
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
+
+    def test_unservable_canonical_raises_not_hangs(self):
+        """A canonical layout with NO device path (dist_graph topo) must
+        raise — the host path would block forever on phantom recvs of a
+        size-1 comm (the guard the graph path does not replace)."""
+        def fn(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import DistGraphTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            c.topo = DistGraphTopo(sources=[1], destinations=[2])
             x = c.device_comm.from_ranks(
                 [np.zeros(2, np.float32)] * 4)
-            with pytest.raises(ValueError, match="periodic"):
+            with pytest.raises(ValueError, match="no device path"):
                 c.coll.neighbor_allgather(c, x)
             return True
 
