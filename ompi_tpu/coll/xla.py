@@ -291,10 +291,10 @@ class XlaModule(CollModule):
         if comm.size == 1 and self._rows_ok(sendbuf, 2):
             raise ValueError(
                 "no device path for this neighborhood exchange (needs a "
-                "periodic cart — or cart/graph for allgather — matching "
-                "the mesh, default recvbuf, and rank-per-position rows); "
-                "the host path cannot express a canonical device layout "
-                "on a single-controller comm")
+                "cart or graph topology matching the mesh, default "
+                "recvbuf, and rank-per-position rows); the host path "
+                "cannot express a canonical device layout on a "
+                "single-controller comm")
 
     def neighbor_allgather(self, comm, sendbuf, recvbuf=None):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 2):
@@ -315,6 +315,14 @@ class XlaModule(CollModule):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 3) \
                 and sendbuf.shape[1] == 2 * len(comm.topo.dims):
             return self.dc.neighbor_alltoall_cart(sendbuf, comm.topo)
+        topo = getattr(comm, "topo", None)
+        if (recvbuf is None and topo is not None
+                and getattr(topo, "kind", "") in ("cart", "graph")
+                and self._rows_ok(sendbuf, 3)
+                and sendbuf.shape[0] == self.dc.n):
+            # ragged degrees (graphs, open carts): row-scatter +
+            # alltoallv + slot reorder (DeviceComm graph section)
+            return self.dc.neighbor_alltoall_graph(sendbuf, topo)
         self._reject_canonical_noncart(comm, sendbuf)
         return self.host.basic.neighbor_alltoall(
             comm, self._to_host(sendbuf), recvbuf)

@@ -522,6 +522,74 @@ class DeviceComm:
 
         return self._compiled(key, build)(x, idx_dev)
 
+    def neighbor_alltoall_graph(self, x: jax.Array, topo) -> jax.Array:
+        """General-topology neighborhood alltoall: x (R, outdeg_max, b,
+        *e) — block p of rank i goes to its p-th OUT-neighbor — →
+        (R, indeg_max, b, *e), slot k of rank j from its k-th
+        IN-neighbor (zeros past each rank's degree). Composed from the
+        existing primitives: a per-row scatter onto destination ranks
+        (row_gather), the dense-block ragged alltoallv, and a per-row
+        reorder into in-neighbor slot order. Maps are memoized on the
+        immutable topology per block size."""
+        R = x.shape[0]
+        if R != self.n or getattr(topo, "size", R) != R:
+            raise ValueError(
+                f"graph exchange needs rank-per-position layout (rows "
+                f"{R} == mesh {self.n} == topo size)")
+        K, b = x.shape[1], x.shape[2]
+        elem = x.shape[3:]
+        memo = getattr(topo, "_dc_a2a_maps", None)
+        if memo is None or memo[0] != (K, b):
+            outs = [list(topo.out_neighbors(i)) for i in range(R)]
+            ins = [list(topo.in_neighbors(i)) for i in range(R)]
+            if max((len(o) for o in outs), default=0) > K:
+                raise ValueError(
+                    f"block dim {K} < max out-degree "
+                    f"{max(len(o) for o in outs)}")
+            for o in outs:
+                if len(set(o)) != len(o):
+                    raise ValueError("repeated edges are not supported "
+                                     "on the device graph path")
+            # dst_map[i, j] = position of dst j in i's out-list (else -1)
+            dst_map = np.full((R, R), -1, np.int32)
+            for i, o in enumerate(outs):
+                for p, j in enumerate(o):
+                    dst_map[i, j] = p
+            C = np.zeros((R, R), np.int64)     # elements i → j
+            for i, o in enumerate(outs):
+                for j in o:
+                    C[i, j] = b
+            # receiver: alltoallv concatenates by ASCENDING source; slot
+            # k must hold in_neighbors[k] — element-level reorder map
+            indeg_max = max((len(s) for s in ins), default=0)
+            rd = np.full((R, indeg_max * b), -1, np.int32) \
+                if indeg_max else np.zeros((R, 0), np.int32)
+            for j, srcs in enumerate(ins):
+                ordered = sorted(srcs)
+                for k, s in enumerate(srcs):
+                    pos = ordered.index(s)
+                    rd[j, k * b:(k + 1) * b] = pos * b + np.arange(b)
+            topo._dc_a2a_maps = memo = ((K, b), dst_map, C, rd, indeg_max)
+        _kb, dst_map, C, rd, indeg_max = memo
+        if indeg_max == 0:
+            return jnp.zeros((R, 0, b) + elem, x.dtype)
+        # static topology → the two device maps upload ONCE (LRU cache),
+        # not per halo step like row_gather's per-call EP-routing form
+        dst_dev = self._idx_cached(
+            ("ga2a_dst", dst_map.tobytes()),
+            lambda: jax.device_put(jnp.asarray(dst_map), self.sharding()))
+        rd_dev = self._idx_cached(
+            ("ga2a_rd", rd.tobytes()),
+            lambda: jax.device_put(jnp.asarray(rd), self.sharding()))
+        flat_blocks = x.reshape(R, K, -1)
+        by_dst = self._row_gather_dev(flat_blocks, dst_dev,
+                                      dst_map.shape[1])  # (R, R, b·e)
+        blocks = by_dst.reshape((R, R, b) + elem)
+        recv, _tot = self.alltoallv(blocks, C)           # (R, out_cap, *e)
+        slot_elems = self._row_gather_dev(recv, rd_dev,
+                                          rd.shape[1])   # (R, indeg·b, *e)
+        return slot_elems.reshape((R, indeg_max, b) + elem)
+
     def push_row(self, x: jax.Array, src: int, dst: int) -> jax.Array:
         """ICI p2p: (R, *e) → (R, *e) with row dst ← row src's data, other
         rows unchanged — the one-hop collective-permute program behind
@@ -804,14 +872,10 @@ class DeviceComm:
         out = self._compiled(key, build)(x, idx_dev)
         return out, [int(t) for t in recv_tot]
 
-    def row_gather(self, x: jax.Array, idx: np.ndarray) -> jax.Array:
-        """Per-row device gather: (R, T, *e) + host map idx (R, M) →
-        (R, M, *e), out[i, m] = x[i, idx[i, m]] (idx −1 → zeros). The map
-        travels as a sharded device argument, so one executable per
-        (shape, M, dtype) serves every permutation — the building block the
-        ragged EP pipeline uses to form/unform alltoallv blocks."""
-        idx = np.asarray(idx, np.int32)
-        key = ("row_gather", x.shape, idx.shape[1], str(x.dtype))
+    def _row_gather_dev(self, x: jax.Array, idx_dev, m: int) -> jax.Array:
+        """row_gather against an ALREADY-device-resident (R, m) map —
+        the zero-upload form static-topology callers use."""
+        key = ("row_gather", x.shape, m, str(x.dtype))
 
         def build():
             def inner(xs, idxs):     # (r, T, *e), (r, M)
@@ -824,8 +888,20 @@ class DeviceComm:
             return self._shard_map(inner, (self._spec, self._spec),
                                    self._spec)
 
-        return self._compiled(key, build)(
-            x, jax.device_put(jnp.asarray(idx), self.sharding()))
+        return self._compiled(key, build)(x, idx_dev)
+
+    def row_gather(self, x: jax.Array, idx: np.ndarray) -> jax.Array:
+        """Per-row device gather: (R, T, *e) + host map idx (R, M) →
+        (R, M, *e), out[i, m] = x[i, idx[i, m]] (idx −1 → zeros). The map
+        travels as a sharded device argument, so one executable per
+        (shape, M, dtype) serves every permutation — the building block the
+        ragged EP pipeline uses to form/unform alltoallv blocks. The map
+        uploads per call (EP routing changes every step); static-topology
+        callers cache the device map and use _row_gather_dev."""
+        idx = np.asarray(idx, np.int32)
+        return self._row_gather_dev(
+            x, jax.device_put(jnp.asarray(idx), self.sharding()),
+            idx.shape[1])
 
     def reduce_scatter_v(self, x: jax.Array, counts: Sequence[int],
                          op: Op = SUM) -> jax.Array:

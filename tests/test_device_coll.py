@@ -639,11 +639,6 @@ class TestDeviceCartNeighbor:
                                                np.full(2, float(nb)))
                 for j in range(len(nbrs), rows.shape[1]):
                     np.testing.assert_allclose(rows[i, j], 0.0)
-            # canonical neighbor_alltoall still has no graph device path
-            blocks = c.device_comm.from_ranks(
-                [np.zeros((2, 2), np.float32)] * 4)
-            with pytest.raises(ValueError, match="periodic"):
-                c.coll.neighbor_alltoall(c, blocks)
             return True
 
         assert runtime.run_ranks(1, fn)[0]
@@ -685,6 +680,64 @@ class TestDeviceCartNeighbor:
                 [np.zeros(2, np.float32)] * 4)
             with pytest.raises(ValueError, match="no device path"):
                 c.coll.neighbor_allgather(c, x)
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
+
+    def test_graph_neighbor_alltoall(self):
+        """Directed ragged exchange: block p of rank i reaches its p-th
+        out-neighbor, landing in the receiver's in-neighbor slot order."""
+        def fn(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import GraphTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            # undirected edges 0-1, 0-3, 1-2 (degrees 2/2/1/1)
+            c.topo = GraphTopo(index=[2, 4, 5, 6],
+                               edges=[1, 3, 0, 2, 1, 0])
+            K, b = 2, 3
+            # block p of rank i carries value 100*i + 10*p
+            x = c.device_comm.from_ranks([
+                np.stack([np.full(b, 100.0 * i + 10 * p, np.float32)
+                          for p in range(K)]) for i in range(4)])
+            out = c.coll.neighbor_alltoall(c, x)
+            rows = np.asarray(jax.device_get(out))
+            for j in range(4):
+                nbrs = c.topo.in_neighbors(j)
+                for k, src in enumerate(nbrs):
+                    # src's block addressed to j = position of j in src's
+                    # out-list
+                    p = c.topo.out_neighbors(src).index(j)
+                    np.testing.assert_allclose(
+                        rows[j, k], np.full(b, 100.0 * src + 10 * p),
+                        err_msg=f"dst {j} slot {k} (src {src})")
+                for k in range(len(nbrs), rows.shape[1]):
+                    # the documented contract: zeros past each in-degree
+                    np.testing.assert_allclose(rows[j, k], 0.0)
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
+
+    def test_open_cart_neighbor_alltoall_via_graph_path(self):
+        """Non-periodic cart alltoall rides the graph machinery: boundary
+        ranks have fewer blocks (ragged), interior ranks exchange fully."""
+        def fn(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import CartTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            c.topo = CartTopo([4], [False])
+            K, b = 2, 2
+            x = c.device_comm.from_ranks([
+                np.stack([np.full(b, 10.0 * i + p, np.float32)
+                          for p in range(K)]) for i in range(4)])
+            out = c.coll.neighbor_alltoall(c, x)
+            rows = np.asarray(jax.device_get(out))
+            for j in range(4):
+                for k, src in enumerate(c.topo.in_neighbors(j)):
+                    p = c.topo.out_neighbors(src).index(j)
+                    np.testing.assert_allclose(
+                        rows[j, k], np.full(b, 10.0 * src + p))
             return True
 
         assert runtime.run_ranks(1, fn)[0]
