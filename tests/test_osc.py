@@ -637,3 +637,76 @@ class TestDeviceWindowPassiveTarget:
             win.unlock(1)
         assert len(win._cache) == 1
         win.free()
+
+
+def test_device_window_passive_storm():
+    """Mixed shared/exclusive passive-target storm from 6 threads against
+    one HBM window: exclusive read-modify-write counters on two target
+    ranks interleaved with shared readers and lock_all sweeps. Invariant:
+    per-target totals equal the increments applied (the arbiter never
+    lets RMWs interleave), and readers only ever observe monotonically
+    consistent snapshots."""
+    import threading
+    import numpy as np
+    import pytest
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from ompi_tpu.osc import win_allocate_device
+    from ompi_tpu.osc.device import LOCK_SHARED
+    from ompi_tpu.parallel import make_mesh
+
+    win = win_allocate_device(make_mesh({"x": 8}), (1,), axis="x",
+                              dtype=jnp.float32)
+    errs = []
+
+    def incrementer(target, rounds):
+        try:
+            for _ in range(rounds):
+                win.lock(target)
+                h = win.get(target, count=1)
+                win.flush(target)
+                win.put(target, np.asarray(h.value) + 1.0)
+                win.unlock(target)
+        except Exception as exc:      # pragma: no cover
+            errs.append(exc)
+
+    def reader(target, rounds):
+        try:
+            last = -1.0
+            for _ in range(rounds):
+                win.lock(target, LOCK_SHARED)
+                h = win.get(target, count=1)
+                win.flush(target)
+                win.unlock(target)
+                v = float(np.asarray(h.value)[0])
+                assert v >= last, (v, last)   # counters only grow
+                last = v
+        except Exception as exc:      # pragma: no cover
+            errs.append(exc)
+
+    def sweeper(rounds):
+        try:
+            for _ in range(rounds):
+                win.lock_all(LOCK_SHARED)
+                hs = [win.get(t, count=1) for t in (0, 5)]
+                win.flush_all()
+                win.unlock_all()
+                for h in hs:
+                    assert float(np.asarray(h.value)[0]) >= 0.0
+        except Exception as exc:      # pragma: no cover
+            errs.append(exc)
+
+    ts = ([threading.Thread(target=incrementer, args=(0, 15))
+           for _ in range(2)]
+          + [threading.Thread(target=incrementer, args=(5, 15))
+             for _ in range(2)]
+          + [threading.Thread(target=reader, args=(0, 10))]
+          + [threading.Thread(target=sweeper, args=(8,))])
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(180)
+    assert not errs, errs
+    assert float(np.asarray(win.rank_slice(0))[0]) == 30.0
+    assert float(np.asarray(win.rank_slice(5))[0]) == 30.0
+    win.free()
