@@ -337,6 +337,12 @@ class BasicModule(CollModule):
         sendbuf = np.asarray(sendbuf)
         if displs is None:
             displs = list(np.concatenate([[0], np.cumsum(counts)[:-1]]))
+        if recvbuf is None:
+            # same allocate-on-None contract as neighbor_allgather: size
+            # by the furthest write (user displs may leave gaps)
+            total = max((int(d) + int(c) for d, c in zip(displs, counts)),
+                        default=0)
+            recvbuf = np.empty(total, sendbuf.dtype)
         flat = recvbuf.reshape(-1)
         reqs = [comm.irecv(flat[displs[i]:displs[i] + counts[i]], src, T_NEIGHBOR)
                 for i, src in enumerate(indeg)]

@@ -299,30 +299,52 @@ class XlaModule(CollModule):
     def neighbor_allgather(self, comm, sendbuf, recvbuf=None):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 2):
             return self.dc.neighbor_allgather_cart(sendbuf, comm.topo)
-        topo = getattr(comm, "topo", None)
-        if (recvbuf is None and topo is not None
-                and getattr(topo, "kind", "") in ("cart", "graph")
-                and self._rows_ok(sendbuf, 2)
-                and sendbuf.shape[0] == self.dc.n):
+        if recvbuf is None and self._graph_ok(comm, sendbuf, 2):
             # arbitrary graphs / non-periodic carts: all_gather + masked
             # gather-map (padded to max degree; zeros past each degree)
-            return self.dc.neighbor_allgather_graph(sendbuf, topo)
+            return self.dc.neighbor_allgather_graph(sendbuf, comm.topo)
         self._reject_canonical_noncart(comm, sendbuf)
         return self.host.basic.neighbor_allgather(
             comm, self._to_host(sendbuf), recvbuf)
+
+    def _graph_ok(self, comm, x, need_ndim: int) -> bool:
+        """The graph-path gate shared by the neighbor_* entries: cart or
+        graph topology, canonical layout, rank-per-position rows."""
+        topo = getattr(comm, "topo", None)
+        return (topo is not None
+                and getattr(topo, "kind", "") in ("cart", "graph")
+                and self._rows_ok(x, need_ndim)
+                and x.shape[0] == self.dc.n)
+
+    def neighbor_allgatherv(self, comm, sendbuf, recvbuf=None, counts=None,
+                            displs=None):
+        """Ragged neighborhood allgather. COUNTS CONTRACT DIFFERS BY
+        REGIME (the same canonical-vs-per-rank split as allgatherv):
+        canonical device layout (R, cap, *e) takes PER-GLOBAL-RANK counts
+        (length R) and returns (R, maxdeg, cap, *e) padded slots — slice
+        slot k of row j by counts[in_neighbors(j)[k]]; the per-rank host
+        path keeps MPI's per-in-neighbor counts/displs contract."""
+        if (counts is not None and displs is None and recvbuf is None
+                and self._graph_ok(comm, sendbuf, 2)
+                and len(counts) == sendbuf.shape[0]
+                and sendbuf.shape[1] >= max(int(c) for c in counts)):
+            if self._cart_ok(comm, sendbuf, 2):
+                # torus: padded rows travel whole on the neighbor-sparse
+                # ppermute path (cart slot order == in_neighbors order)
+                return self.dc.neighbor_allgather_cart(sendbuf, comm.topo)
+            return self.dc.neighbor_allgather_graph(sendbuf, comm.topo)
+        self._reject_canonical_noncart(comm, sendbuf)
+        return self.host.basic.neighbor_allgatherv(
+            comm, self._to_host(sendbuf), recvbuf, counts, displs)
 
     def neighbor_alltoall(self, comm, sendbuf, recvbuf=None):
         if recvbuf is None and self._cart_ok(comm, sendbuf, 3) \
                 and sendbuf.shape[1] == 2 * len(comm.topo.dims):
             return self.dc.neighbor_alltoall_cart(sendbuf, comm.topo)
-        topo = getattr(comm, "topo", None)
-        if (recvbuf is None and topo is not None
-                and getattr(topo, "kind", "") in ("cart", "graph")
-                and self._rows_ok(sendbuf, 3)
-                and sendbuf.shape[0] == self.dc.n):
+        if recvbuf is None and self._graph_ok(comm, sendbuf, 3):
             # ragged degrees (graphs, open carts): row-scatter +
             # alltoallv + slot reorder (DeviceComm graph section)
-            return self.dc.neighbor_alltoall_graph(sendbuf, topo)
+            return self.dc.neighbor_alltoall_graph(sendbuf, comm.topo)
         self._reject_canonical_noncart(comm, sendbuf)
         return self.host.basic.neighbor_alltoall(
             comm, self._to_host(sendbuf), recvbuf)

@@ -840,3 +840,28 @@ def test_scan_linear_forced():
     finally:
         _force("coll_tuned_scan_algorithm", "")
         _force("coll_tuned_exscan_algorithm", "")
+
+
+def test_neighbor_allgatherv_allocates_recvbuf():
+    """recvbuf=None allocates like the non-v sibling (per-in-neighbor
+    counts, MPI contract)."""
+    import numpy as np
+    from ompi_tpu import runtime
+    from ompi_tpu.topo import CartTopo
+
+    def fn(ctx):
+        c = ctx.comm_world
+        c.topo = CartTopo([4], [True])
+        mine = np.full(c.rank + 1, float(c.rank))
+        nbrs = c.topo.in_neighbors(c.rank)
+        counts = [n + 1 for n in nbrs]
+        out = c.coll.neighbor_allgatherv(c, mine, None, counts)
+        flat = np.asarray(out).reshape(-1)
+        off = 0
+        for n, cnt in zip(nbrs, counts):
+            np.testing.assert_allclose(flat[off:off + cnt],
+                                       np.full(cnt, float(n)))
+            off += cnt
+        return True
+
+    assert all(runtime.run_ranks(4, fn, timeout=90))

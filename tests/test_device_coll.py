@@ -741,3 +741,28 @@ class TestDeviceCartNeighbor:
             return True
 
         assert runtime.run_ranks(1, fn)[0]
+
+    def test_graph_neighbor_allgatherv_ragged_rows(self):
+        """Ragged per-rank contributions over the device neighborhood:
+        padded rows travel whole; valid prefixes per counts."""
+        def fn(ctx):
+            c = ctx.comm_world
+            from ompi_tpu.topo import CartTopo
+            mesh = make_mesh({"x": 4}, devices=jax.devices()[:4])
+            attach_mesh(c, mesh, "x")
+            c.topo = CartTopo([4], [True])
+            dc = c.device_comm
+            rows = [np.arange(i + 1, dtype=np.float32) + 10 * i
+                    for i in range(4)]
+            x, counts = dc.pad_ragged(rows)
+            out = c.coll.neighbor_allgatherv(c, x, counts=counts)
+            got = np.asarray(jax.device_get(out))
+            for j in range(4):
+                for k, src in enumerate(c.topo.in_neighbors(j)):
+                    valid = got[j, k, :counts[src]]
+                    np.testing.assert_allclose(valid, rows[src])
+                    np.testing.assert_allclose(
+                        got[j, k, counts[src]:], 0.0)
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
