@@ -230,8 +230,12 @@ def _partials_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_out, l_out,
     @pl.when(ki == kv_steps - 1)
     def _finish():
         o_ref[0] = acc_ref[...].astype(o_ref.dtype)
-        m_out[0] = m_ref[:, 0]
-        l_out[0] = l_ref[:, 0]
+        # m/l blocks are (1, bq, 1): TPU tiling requires the last two block
+        # dims be (8k, 128k) or equal to the array dims, so a flat (1, bq)
+        # row block is unlowerable — the trailing singleton satisfies the
+        # "equal to the array dim" arm while bq covers the sublane arm
+        m_out[0] = m_ref[:, :1]
+        l_out[0] = l_ref[:, :1]
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -277,13 +281,13 @@ def flash_attention_partials(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, bq), lambda b, qi, ki: (b, qi)),
-            pl.BlockSpec((1, bq), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, qi, ki: (b, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s_q, d), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((bh, s_q), jnp.float32, vma=vma),
-            jax.ShapeDtypeStruct((bh, s_q), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((bh, s_q, 1), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, 128), jnp.float32),
@@ -292,7 +296,7 @@ def flash_attention_partials(q: jax.Array, k: jax.Array, v: jax.Array,
         ],
         interpret=interpret,
     )(offs, q, k, v)
-    return o, m, l
+    return o, m[..., 0], l[..., 0]
 
 
 # ---------------------------------------------------------------------------
@@ -327,8 +331,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                                    # (bq, 1)
+        delta = delta_ref[0]                                # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -337,13 +341,13 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = (ki * block_k
                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        p = jnp.exp(s - lse)                                # (bq, bk)
         dv_acc[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # pᵀ·dO
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # dsᵀ·Q
@@ -378,8 +382,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0]                                    # (bq, 1)
+        delta = delta_ref[0]                                # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -388,10 +392,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = (ki * block_k
                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta) * scale
         dq_acc[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -448,9 +452,12 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
         scale = 1.0 / np.sqrt(d)
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
     dof = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d)
-    # δ_i = Σ_d dO·O — the dS correction term (FlashAttention-2 eq. 4)
+    # δ_i = Σ_d dO·O — the dS correction term (FlashAttention-2 eq. 4).
+    # lse/delta carry a trailing singleton so their blocks are (1, bq, 1)
+    # (TPU-lowerable; see _partials_kernel._finish)
     delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
-                    axis=-1)                                # (bh, s_q)
+                    axis=-1, keepdims=True)                 # (bh, s_q, 1)
+    lse3 = lse[..., None]                                   # (bh, s_q, 1)
 
     dkdv = functools.partial(
         _bwd_dkdv_kernel, scale=float(scale), causal=bool(causal),
@@ -463,8 +470,8 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
             pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
             pl.BlockSpec((1, bq, d), lambda bh_, ki, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh_, ki, qi: (bh_, qi)),
-            pl.BlockSpec((1, bq), lambda bh_, ki, qi: (bh_, qi)),
+            pl.BlockSpec((1, bq, 1), lambda bh_, ki, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh_, ki, qi: (bh_, qi, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh_, ki, qi: (bh_, ki, 0)),
@@ -479,7 +486,7 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse3, delta)
 
     dqk = functools.partial(
         _bwd_dq_kernel, scale=float(scale), causal=bool(causal),
@@ -492,14 +499,14 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
             pl.BlockSpec((1, bk, d), lambda bh_, qi, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh_, qi, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, bq, d), lambda bh_, qi, ki: (bh_, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh_, qi, ki: (bh_, qi)),
-            pl.BlockSpec((1, bq), lambda bh_, qi, ki: (bh_, qi)),
+            pl.BlockSpec((1, bq, 1), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh_, qi, ki: (bh_, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda bh_, qi, ki: (bh_, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s_q, d), qf.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(qf, kf, vf, dof, lse, delta)
+    )(qf, kf, vf, dof, lse3, delta)
 
     unfold = lambda x, s: jnp.moveaxis(x.reshape(b, h, s, d), 1, 2)
     return unfold(dq, s_q), unfold(dk, s_k), unfold(dv, s_k)
