@@ -128,13 +128,20 @@ def _peak_tflops(device) -> tuple:
     return 197.0, f"default v5e (unrecognized device_kind={kind!r})"
 
 
-def run_flagship(platform: str) -> dict:
+def run_flagship(platform: str, do_ab: bool = True,
+                 checkpoint=None) -> dict:
     """One flagship train step, steady state. On the cpu fallback a scaled-
     down config keeps the phase fast and proves the harness; MFU is only
     claimed on a real accelerator. On accel, an A/B block additionally
     measures flash-attention off and the remat alternatives AT THE
     FLAGSHIP'S OWN SHAPE (round-3 verdict items 1/9: the staircase the
-    tuning decisions rest on), at the batch the main run settled on."""
+    tuning decisions rest on), at the batch the main run settled on.
+
+    ``checkpoint`` (callable taking the partial result dict) is invoked
+    with the MAIN measurement before the A/B block starts: the tunneled
+    chip has wedged mid-run (2026-07-31 lost a finished 70-min flagship
+    to a wedge during the sweep), so the headline is banked the moment
+    it exists."""
     import jax
     import jax.numpy as jnp
 
@@ -157,11 +164,7 @@ def run_flagship(platform: str) -> dict:
             fpt = train_flops_per_token(cfg)
             tf_s = tokens_per_s * fpt / 1e12
             peak, peak_src = _peak_tflops(jax.devices()[0])
-            # A/B runs AFTER the main run's params/optimizer are freed
-            # (inside _measure_steps) — each variant must see the same
-            # clean-HBM conditions as the baseline it is compared against
-            ab = _flagship_ab(cfg, batch, rng) if on_accel else None
-            return {
+            main_result = {
                 "platform": platform,
                 "config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
                            "n_heads": cfg.n_heads, "head_dim": cfg.head_dim,
@@ -177,11 +180,19 @@ def run_flagship(platform: str) -> dict:
                 "peak_source": peak_src,
                 "mfu": round(tf_s / peak, 4) if on_accel else None,
                 "loss_finite": bool(np.isfinite(final)),
-                "ab": ab,
+                "ab": None,
                 "methodology": "chained donated steps (no cacheable "
                                "repeats), device-value read barrier, "
                                "counted model FLOPs only",
             }
+            if checkpoint is not None:
+                checkpoint(dict(main_result))
+            # A/B runs AFTER the main run's params/optimizer are freed
+            # (inside _measure_steps) — each variant must see the same
+            # clean-HBM conditions as the baseline it is compared against
+            if do_ab and on_accel:
+                main_result["ab"] = _flagship_ab(cfg, batch, rng)
+            return main_result
         except Exception as exc:           # OOM at this batch → shrink
             last_err = exc
             continue
@@ -537,13 +548,36 @@ def update_baseline_md(sweep: dict) -> None:
     tag = "-CPU" if is_cpu else ""
     begin = f"<!-- AUTO-MEASURED{tag} BEGIN -->"
     end = f"<!-- AUTO-MEASURED{tag} END -->"
+    # provenance: bench-code revision + the artifact file backing the table
+    # (ADVICE r4: the round-2 table could only be diagnosed as floor-bound
+    # because its heading pinned the bench code and raw JSON)
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10, cwd=here
+        ).stdout.strip() or "unknown"
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            capture_output=True, text=True, timeout=10, cwd=here
+        ).stdout.strip()
+        if rev != "unknown" and dirty:
+            rev += "-dirty"    # the numbers came from uncommitted code —
+            # never pin them to a clean hash an auditor would check out
+    except Exception:
+        rev = "unknown"
+    artifact = f"BENCH_SWEEP_{sweep['platform']}_{sweep['ndev']}dev.json"
     lines = [
         begin,
         "",
         f"## Measured (latest `bench.py` run — platform={sweep['platform']}, "
-        f"{sweep['ndev']} device(s), {sweep['ranks']} ranks)",
+        f"{sweep['ndev']} device(s), {sweep['ranks']} ranks; bench code @ "
+        f"{rev}, raw data {artifact})",
         "",
     ]
+    if flagship.get("error"):
+        lines += [f"**Flagship train step FAILED this run**: "
+                  f"`{flagship['error'][:300]}`", ""]
     if flagship.get("tokens_per_s"):
         c = flagship["config"]
         mfu = flagship.get("mfu")
@@ -577,6 +611,26 @@ def update_baseline_md(sweep: dict) -> None:
                         f"| {v['variant']} | {v['step_ms']} | "
                         f"{v['tokens_per_s']:.0f} | {v['tf_per_s']} |")
             lines.append("")
+    # tunneled-single-chip RTT-floor detection (ADVICE r3/r4): when even the
+    # 8 B collective takes milliseconds, the device column is measuring the
+    # tunnel round trip, not the chip — label the table so those rows are
+    # never quoted as device performance
+    measured_us = [r["device_us"] for r in sweep["results"]
+                   if "device_us" in r]
+    floor_bound = (not is_cpu and sweep["ndev"] == 1 and measured_us
+                   and min(measured_us) > 5000.0)
+    if floor_bound:
+        lines += [
+            "**CAVEAT — tunnel-RTT floor-bound device column.** The "
+            "smallest payload's device time is already "
+            f"{min(measured_us) / 1000:.0f} ms: per-op latency here is the "
+            "host↔TPU tunnel round trip, not device execution, so device "
+            "µs / GB/s are a *lower bound* and the speedup column mostly "
+            "reflects how many round trips the staged arm pays. Valid "
+            "relative evidence (native vs staged, same floor on both "
+            "arms); NOT quotable as absolute device latency.",
+            "",
+        ]
     lines += [
         "Device-native (coll/xla) vs host-staging shim "
         "(`coll_accelerator_allreduce.c:31-60` design):",
@@ -624,13 +678,78 @@ def main() -> None:
         # accel: leave selection alone — see pick_platform
         platform = jax.devices()[0].platform
 
-        flagship = run_flagship(platform)
-        sweep = run_sweep(platform)
+        # Phase control + incremental banking: the tunneled chip wedges
+        # mid-run, so each phase's result is persisted the moment it
+        # exists (OMPI_TPU_BENCH_PHASES lets a guard loop bank the
+        # flagship headline first, then continue with ab/sweep in a
+        # later healthy window without re-measuring what already landed)
+        phases = [p.strip() for p in os.environ.get(
+            "OMPI_TPU_BENCH_PHASES", "flagship,ab,sweep").split(",") if p]
         here = os.path.dirname(os.path.abspath(__file__))
+        ck_path = os.path.join(here, f"BENCH_FLAGSHIP_{platform}.json")
+        fname = f"BENCH_SWEEP_{platform}_{len(jax.devices())}dev.json"
+        try:       # prior artifact: flagship fallback + sweep reuse source
+            with open(os.path.join(here, fname)) as f:
+                old_sweep = json.load(f)
+        except OSError:
+            old_sweep = {}
+
+        def bank(d):
+            # a failed re-run must never clobber a banked good headline —
+            # that is the wedge scenario the checkpoint exists for
+            if not d.get("tokens_per_s"):
+                try:
+                    with open(ck_path) as f:
+                        if json.load(f).get("tokens_per_s"):
+                            return
+                except OSError:
+                    pass
+            with open(ck_path, "w") as f:
+                json.dump(d, f, indent=1)
+
+        if "flagship" in phases:
+            flagship = run_flagship(platform, do_ab="ab" in phases,
+                                    checkpoint=bank)
+            bank(flagship)
+            if not flagship.get("tokens_per_s"):
+                try:       # failed re-run: fall back to the banked good one
+                    with open(ck_path) as f:
+                        banked = json.load(f)
+                    if banked.get("tokens_per_s"):
+                        banked.setdefault("rerun_error",
+                                          flagship.get("error"))
+                        flagship = banked
+                except OSError:
+                    pass
+        else:
+            try:
+                with open(ck_path) as f:
+                    flagship = json.load(f)
+            except OSError:
+                flagship = old_sweep.get("flagship") or {}
+            if ("ab" in phases and flagship.get("config")
+                    and platform != "cpu" and not flagship.get("ab")):
+                from ompi_tpu.models.transformer import Config
+                c = flagship["config"]
+                cfg = Config(vocab=c["vocab"], d_model=c["d_model"],
+                             n_layers=c["n_layers"], n_heads=c["n_heads"],
+                             head_dim=c["head_dim"], d_ff=c["d_ff"],
+                             seq=c["seq"], attn=c["attn"], remat=c["remat"])
+                flagship["ab"] = _flagship_ab(cfg, c["batch"],
+                                              np.random.default_rng(0))
+                bank(flagship)
+
+        if "sweep" in phases:
+            sweep = run_sweep(platform)
+        elif old_sweep:     # reuse the last banked sweep for this platform
+            sweep = old_sweep
+            sweep.setdefault("results", [])
+        else:
+            sweep = {"platform": platform, "ndev": len(jax.devices()),
+                     "ranks": len(jax.devices()) or 1, "results": []}
         sweep["flagship"] = flagship
         # platform + device count in the FILENAME — a cpu fallback writes
         # alongside tpu evidence, never over it
-        fname = f"BENCH_SWEEP_{sweep['platform']}_{sweep['ndev']}dev.json"
         with open(os.path.join(here, fname), "w") as f:
             json.dump(sweep, f, indent=1)
         update_baseline_md(sweep)
@@ -639,7 +758,10 @@ def main() -> None:
         ns = [r for r in measured
               if r["collective"] == "allreduce"
               and r["bytes_per_rank"] == NORTH_STAR_COUNT * 4]
-        r = ns[0] if ns else measured[-1]
+        r = (ns[0] if ns else
+             measured[-1] if measured else
+             {"device_GBps": 0.0, "speedup_vs_staged": 0.0,
+              "ranks": sweep.get("ranks", 0)})
         if flagship.get("mfu") is not None:
             # headline on a real accelerator: flagship MFU (round-2
             # verdict item 1); vs_baseline = improvement over the ~20%
