@@ -95,9 +95,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        # operands stay in their storage dtype: on the MXU a bf16xbf16
+        # dot with float32 accumulation (preferred_element_type) runs at
+        # full rate, while upcasting inputs to f32 first quarters it (and
+        # doubles VMEM); f32 inputs keep exact f32 math as before
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -115,7 +119,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_cur = l_prev * alpha + jnp.sum(p, axis=1)
         acc_ref[...] = (acc_ref[...] * alpha[:, None]
                         + jax.lax.dot_general(
-                            p, v, (((1,), (0,)), ((), ())),
+                            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32))
         m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
@@ -148,8 +152,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     kv_steps = s_k // bk
 
     qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
-    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
-    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+    # kernels run uniform-dtype dots (lax.dot_general does not promote)
+    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d).astype(q.dtype)
+    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d).astype(q.dtype)
 
     kernel = functools.partial(
         _flash_kernel, scale=float(scale), causal=bool(causal), block_q=bq,
@@ -202,9 +207,9 @@ def _partials_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_out, l_out,
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0]                 # native dtype -> full-rate MXU
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -222,7 +227,7 @@ def _partials_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_out, l_out,
         l_cur = l_prev * alpha + jnp.sum(p, axis=1)
         acc_ref[...] = (acc_ref[...] * alpha[:, None]
                         + jax.lax.dot_general(
-                            p, v, (((1,), (0,)), ((), ())),
+                            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                             preferred_element_type=jnp.float32))
         m_ref[...] = jnp.broadcast_to(m_cur[:, None], m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_cur[:, None], l_ref.shape)
@@ -267,6 +272,8 @@ def flash_attention_partials(q: jax.Array, k: jax.Array, v: jax.Array,
     offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                       jnp.asarray(kv_offset, jnp.int32)])
 
+    k = k.astype(q.dtype)      # uniform-dtype dots (no promotion in lax)
+    v = v.astype(q.dtype)
     kernel = functools.partial(
         _partials_kernel, scale=float(scale), causal=bool(causal),
         block_q=bq, block_k=bk, kv_steps=kv_steps)
@@ -327,10 +334,10 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                 # native dtype -> full-rate MXU
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                    # (bq, 1)
         delta = delta_ref[0]                                # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -341,15 +348,15 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             cols = (ki * block_k
                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1))
             s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)                                # (bq, bk)
+        p = jnp.exp(s - lse)                                # (bq, bk) f32
         dv_acc[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # pᵀ·dO
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dk_acc[...] += jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)             # dsᵀ·Q
 
     @pl.when(qi == q_steps - 1)
@@ -378,10 +385,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(visible)
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]                 # native dtype -> full-rate MXU
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                                    # (bq, 1)
         delta = delta_ref[0]                                # (bq, 1)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
@@ -397,7 +404,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * scale
         dq_acc[...] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
     @pl.when(ki == kv_steps - 1)
@@ -429,8 +436,10 @@ def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
-    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
-    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
+    # residuals feed the bwd kernels' dots too: normalize dtypes here so
+    # qf/kf/vf stay uniform end to end (lax.dot_general does not promote)
+    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d).astype(q.dtype)
+    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d).astype(q.dtype)
     o_un, m, l = flash_attention_partials(
         qf, kf, vf, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret)
@@ -451,7 +460,7 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
-    dof = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d)
+    dof = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d).astype(qf.dtype)
     # δ_i = Σ_d dO·O — the dS correction term (FlashAttention-2 eq. 4).
     # lse/delta carry a trailing singleton so their blocks are (1, bq, 1)
     # (TPU-lowerable; see _partials_kernel._finish)
