@@ -244,9 +244,19 @@ def _flagship_ab(base_cfg, batch: int, rng) -> list:
     variants = [("attn=dense (flash OFF)", {"attn": "dense"}),
                 ("remat=none", {"remat": "none"}),
                 ("remat=full", {"remat": "full"}),
-                ("adam mu=bf16", {"opt_moment_dtype": "bfloat16"})]
+                ("adam mu=bf16", {"opt_moment_dtype": "bfloat16"}),
+                ("flash block 512", {"attn_block": 512}),
+                ("flash block 256", {"attn_block": 256})]
     out = []
     for label, delta in variants:
+        if "attn_block" in delta:
+            # a block override clamped to the sequence (or equal to the
+            # auto-pick) would re-measure the baseline under a new label
+            from ompi_tpu.ops.attention import _auto_block
+            eff = min(delta["attn_block"], base_cfg.seq)
+            if eff == min(base_cfg.attn_block or _auto_block(base_cfg.seq),
+                          base_cfg.seq):
+                continue
         cfg = Config(**{**base_cfg.__dict__, **delta})
         try:
             dt, tokens_per_s, _n, _loss = _measure_steps(

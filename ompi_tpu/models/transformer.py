@@ -49,6 +49,9 @@ class Config:
     #   make_train_step: "full" recomputes each layer in the backward
     #   (cheapest memory, +~1 forward of FLOPs), "dots" saves matmul
     #   outputs and recomputes only elementwise ops (MXU work unchanged)
+    attn_block: Optional[int] = None   # flash block_q/block_k override
+    #   (None = ops.attention auto-pick); an A/B lever — block size sets
+    #   the VMEM-tile / grid-step trade on the MXU
     opt_moment_dtype: str = "float32"  # Adam first-moment dtype; "bfloat16"
     #   halves the mu buffer's HBM (the MFU lever VERDICT r3 item 9 names:
     #   less optimizer traffic on an HBM-bound chip). Second moment stays
@@ -199,7 +202,8 @@ def _layer_apply(x: jax.Array, layer: Dict, cfg: Config,
                              else None)
     elif cfg.attn == "flash":
         from ..ops.attention import flash_mha
-        att = flash_mha(q, k, v, True)                 # Pallas fwd + bwd
+        att = flash_mha(q, k, v, True, None,           # Pallas fwd + bwd
+                        cfg.attn_block, cfg.attn_block)
     else:
         att = attention_reference(q, k, v, causal=True)
     att = att.reshape(b, s, cfg.n_heads * cfg.head_dim)
