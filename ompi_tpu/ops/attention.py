@@ -45,6 +45,36 @@ def _default_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def check_tpu_block(block, array_shape, what: str = "block",
+                    dtype=jnp.float32) -> None:
+    """Enforce the Mosaic TPU tiling rule at trace time, on EVERY backend.
+
+    Real-TPU Pallas requires the last two block dims be divisible by the
+    dtype's (sublane, lane) tile — (8, 128) for 4-byte types, sublanes
+    doubling as the itemsize halves (16 for bf16, 32 for int8/fp8) — or
+    equal to the corresponding array dim. Interpret mode (the CPU test
+    path) never checks this, which is how an unlowerable (1, bq) block on
+    a (bh, s_q) output survived 500+ green CPU tests and then failed the
+    first real-chip flagship compile (commit d5b947d). Calling this in
+    the kernel wrappers makes that failure class a CPU-testable
+    invariant."""
+    if len(block) < 2:
+        return                       # 1-D blocks: lane tiling only, exempt
+    if len(block) != len(array_shape):
+        raise ValueError(
+            f"{what}: block {tuple(block)} and array {tuple(array_shape)} "
+            f"have different ranks — mis-paired shapes, nothing checked")
+    sublane = 8 * max(1, 4 // jnp.dtype(dtype).itemsize)
+    for off, req in ((-2, sublane), (-1, 128)):
+        b, a = block[off], array_shape[off]
+        if b != a and b % req:
+            raise ValueError(
+                f"{what}: block {tuple(block)} on array "
+                f"{tuple(array_shape)} ({jnp.dtype(dtype).name}) is not "
+                f"TPU-lowerable — dim {off} block size {b} is neither a "
+                f"multiple of {req} nor equal to the array dim {a}")
+
+
 def _auto_block(s: int) -> int:
     """Largest power-of-two block ≤1024 dividing the sequence: the v5e
     block sweep (BASELINE.md) shows 1024² blocks run 2.4× faster than 256²
@@ -70,6 +100,20 @@ def _block_sizes(s_q: int, s_k: int, block_q: Optional[int],
         raise ValueError(f"seq lengths ({s_q},{s_k}) must divide into "
                          f"blocks ({bq},{bk})")
     return bq, bk
+
+
+def _check_flash_blocks(bh: int, s_q: int, s_k: int, d: int,
+                        bq: int, bk: int, with_partials: bool,
+                        what: str, dtype=jnp.float32) -> None:
+    """The three distinct (block, array) pairs every flash pallas_call in
+    this module uses; see check_tpu_block. ``dtype`` is the q/k/v storage
+    dtype (the sublane tile is dtype-dependent); m/l/lse/delta are always
+    f32."""
+    check_tpu_block((1, bq, d), (bh, s_q, d), f"{what} q/o", dtype)
+    check_tpu_block((1, bk, d), (bh, s_k, d), f"{what} k/v", dtype)
+    if with_partials:
+        check_tpu_block((1, bq, 1), (bh, s_q, 1), f"{what} m/l/lse/delta",
+                        jnp.float32)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
@@ -149,6 +193,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    _check_flash_blocks(b * h, s_q, s_k, d, bq, bk, False,
+                        "flash_attention", q.dtype)
     kv_steps = s_k // bk
 
     qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
@@ -268,6 +314,8 @@ def flash_attention_partials(q: jax.Array, k: jax.Array, v: jax.Array,
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    _check_flash_blocks(bh, s_q, s_k, d, bq, bk, True,
+                        "flash_attention_partials", q.dtype)
     kv_steps = s_k // bk
     offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                       jnp.asarray(kv_offset, jnp.int32)])
@@ -460,6 +508,8 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    _check_flash_blocks(bh, s_q, s_k, d, bq, bk, True, "flash_mha_bwd",
+                        qf.dtype)
     dof = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d).astype(qf.dtype)
     # δ_i = Σ_d dO·O — the dS correction term (FlashAttention-2 eq. 4).
     # lse/delta carry a trailing singleton so their blocks are (1, bq, 1)

@@ -220,3 +220,42 @@ class TestRingPallas:
         ref = attention_reference(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-4)
+
+
+class TestTpuTilingGuard:
+    """check_tpu_block: the trace-time Mosaic tiling rule (the invariant
+    whose absence let an unlowerable (1, bq) block reach the first
+    real-chip compile — commit d5b947d)."""
+
+    def test_rejects_the_d5b947d_shape(self):
+        from ompi_tpu.ops.attention import check_tpu_block
+        with pytest.raises(ValueError, match="not TPU-lowerable"):
+            check_tpu_block((1, 1024), (16, 2048), "m/l")
+
+    def test_accepts_lane_aligned_and_equal_dims(self):
+        from ompi_tpu.ops.attention import check_tpu_block
+        check_tpu_block((1, 1024, 128), (16, 2048, 128))   # divisible
+        check_tpu_block((1, 1024, 1), (16, 2048, 1))       # equal arm
+        check_tpu_block((1, 256, 64), (8, 256, 64))        # d == array dim
+        check_tpu_block((8,), (64,))                       # 1-D: exempt
+
+    def test_wrappers_enforce_it(self):
+        # a hand-forced block that violates the sublane rule must raise on
+        # EVERY backend, not just on a real chip
+        from ompi_tpu.ops.attention import flash_attention
+        q = jnp.ones((1, 64, 2, 128), jnp.float32)
+        with pytest.raises(ValueError, match="not TPU-lowerable"):
+            # bq=4 divides s_q=64 (so _block_sizes accepts it) but is
+            # neither a multiple of 8 sublanes nor equal to s_q
+            flash_attention(q, q, q, block_q=4)
+
+    def test_bf16_sublane_tile_is_16(self):
+        from ompi_tpu.ops.attention import check_tpu_block
+        check_tpu_block((1, 8, 128), (4, 64, 128))            # f32: ok
+        with pytest.raises(ValueError, match="multiple of 16"):
+            check_tpu_block((1, 8, 128), (4, 64, 128), "q", jnp.bfloat16)
+
+    def test_rank_mismatch_raises(self):
+        from ompi_tpu.ops.attention import check_tpu_block
+        with pytest.raises(ValueError, match="different ranks"):
+            check_tpu_block((1, 8), (4, 64, 1))
