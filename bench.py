@@ -590,6 +590,17 @@ def run_sweep(platform: str) -> dict:
     }
 
 
+def _load_json(path):
+    """Banked-artifact read: None on missing OR corrupt (bank() writes
+    non-atomically on a machine that wedges mid-run, so truncated JSON is
+    an expected state, not an error worth losing the run's output over)."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
 def update_baseline_md(sweep: dict) -> None:
     """Fold measured numbers into BASELINE.md. Accelerator runs own the
     primary AUTO-MEASURED block; cpu-fallback runs own a separate
@@ -756,22 +767,16 @@ def main() -> None:
         here = os.path.dirname(os.path.abspath(__file__))
         ck_path = os.path.join(here, f"BENCH_FLAGSHIP_{platform}.json")
         fname = f"BENCH_SWEEP_{platform}_{len(jax.devices())}dev.json"
-        try:       # prior artifact: flagship fallback + sweep reuse source
-            with open(os.path.join(here, fname)) as f:
-                old_sweep = json.load(f)
-        except OSError:
-            old_sweep = {}
+        # prior artifact: flagship fallback + sweep reuse source
+        old_sweep = _load_json(os.path.join(here, fname)) or {}
 
         def bank(d):
             # a failed re-run must never clobber a banked good headline —
             # that is the wedge scenario the checkpoint exists for
             if not d.get("tokens_per_s"):
-                try:
-                    with open(ck_path) as f:
-                        if json.load(f).get("tokens_per_s"):
-                            return
-                except OSError:
-                    pass
+                prev = _load_json(ck_path)
+                if prev and prev.get("tokens_per_s"):
+                    return
             with open(ck_path, "w") as f:
                 json.dump(d, f, indent=1)
 
@@ -780,21 +785,14 @@ def main() -> None:
                                     checkpoint=bank)
             bank(flagship)
             if not flagship.get("tokens_per_s"):
-                try:       # failed re-run: fall back to the banked good one
-                    with open(ck_path) as f:
-                        banked = json.load(f)
-                    if banked.get("tokens_per_s"):
-                        banked.setdefault("rerun_error",
-                                          flagship.get("error"))
-                        flagship = banked
-                except OSError:
-                    pass
+                banked = _load_json(ck_path)  # failed re-run: use banked
+                if banked and banked.get("tokens_per_s"):
+                    banked.setdefault("rerun_error",
+                                      flagship.get("error"))
+                    flagship = banked
         else:
-            try:
-                with open(ck_path) as f:
-                    flagship = json.load(f)
-            except OSError:
-                flagship = old_sweep.get("flagship") or {}
+            flagship = (_load_json(ck_path)
+                        or old_sweep.get("flagship") or {})
             if ("ab" in phases and flagship.get("config")
                     and platform != "cpu" and not flagship.get("ab")):
                 from ompi_tpu.models.transformer import Config
@@ -863,18 +861,14 @@ def main() -> None:
                                "real chip")
                 # a wedged tunnel at round end must not hide evidence a
                 # healthy window already banked: surface the TPU headline
-                try:
-                    with open(os.path.join(
-                            here, "BENCH_FLAGSHIP_tpu.json")) as f:
-                        tpu = json.load(f)
-                    if tpu.get("mfu"):
-                        out["banked_tpu_flagship"] = {
-                            "mfu_pct": round(tpu["mfu"] * 100, 1),
-                            "tokens_per_s": tpu["tokens_per_s"],
-                            "tf_per_s": tpu["tf_per_s"],
-                        }
-                except OSError:
-                    pass
+                tpu = _load_json(os.path.join(
+                    here, "BENCH_FLAGSHIP_tpu.json"))
+                if tpu and tpu.get("mfu"):
+                    out["banked_tpu_flagship"] = {
+                        "mfu_pct": round(tpu["mfu"] * 100, 1),
+                        "tokens_per_s": tpu["tokens_per_s"],
+                        "tf_per_s": tpu["tf_per_s"],
+                    }
             else:          # flagship failed on a real accelerator: say so
                 out["flagship_error"] = flagship.get("error", "unknown")
             print(json.dumps(out))
