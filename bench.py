@@ -33,6 +33,7 @@ virtual 8-device CPU mesh so a number ALWAYS lands.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -166,12 +167,15 @@ def run_flagship(platform: str, do_ab: bool = True,
             peak, peak_src = _peak_tflops(jax.devices()[0])
             main_result = {
                 "platform": platform,
-                "config": {"d_model": cfg.d_model, "n_layers": cfg.n_layers,
-                           "n_heads": cfg.n_heads, "head_dim": cfg.head_dim,
-                           "d_ff": cfg.d_ff, "seq": cfg.seq,
-                           "vocab": cfg.vocab, "batch": batch,
-                           "attn": cfg.attn, "remat": cfg.remat,
-                           "params_m": round(n_params / 1e6, 1)},
+                # full Config (every field, dtype as its name string) so
+                # an ab-only rerun rebuilds the EXACT flagship config —
+                # a partial field list would silently revert unlisted
+                # fields to defaults and unmoor the A/B baseline
+                "config": dict(
+                    dataclasses.asdict(cfg),
+                    dtype=jnp.dtype(cfg.dtype).name,
+                    batch=batch,
+                    params_m=round(n_params / 1e6, 1)),
                 "step_ms": round(dt * 1e3, 2),
                 "tokens_per_s": round(tokens_per_s, 0),
                 "flops_per_token": round(fpt, 0),
@@ -835,11 +839,16 @@ def main() -> None:
             if ("ab" in phases and flagship.get("config")
                     and platform != "cpu" and not flagship.get("ab")):
                 from ompi_tpu.models.transformer import Config
+                import jax.numpy as jnp
                 c = flagship["config"]
-                cfg = Config(vocab=c["vocab"], d_model=c["d_model"],
-                             n_layers=c["n_layers"], n_heads=c["n_heads"],
-                             head_dim=c["head_dim"], d_ff=c["d_ff"],
-                             seq=c["seq"], attn=c["attn"], remat=c["remat"])
+                # rebuild from every banked field that IS a Config field
+                # (old artifacts carry a subset; extras like batch/params_m
+                # are not Config fields) — dtype round-trips via its name
+                names = {f.name for f in dataclasses.fields(Config)}
+                kw = {k: v for k, v in c.items() if k in names}
+                if isinstance(kw.get("dtype"), str):
+                    kw["dtype"] = jnp.dtype(kw["dtype"])
+                cfg = Config(**kw)
                 flagship["ab"] = _flagship_ab(cfg, c["batch"],
                                               np.random.default_rng(0))
                 bank(flagship)
@@ -881,15 +890,19 @@ def main() -> None:
                 "allreduce_4M_device_GBps": r["device_GBps"],
             }))
         else:
+            # methodology lives IN the metric name: a _chained headline is
+            # not comparable to a single-op one, so the key must differ
+            chained = "device_GBps_chained" in r
             out = {
                 "metric": f"allreduce_{r['ranks']}x4M_f32_device_native_"
-                          f"{sweep['platform']}",
+                          f"{sweep['platform']}"
+                          + ("_chained" if chained else ""),
                 "value": r.get("device_GBps_chained", r["device_GBps"]),
                 "unit": "GB/s",
                 "vs_baseline": r.get("speedup_vs_staged_chained",
                                      r["speedup_vs_staged"]),
             }
-            if "device_GBps_chained" in r:
+            if chained:
                 out["note_chained"] = ("steady-state: chained "
                                        "data-dependent ops, dispatch "
                                        "amortized; vs_baseline is "

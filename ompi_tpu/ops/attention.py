@@ -5,8 +5,11 @@ with the kv dimension innermost — on TPU the innermost grid dimension is
 sequential per core, so the online-softmax state (row max ``m``, denominator
 ``l``, un-normalized accumulator ``acc``) lives in VMEM scratch and is
 carried across kv steps; the final kv step normalizes and writes the output
-block. Scores and accumulation are float32 on the MXU regardless of input
-dtype (bfloat16 inputs stay bfloat16 in HBM/VMEM).
+block. The QK and PV dots run in the storage dtype with float32
+accumulation (``preferred_element_type``): bfloat16 inputs stay bfloat16 in
+HBM/VMEM and on the MXU operand ports, probabilities are downcast to the
+storage dtype for the PV dot, and only the online-softmax state (m, l, acc)
+is float32.
 
 Three entry points:
   * ``flash_attention`` — self-contained attention (optionally causal);
@@ -479,15 +482,19 @@ def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array,
 def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     if interpret is None:
         interpret = _default_interpret()
+    if k.dtype != q.dtype or v.dtype != q.dtype:
+        # custom_vjp cotangents must match the primal input avals; a cast
+        # here would hand jax.grad dk/dv in q.dtype and fail downstream.
+        raise TypeError(
+            f"flash_mha requires uniform q/k/v dtype, got q={q.dtype} "
+            f"k={k.dtype} v={v.dtype}; cast inputs before calling")
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     qf = jnp.moveaxis(q, 2, 1).reshape(b * h, s_q, d)
-    # residuals feed the bwd kernels' dots too: normalize dtypes here so
-    # qf/kf/vf stay uniform end to end (lax.dot_general does not promote)
-    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d).astype(q.dtype)
-    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d).astype(q.dtype)
+    kf = jnp.moveaxis(k, 2, 1).reshape(b * h, s_k, d)
+    vf = jnp.moveaxis(v, 2, 1).reshape(b * h, s_k, d)
     o_un, m, l = flash_attention_partials(
         qf, kf, vf, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret)
