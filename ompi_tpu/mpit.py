@@ -2,8 +2,15 @@
 
 cvars  — control variables: the var registry (core/var.py), with name/level/
          scope/source, readable and (scope permitting) writable at runtime;
-pvars  — performance variables: the SPC counters (spc.py) of a Context;
-categories — frameworks with their components and variables.
+pvars  — performance variables: the SPC counters (spc.py) of a Context plus
+         the monitoring per-peer matrices, exported through the full MPI_T
+         handle/session machinery (≙ ompi/mpi/tool/pvar_session_create.c,
+         pvar_handle_alloc.c, pvar_start.c, pvar_readreset.c):
+         sessions isolate handle sets, a handle binds one pvar to one MPI
+         object, and non-continuous counters accumulate PER HANDLE only
+         while started — so two tools reading the same counter never see
+         each other's resets;
+categories — frameworks with their components, variables and descriptions.
 
 The tpu_info tool (tools/tpu_info.py) and tests are the consumers; external
 tools get the same dicts via these functions.
@@ -13,9 +20,21 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
+import numpy as np
+
 from .core import var as _var
 from .core.component import frameworks
 from .spc import COUNTERS
+
+
+class MPITError(RuntimeError):
+    """≙ the MPI_T_ERR_* family; ``code`` is the lowercase suffix
+    (no_startstop, no_write, no_atomic, invalid_handle, invalid_session,
+    invalid_index)."""
+
+    def __init__(self, code: str, msg: str) -> None:
+        super().__init__(f"MPI_T_ERR_{code.upper()}: {msg}")
+        self.code = code
 
 
 def cvar_get_num(max_level: int = 9) -> int:
@@ -41,15 +60,20 @@ def cvar_write(name: str, value) -> None:
 
 
 def pvar_get_num() -> int:
-    return len(COUNTERS)
+    return len(_pvar_inventory())
 
 
-def pvar_get_info(index: int) -> Dict[str, str]:
-    name, help_ = COUNTERS[index]
-    return {"name": name, "help": help_}
+def pvar_get_info(index: int) -> Dict[str, Any]:
+    return dict(_pvar_inventory()[index])
 
 
 def pvar_read(ctx, name: str) -> float:
+    if not any(name == n for n, _ in COUNTERS):
+        # advertised-but-handle-only pvars (the monitoring matrices) must
+        # not silently read as 0.0 through the ctx shortcut
+        raise MPITError("invalid_index",
+                        f"{name!r} is not a context-bound counter; "
+                        "read it through pvar_handle_alloc")
     return ctx.spc.get(name)
 
 
@@ -57,11 +81,222 @@ def pvar_read_all(ctx) -> Dict[str, float]:
     return ctx.spc.snapshot()
 
 
+# -- pvar handles + sessions (≙ ompi/mpi/tool/pvar_*.c) ----------------------
+#
+# Pvar inventory: every SPC counter is a NON-continuous counter pvar — the
+# MPI_T model where counting is scoped to the handle (starts stopped,
+# accumulates only while started, reset/readreset are per-handle and never
+# disturb the underlying source or other tools' handles). The monitoring
+# matrices are CONTINUOUS readonly array pvars bound to a communicator
+# (count = comm.size) — always on at the source, so start/stop/readreset
+# are refused exactly as the reference refuses them for
+# MCA_BASE_PVAR_FLAG_CONTINUOUS variables (mca_base_pvar.c start path).
+
+_MON_CLASSES = ("pt2pt_tx", "pt2pt_rx", "coll", "osc")
+
+
+def _pvar_inventory() -> List[Dict[str, Any]]:
+    out = [{"name": n, "help": h, "class": "counter", "bind": "context",
+            "continuous": False, "readonly": False, "count": 1}
+           for n, h in COUNTERS]
+    out += [{"name": f"monitoring_{cls}_bytes",
+             "help": f"per-peer {cls} traffic matrix row (bytes)",
+             "class": "aggregate", "bind": "comm", "continuous": True,
+             "readonly": True, "count": None}      # count = comm.size
+            for cls in _MON_CLASSES]
+    return out
+
+
+def _pvar_index(name: str) -> int:
+    for i, m in enumerate(_pvar_inventory()):
+        if m["name"] == name:
+            return i
+    raise MPITError("invalid_index", f"no pvar named {name!r}")
+
+
+class PvarSession:
+    """≙ MPI_T_pvar_session: an isolated set of handles so concurrent tools
+    (a tracer and a monitor, say) never share start/stop/reset state."""
+
+    def __init__(self) -> None:
+        self.handles: List["PvarHandle"] = []
+        self._freed = False
+
+    def _check(self) -> None:
+        if self._freed:
+            raise MPITError("invalid_session", "session was freed")
+
+
+class PvarHandle:
+    """One pvar bound to one MPI object within one session.
+
+    ``obj`` must carry the pvar's bind type: a Context (or anything with
+    ``.spc``) for counter pvars; a Comm whose context has monitoring
+    installed for the matrix pvars."""
+
+    def __init__(self, session: PvarSession, meta: Dict[str, Any],
+                 obj: Any) -> None:
+        self.session = session
+        self.meta = dict(meta)
+        self.obj = obj
+        self._freed = False
+        if meta["bind"] == "context":
+            ctx = getattr(obj, "ctx", obj)     # a Comm binds via its ctx
+            spc = getattr(ctx, "spc", None)
+            if spc is None:
+                raise MPITError("invalid_handle",
+                                f"{meta['name']} binds a Context "
+                                f"(object with .spc), got {type(obj)}")
+            self._spc = spc
+            self.count = 1
+        else:                                   # comm-bound matrix pvar
+            ctx = getattr(obj, "ctx", None)
+            mon = getattr(ctx, "_monitor", None) if ctx else None
+            if mon is None:
+                raise MPITError("invalid_handle",
+                                f"{meta['name']} binds a Comm with "
+                                "monitoring installed (monitoring.install)")
+            self._mon = mon
+            self.count = obj.size
+        # non-continuous counters start STOPPED with zero accumulation
+        self.started = bool(meta["continuous"])
+        self._acc = 0.0
+        self._base = self._source() if self.started else 0.0
+
+    # raw source value, independent of handle state
+    def _source(self):
+        if self.meta["bind"] == "context":
+            return float(self._spc.get(self.meta["name"]))
+        cls = self.meta["name"][len("monitoring_"):-len("_bytes")]
+        rows = self._mon.peers.get(cls, {})
+        out = np.zeros(self.count)
+        group = self.obj.group      # peers() keys are WORLD ranks: map to
+        for peer, (msgs, nbytes) in rows.items():   # the bound comm's rank
+            r = group.rank_of_world(peer)           # space (-1 = not in
+            if r >= 0:                              # this comm: dropped,
+                out[r] = nbytes                     # as gather_matrix does)
+        return out
+
+    def _check(self) -> None:
+        self.session._check()
+        if self._freed:
+            raise MPITError("invalid_handle", "handle was freed")
+
+    def start(self) -> None:
+        self._check()
+        if self.meta["continuous"]:
+            raise MPITError("no_startstop",
+                            f"{self.meta['name']} is continuous")
+        if not self.started:
+            self.started = True
+            self._base = self._source()
+
+    def stop(self) -> None:
+        self._check()
+        if self.meta["continuous"]:
+            raise MPITError("no_startstop",
+                            f"{self.meta['name']} is continuous")
+        if self.started:
+            self._acc += self._source() - self._base
+            self.started = False
+
+    def read(self):
+        self._check()
+        if self.meta["continuous"]:
+            return self._source()
+        if self.started:
+            return self._acc + self._source() - self._base
+        return self._acc
+
+    def reset(self) -> None:
+        self._check()
+        if self.meta["readonly"]:
+            raise MPITError("no_atomic",
+                            f"{self.meta['name']} is readonly")
+        self._acc = 0.0
+        self._base = self._source()
+
+    def readreset(self):
+        self._check()
+        if self.meta["readonly"]:
+            raise MPITError("no_atomic",
+                            f"{self.meta['name']} is readonly")
+        v = self.read()
+        self.reset()
+        return v
+
+    def write(self, value) -> None:
+        self._check()
+        if self.meta["readonly"]:
+            raise MPITError("no_write",
+                            f"{self.meta['name']} is readonly")
+        self._acc = float(value)
+        self._base = self._source()
+
+    def free(self) -> None:
+        self._freed = True
+        if self in self.session.handles:
+            self.session.handles.remove(self)
+
+
+def pvar_session_create() -> PvarSession:
+    return PvarSession()
+
+
+def pvar_session_free(session: PvarSession) -> None:
+    session._check()
+    for h in list(session.handles):
+        h.free()
+    session._freed = True
+
+
+def pvar_handle_alloc(session: PvarSession, index_or_name, obj) -> PvarHandle:
+    """≙ MPI_T_pvar_handle_alloc: bind pvar ``index_or_name`` to ``obj``
+    in ``session``; ``handle.count`` is the element count."""
+    session._check()
+    inv = _pvar_inventory()
+    if isinstance(index_or_name, int):
+        if not 0 <= index_or_name < len(inv):
+            raise MPITError("invalid_index", f"pvar {index_or_name}")
+        meta = inv[index_or_name]
+    else:
+        meta = inv[_pvar_index(index_or_name)]
+    h = PvarHandle(session, meta, obj)
+    session.handles.append(h)
+    return h
+
+
+def pvar_handle_free(handle: PvarHandle) -> None:
+    handle.free()
+
+
+# -- categories ---------------------------------------------------------------
+
+# one-line descriptions (≙ the reference's framework .h descriptions)
+_FRAMEWORK_DESC = {
+    "btl": "byte transfer layer: point-to-point transports",
+    "pml": "point-to-point messaging layer (matching, protocols)",
+    "coll": "collective operation components",
+    "osc": "one-sided communication (RMA windows)",
+    "io": "MPI-IO file components",
+    "fbtl": "individual file byte transfer",
+    "fcoll": "collective file I/O strategies",
+    "fs": "file-system adaptors",
+    "sharedfp": "shared file-pointer components",
+    "topo": "process topology components",
+    "accelerator": "device memory/stream abstraction",
+    "spc": "software performance counters",
+    "monitoring": "per-peer traffic recording",
+}
+
+
 def category_get_all() -> List[Dict[str, Any]]:
     out = []
     for fw in frameworks.all_frameworks():
         out.append({
             "framework": fw.name,
+            "description": _FRAMEWORK_DESC.get(
+                fw.name, f"{fw.name} framework"),
             "components": sorted(fw.components.keys()),
             "vars": [v.name for v in _var.registry.all_vars()
                      if v.name.startswith(fw.name + "_")],

@@ -313,6 +313,55 @@ def iget(src: SymmetricArray, dst_stride: int, src_stride: int,
     return out
 
 
+# signal ops for put_signal (≙ oshmem/include/shmem.h SHMEM_SIGNAL_*)
+SIGNAL_SET = 0
+SIGNAL_ADD = 1
+
+
+def put_signal(dest: SymmetricArray, value, sig: SymmetricArray,
+               sig_val, pe: int, *, offset: int = 0, sig_offset: int = 0,
+               sig_op: int = SIGNAL_SET) -> None:
+    """shmem_put_signal: data put + signal update in one call, with the
+    signal applied at the target AFTER the data is visible
+    (≙ oshmem/shmem/c/shmem_put_signal.c). The producer-consumer
+    primitive: the consumer wait_until()s on ``sig`` and may then read
+    the data with no fence/quiet of its own.
+
+    Ordering is structural, not flushed: both operations are AM frames to
+    the same peer on the same tag, the transport delivers same-peer+tag
+    frames in send order, and the target's progress loop applies them in
+    arrival order — so the signal can never overtake the data."""
+    put_signal_nbi(dest, value, sig, sig_val, pe, offset=offset,
+                   sig_offset=sig_offset, sig_op=sig_op).wait()
+
+
+def put_signal_nbi(dest: SymmetricArray, value, sig: SymmetricArray,
+                   sig_val, pe: int, *, offset: int = 0,
+                   sig_offset: int = 0,
+                   sig_op: int = SIGNAL_SET) -> Request:
+    """shmem_put_signal_nbi: non-blocking put_signal. The returned request
+    completes when the SIGNAL is applied — which, by the same-channel
+    ordering contract above, implies the data already landed; quiet()
+    covers both (both are tracked)."""
+    st = _state()
+    a = np.ascontiguousarray(np.asarray(value, dest.dtype))
+    _track(st, dest._win.put(a, pe, **_rma_kw(dest, offset)))
+    sv = np.asarray([sig_val], sig.dtype)
+    if sig_op == SIGNAL_ADD:
+        r = sig._win.accumulate(sv, pe, op=SUM,
+                                **_rma_kw(sig, sig_offset))
+    elif sig_op == SIGNAL_SET:
+        r = sig._win.put(sv, pe, **_rma_kw(sig, sig_offset))
+    else:
+        raise ValueError(f"unknown sig_op {sig_op!r}")
+    return _track(st, r)
+
+
+def signal_fetch(sig: SymmetricArray, offset: int = 0):
+    """shmem_signal_fetch: atomic local read of a signal word."""
+    return sig.local.reshape(-1)[offset]
+
+
 # -- ordering (≙ spml fence/quiet) ------------------------------------------
 
 def quiet() -> None:

@@ -51,6 +51,7 @@ def main(argv=None) -> int:
     print("\nframeworks / components:")
     for cat in mpit.category_get_all():
         print(f"  {cat['framework']}: {', '.join(cat['components']) or '-'}")
+        print(f"      {cat['description']}")
 
     print(f"\nvariables (level ≤ {args.level}):")
     for v in _var.registry.all_vars(args.level):

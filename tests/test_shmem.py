@@ -93,6 +93,52 @@ def test_wait_until_signalling():
     assert all(_pe(body))
 
 
+def test_put_signal_producer_consumer():
+    """The canonical SHMEM pipeline: data + signal in ONE op, consumer
+    reads data after wait_until on the signal with NO fence/quiet anywhere
+    (≙ oshmem/shmem/c/shmem_put_signal.c ordering guarantee)."""
+    def body():
+        me = shmem.my_pe()
+        data = shmem.smalloc(16, np.float64)
+        sig = shmem.smalloc(1, np.int64)
+        shmem.barrier_all()
+        if me == 1:
+            shmem.put_signal(data, np.arange(16) * 2.0, sig, 7, 0)
+        if me == 0:
+            shmem.wait_until(sig, "eq", 7, timeout=30)
+            # signal visible ⇒ data visible: no fence between
+            np.testing.assert_array_equal(data.local, np.arange(16) * 2.0)
+            assert shmem.signal_fetch(sig) == 7
+        shmem.barrier_all()
+        return True
+    assert all(_pe(body))
+
+
+def test_put_signal_nbi_add_and_quiet():
+    """SIGNAL_ADD accumulates arrivals: consumer waits for ALL producers
+    by counting the signal up, one put_signal_nbi each; quiet() on the
+    producers covers both halves of the op."""
+    def body():
+        me = shmem.my_pe()
+        n = shmem.n_pes()
+        data = shmem.smalloc((n, 4), np.int64)
+        sig = shmem.smalloc(1, np.int64)
+        shmem.barrier_all()
+        if me != 0:
+            shmem.put_signal_nbi(data, np.full(4, me * 11), sig, 1, 0,
+                                 offset=me * 4,
+                                 sig_op=shmem.SIGNAL_ADD)
+            shmem.quiet()
+        if me == 0:
+            shmem.wait_until(sig, "eq", n - 1, timeout=30)
+            for pe in range(1, n):
+                np.testing.assert_array_equal(data.local[pe],
+                                              np.full(4, pe * 11))
+        shmem.barrier_all()
+        return True
+    assert all(_pe(body))
+
+
 def test_shmem_collectives():
     def body():
         me = shmem.my_pe()
