@@ -8,9 +8,13 @@ pipelined collectives and the rendezvous protocol rely on — and external32
 
 Differences from the reference, by design:
   * the unit of user data is a numpy array (or anything exposing the buffer
-    protocol); jax device arrays are staged through numpy at this layer —
-    device-side packing of non-contiguous layouts is a Pallas kernel upgrade
-    tracked in SURVEY.md §7 (hard parts);
+    protocol). Jax DEVICE arrays never reach this layer for the common
+    case: the accelerator component packs/unpacks homogeneous item-aligned
+    datatypes ON DEVICE as one jitted XLA gather/scatter with a
+    device-cached index map (accelerator/jaxacc.py pack_device/stage_in —
+    the device half of opal_convertor.c:245's role), and only the packed
+    contiguous stream crosses the PCIe/host bridge. Heterogeneous or
+    misaligned datatypes fall back to full staging plus this convertor;
   * contiguous fast path is a single memoryview copy (no per-segment loop).
 """
 

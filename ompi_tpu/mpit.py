@@ -64,7 +64,11 @@ def pvar_get_num() -> int:
 
 
 def pvar_get_info(index: int) -> Dict[str, Any]:
-    return dict(_pvar_inventory()[index])
+    inv = _pvar_inventory()
+    if not 0 <= index < len(inv):
+        raise MPITError("invalid_index", f"pvar index {index} outside "
+                                         f"[0, {len(inv)})")
+    return dict(inv[index])
 
 
 def pvar_read(ctx, name: str) -> float:
@@ -92,7 +96,7 @@ def pvar_read_all(ctx) -> Dict[str, float]:
 # are refused exactly as the reference refuses them for
 # MCA_BASE_PVAR_FLAG_CONTINUOUS variables (mca_base_pvar.c start path).
 
-_MON_CLASSES = ("pt2pt_tx", "pt2pt_rx", "coll", "osc")
+from .monitoring import CLASSES as _MON_CLASSES  # one source of truth
 
 
 def _pvar_inventory() -> List[Dict[str, Any]]:

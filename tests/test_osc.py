@@ -367,6 +367,16 @@ def test_window_info_accessors():
 # ---------------------------------------------------------------------------
 
 class TestDeviceWindow:
+    @pytest.fixture(autouse=True)
+    def _native_mode(self, monkeypatch):
+        # these tests validate the NATIVE compiled-epoch path; on the CPU
+        # fabric the measured decision layer would route to staged
+        from ompi_tpu.core import var
+        monkeypatch.setenv("OMPI_TPU_osc_device_mode", "native")
+        var.registry.reset_cache()
+        yield
+        var.registry.reset_cache()
+
     def _win(self, shape=(8,), dtype=None, init=None):
         import jax.numpy as jnp
         from ompi_tpu.osc import win_allocate_device
@@ -509,6 +519,96 @@ def test_async_progress_init_opt_in():
 # device-window passive target (VERDICT r3 item 6 ≙ osc_rdma_passive_target.c)
 # ---------------------------------------------------------------------------
 
+class TestDeviceWindowDecision:
+    """Native-vs-staged epoch decision (≙ coll_tuned_decision_fixed.c
+    applied to the device RMA path; round-4 verdict weak#3)."""
+
+    def _win(self, shape=(8,)):
+        import jax.numpy as jnp
+        from ompi_tpu.osc import win_allocate_device
+        from ompi_tpu.parallel import make_mesh
+        return win_allocate_device(make_mesh({"x": 8}), shape, axis="x",
+                                   dtype=jnp.float32)
+
+    def _epoch(self, win):
+        win.fence()
+        win.put(3, np.arange(8, dtype=np.float32))
+        win.put(5, np.full(4, 7.0, np.float32), offset=2)
+        win.accumulate(2, np.ones(8, np.float32))
+        g = win.get(3, count=8)
+        ga = win.get_accumulate(6, np.full(8, 2.0, np.float32))
+        win.fence()
+        return g, ga
+
+    def test_staged_epoch_matches_native(self, monkeypatch):
+        import jax
+        from ompi_tpu.core import var
+        outs = {}
+        for mode in ("native", "staged"):
+            monkeypatch.setenv("OMPI_TPU_osc_device_mode", mode)
+            var.registry.reset_cache()
+            win = self._win()
+            g, ga = self._epoch(win)
+            outs[mode] = (np.asarray(jax.device_get(win.array)),
+                          np.asarray(g.value), np.asarray(ga.value))
+            win.free()
+        var.registry.reset_cache()
+        for a, b in zip(outs["native"], outs["staged"]):
+            np.testing.assert_array_equal(a, b)
+
+    def test_cpu_platform_defaults_staged_and_caches_nothing(self):
+        from ompi_tpu.core import var
+        var.registry.reset_cache()      # no force: measured default
+        win = self._win()
+        assert win._platform == "cpu"
+        ops = [("put", 0, 0, (8,), None)]
+        assert win._mode(ops) == "staged"
+        self._epoch(win)
+        assert len(win._cache) == 0     # staged path compiled no program
+        win.free()
+
+    def test_rules_file_steers_mode_per_size(self, tmp_path):
+        from ompi_tpu.core import var
+        rules = tmp_path / "rules.txt"
+        rules.write_text("rma_fence_epoch 1 0 native\n"
+                         "rma_fence_epoch 1 65536 staged\n")
+        # CLI level, not env: other tests leave a CLI-level "" behind,
+        # which outranks ENV in the var ladder
+        var.registry.set_cli("coll_xla_dynamic_rules", str(rules))
+        var.registry.reset_cache()
+        try:
+            win = self._win()
+            small = [("put", 0, 0, (8,), None)]             # 32 B
+            large = [("put", 0, 0, (65536,), None)]         # 256 KB
+            assert win._mode(small) == "native"
+            assert win._mode(large) == "staged"
+            win.free()
+        finally:
+            var.registry.set_cli("coll_xla_dynamic_rules", "")
+            var.registry.reset_cache()
+
+    def test_coalesce_merges_adjacent_puts(self, monkeypatch):
+        from ompi_tpu.core import var
+        monkeypatch.setenv("OMPI_TPU_osc_device_mode", "native")
+        var.registry.reset_cache()
+        win = self._win(shape=(12,))
+        win.fence()
+        win.put(4, np.arange(4, dtype=np.float32))            # [0:4)
+        win.put(4, np.arange(4, 8, dtype=np.float32), offset=4)   # [4:8)
+        win.put(2, np.full(4, 9.0, np.float32), offset=8)     # other target
+        win.fence()
+        # the two contiguous same-target puts merged into ONE program op
+        (sig,) = win._cache.keys()
+        assert sig == (("put", (8,)), ("put", (4,)))
+        np.testing.assert_array_equal(
+            np.asarray(win.rank_slice(4))[:8],
+            np.arange(8, dtype=np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(win.rank_slice(2))[8:], np.full(4, 9.0))
+        win.free()
+        var.registry.reset_cache()
+
+
 class TestDeviceWindowPassiveTarget:
     def _win(self, n=8, size=8):
         jax = pytest.importorskip("jax")
@@ -627,9 +727,14 @@ class TestDeviceWindowPassiveTarget:
                                        np.full(2, float((r + 1) % 4)))
         win.free()
 
-    def test_steady_state_cache_reuse(self):
-        """Repeated identical passive epochs hit ONE cached executable."""
+    def test_steady_state_cache_reuse(self, monkeypatch):
+        """Repeated identical passive epochs hit ONE cached executable
+        (native path — the CPU default would route staged and cache
+        nothing)."""
         import numpy as np
+        from ompi_tpu.core import var
+        monkeypatch.setenv("OMPI_TPU_osc_device_mode", "native")
+        var.registry.reset_cache()
         win = self._win(size=4)
         for i in range(3):
             win.lock(1)
