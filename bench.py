@@ -250,17 +250,33 @@ def _flagship_ab(base_cfg, batch: int, rng) -> list:
                 ("remat=full", {"remat": "full"}),
                 ("adam mu=bf16", {"opt_moment_dtype": "bfloat16"}),
                 ("flash block 512", {"attn_block": 512}),
-                ("flash block 256", {"attn_block": 256})]
+                ("flash block 256", {"attn_block": 256}),
+                # bwd kernels (dq; dk/dv) tile independently (r4 verdict
+                # item 8): sweep their block with the fwd pinned at auto
+                ("flash bwd block 512", {"attn_bwd_block": 512}),
+                ("flash bwd block 256", {"attn_bwd_block": 256})]
     out = []
     for label, delta in variants:
-        if "attn_block" in delta:
-            # a block override clamped to the sequence (or equal to the
-            # auto-pick) would re-measure the baseline under a new label
-            from ompi_tpu.ops.attention import _auto_block
-            eff = min(delta["attn_block"], base_cfg.seq)
-            if eff == min(base_cfg.attn_block or _auto_block(base_cfg.seq),
-                          base_cfg.seq):
-                continue
+        for key in ("attn_block", "attn_bwd_block"):
+            if key in delta:
+                # a block override clamped to the sequence (or equal to
+                # the baseline's effective pick) would re-measure the
+                # baseline under a new label. The bwd baseline mirrors
+                # _flash_mha_bwd's resolution order: bwd override, else
+                # the FWD override, else the bwd auto-pick.
+                from ompi_tpu.ops import attention as _attn
+                eff = min(delta[key], base_cfg.seq)
+                if key == "attn_block":
+                    base = base_cfg.attn_block \
+                        or _attn._auto_block(base_cfg.seq)
+                else:
+                    base = base_cfg.attn_bwd_block or base_cfg.attn_block \
+                        or _attn._auto_block_bwd(base_cfg.seq)
+                if eff == min(base, base_cfg.seq):
+                    delta = None
+                break
+        if delta is None:
+            continue
         cfg = Config(**{**base_cfg.__dict__, **delta})
         try:
             dt, tokens_per_s, _n, _loss = _measure_steps(

@@ -95,13 +95,30 @@ def _auto_block(s: int) -> int:
         f"block_q/block_k explicitly")
 
 
+def _auto_block_bwd(s: int) -> int:
+    """Block auto-pick for the BACKWARD kernels (dq; dk/dv). Tracked
+    separately from the forward pick so an on-chip bwd block sweep (the
+    A/B harness's 'flash bwd block' rows) can retune it without touching
+    the fwd choice; until chip evidence says otherwise it mirrors the
+    forward heuristic (the bwd kernels carry two extra VMEM accumulators,
+    so if anything the sweep is expected to prefer the SAME or one notch
+    smaller block)."""
+    return _auto_block(s)
+
+
 def _block_sizes(s_q: int, s_k: int, block_q: Optional[int],
-                 block_k: Optional[int]) -> Tuple[int, int]:
-    bq = min(block_q or _auto_block(s_q), s_q)
-    bk = min(block_k or _auto_block(s_k), s_k)
+                 block_k: Optional[int],
+                 auto=None, what: str = "blocks") -> Tuple[int, int]:
+    """Resolve (block_q, block_k): explicit override, else ``auto``
+    (default ``_auto_block``), clamped to the sequence and checked for
+    divisibility — the ONE block-resolution invariant, shared by the fwd
+    and bwd paths."""
+    auto = auto or _auto_block
+    bq = min(block_q or auto(s_q), s_q)
+    bk = min(block_k or auto(s_k), s_k)
     if s_q % bq or s_k % bk:
         raise ValueError(f"seq lengths ({s_q},{s_k}) must divide into "
-                         f"blocks ({bq},{bk})")
+                         f"{what} ({bq},{bk})")
     return bq, bk
 
 
@@ -463,23 +480,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_mha(q: jax.Array, k: jax.Array, v: jax.Array,
               causal: bool = False, scale: Optional[float] = None,
               block_q: Optional[int] = None, block_k: Optional[int] = None,
-              interpret: Optional[bool] = None) -> jax.Array:
+              interpret: Optional[bool] = None,
+              bwd_block_q: Optional[int] = None,
+              bwd_block_k: Optional[int] = None) -> jax.Array:
     """Differentiable flash attention over (batch, seq, heads, head_dim).
 
     The train-step entry point: identical math to ``flash_attention`` but
     with a FlashAttention-2 backward (blockwise recompute from the saved
     logsumexp), so ``jax.grad`` through it never materializes the score
-    matrix. Residuals are q, k, v, o, logsumexp — O(batch·seq·heads·d)."""
+    matrix. Residuals are q, k, v, o, logsumexp — O(batch·seq·heads·d).
+
+    ``bwd_block_q``/``bwd_block_k`` tile the BACKWARD kernels
+    independently of the forward (None = the fwd override if set, else
+    ``_auto_block_bwd`` — so existing callers passing only
+    block_q/block_k keep their pre-split behavior): the dq and dk/dv
+    kernels hold extra VMEM accumulators, so their optimum block need
+    not match the forward's — the A/B harness sweeps them separately
+    (the reference's per-path segsize-tuning discipline,
+    coll_tuned_dynamic_file.c:58, applied to kernel blocks)."""
     out, _ = _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k,
-                            interpret)
+                            interpret, bwd_block_q, bwd_block_k)
     return out
 
 
-def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+                   bwd_block_q=None, bwd_block_k=None):
     if interpret is None:
         interpret = _default_interpret()
     if k.dtype != q.dtype or v.dtype != q.dtype:
@@ -506,7 +535,7 @@ def _flash_mha_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
-                   residuals, g):
+                   bwd_block_q, bwd_block_k, residuals, g):
     qf, kf, vf, of, lse, (b, h) = residuals
     if interpret is None:
         interpret = _default_interpret()
@@ -514,7 +543,12 @@ def _flash_mha_bwd(causal, scale, block_q, block_k, interpret,
     s_k = kf.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
-    bq, bk = _block_sizes(s_q, s_k, block_q, block_k)
+    # bwd tiles independently of fwd: explicit bwd override, else the fwd
+    # override (pre-split behavior for callers that only set
+    # block_q/block_k), else the bwd auto-pick
+    bq, bk = _block_sizes(s_q, s_k, bwd_block_q or block_q,
+                          bwd_block_k or block_k,
+                          auto=_auto_block_bwd, what="bwd blocks")
     _check_flash_blocks(bh, s_q, s_k, d, bq, bk, True, "flash_mha_bwd",
                         qf.dtype)
     dof = jnp.moveaxis(g, 2, 1).reshape(bh, s_q, d).astype(qf.dtype)
