@@ -35,7 +35,6 @@ MODE_DELETE_ON_CLOSE = 0x40
 
 _DUMMY = np.zeros(0, np.uint8)
 
-_atomic_mutex = _components.path_mutex
 
 
 class File:
@@ -181,39 +180,34 @@ class File:
                nbytes: int) -> bytes | int:
         runs = self._view_ranges(voff_bytes, nbytes if data is None
                                  else len(data))
-        lock = self.atomicity and runs
-        if lock:
-            # Atomic mode (MPI-4 §14.6.1): each call is atomic relative to
-            # every other rank's calls on the same file. Two layers, because
-            # ranks may be threads of one process (run_ranks) or separate
-            # processes (tpurun): a process-wide per-path mutex serializes
-            # threaded ranks (POSIX record locks are per-process and would
-            # not exclude them — and one thread's unlock/close would drop
-            # another's), and an fcntl byte-range lock mediates processes.
-            # The mutex also guarantees at most one thread holds the fcntl
-            # lock, so intra-process unlock-steals-lock cannot happen.
+        # Writes ALWAYS lock; reads lock only in atomic mode. The write
+        # lock serves two masters: atomic mode (MPI-4 §14.6.1 — each call
+        # atomic relative to every other rank's calls), and the sieved
+        # write path (fbtl data sieving read-modify-writes whole extent
+        # windows including hole bytes, so any concurrent write into a
+        # hole would be silently lost unless every framework write
+        # excludes the RMW — MPI's non-interference guarantee for
+        # non-overlapping writes, §14.6.1 nonatomic case).
+        # The locking lives in components.locked_extent (an intra-process
+        # interval table + an fcntl byte-range lock for processes, with a
+        # lockless fallback on filesystems without byte-range support):
+        # disjoint extents proceed concurrently, overlapping ones
+        # serialize — in threads AND across processes.
+        if data is not None:
+            # (no fsync here: atomicity is inter-process *visibility*,
+            # which the shared page cache + the byte-range lock already
+            # give; durability is MPI_File_sync's job)
+            return _components.locked_writev(self, runs, data)
+        if self.atomicity and runs:
+            # atomic-mode read (MPI-4 §14.6.1): shared fcntl lock against
+            # other processes' atomic writes; the extent table serializes
+            # intra-process overlap (conservatively exclusive)
             import fcntl
             lo = min(o for o, _n in runs)
             hi = max(o + n for o, n in runs)
-            kind = fcntl.LOCK_SH if data is None else fcntl.LOCK_EX
-            _atomic_mutex(self.path).acquire()
-            try:
-                fcntl.lockf(self._fd, kind, hi - lo, lo, 0)
-            except BaseException:
-                _atomic_mutex(self.path).release()
-                raise
-        try:
-            if data is None:                       # read
+            with _components.locked_extent(self, lo, hi, fcntl.LOCK_SH):
                 return self._fbtl.readv(self._fd, runs)
-            # (no fsync here: atomicity is inter-process *visibility*, which
-            # the shared page cache + the byte-range lock already give;
-            # durability is MPI_File_sync's job)
-            return self._fbtl.writev(self._fd, runs, data)
-        finally:
-            if lock:
-                import fcntl
-                fcntl.lockf(self._fd, fcntl.LOCK_UN, hi - lo, lo, 0)
-                _atomic_mutex(self.path).release()
+        return self._fbtl.readv(self._fd, runs)
 
     def read_at(self, offset: int, buf: np.ndarray,
                 count: Optional[int] = None) -> int:

@@ -451,3 +451,145 @@ def test_file_info_hints():
         assert all(run(3, body))
     finally:
         os.unlink(path)
+
+
+# -- data sieving (≙ ROMIO ad_read_str.c / ad_nfs_write.c; r4 verdict
+# missing#4): many-small-hole views read/write the covering extent in a
+# few large windows instead of one syscall per hole --------------------
+
+
+def _sieve_body(path, policy):
+    """One rank writes 64 strided blocks of 2 int32 (stride 8) through a
+    vector view, reads them back strided, and the full file confirms the
+    holes stayed intact."""
+    from ompi_tpu.core import var
+
+    def body(ctx):
+        comm = ctx.comm_world
+        os.environ["OMPI_TPU_io_posix_ds_read"] = policy
+        os.environ["OMPI_TPU_io_posix_ds_write"] = policy
+        os.environ["OMPI_TPU_io_posix_ds_threshold"] = "4"
+        var.registry.reset_cache()
+        try:
+            f = File.open(comm, path, MODE_RDWR | MODE_CREATE)
+            blk, stride, count = 2, 8, 64
+            ft = Datatype.vector(count=count, blocklength=blk,
+                                 stride=stride, base=INT32)
+            # pre-fill so the holes have recognizable contents
+            f.write_at(0, np.full(count * stride, -7, np.int32))
+            f.sync()
+            f.set_view(disp=0, etype=INT32, filetype=ft)
+            data = np.arange(count * blk, dtype=np.int32)
+            f.write_at(0, data)
+            f.sync()
+            got = np.zeros(count * blk, np.int32)
+            f.read_at(0, got)
+            np.testing.assert_array_equal(got, data)
+            f.set_view(disp=0)               # raw byte view
+            full = np.zeros(count * stride, np.int32)
+            f.read_at(0, full)
+            f.close()
+            expect = np.full(count * stride, -7, np.int32)
+            for i in range(count):
+                expect[i * stride:i * stride + blk] = data[i * blk:
+                                                           (i + 1) * blk]
+            np.testing.assert_array_equal(full, expect)
+            return True
+        finally:
+            for k in ("ds_read", "ds_write", "ds_threshold"):
+                os.environ.pop(f"OMPI_TPU_io_posix_{k}", None)
+            var.registry.reset_cache()
+
+    return body
+
+
+@pytest.mark.parametrize("policy", ["enable", "disable", "auto"])
+def test_data_sieving_strided_view_roundtrip(policy):
+    path = _tmppath()
+    try:
+        assert all(run(1, _sieve_body(path, policy)))
+    finally:
+        os.unlink(path)
+
+
+def test_data_sieving_collapses_syscalls(monkeypatch):
+    """The sieve's point: 64 hole-separated runs become ONE pread per
+    window instead of one per run (and the sieved write is one
+    read-modify-write, not 64 pwrites — run here without the caller's
+    extent lock, which single-threaded direct use doesn't need)."""
+    from ompi_tpu.core import var
+    from ompi_tpu.io import components as C
+
+    calls = {"pread": 0, "pwrite": 0}
+    real_pread, real_pwrite = os.pread, os.pwrite
+    monkeypatch.setattr(C.os, "pread",
+                        lambda *a: (calls.__setitem__(
+                            "pread", calls["pread"] + 1),
+                            real_pread(*a))[1])
+    monkeypatch.setattr(C.os, "pwrite",
+                        lambda *a: (calls.__setitem__(
+                            "pwrite", calls["pwrite"] + 1),
+                            real_pwrite(*a))[1])
+    monkeypatch.setenv("OMPI_TPU_io_posix_ds_read", "enable")
+    monkeypatch.setenv("OMPI_TPU_io_posix_ds_write", "enable")
+    var.registry.reset_cache()
+    fbtl = C._PosixFbtl()
+    path = _tmppath()
+    try:
+        fd = os.open(path, os.O_RDWR)
+        runs = [(i * 64, 8) for i in range(64)]   # 64 runs, 56-byte holes
+        payload = bytes(range(256)) * 2
+        os.pwrite(fd, b"\xff" * (64 * 64), 0)     # recognizable holes
+        calls["pread"] = calls["pwrite"] = 0
+        fbtl.writev(fd, runs, payload)
+        assert calls["pwrite"] == 1               # one RMW window
+        assert calls["pread"] == 1
+        calls["pread"] = 0
+        got = fbtl.readv(fd, runs)
+        assert calls["pread"] == 1                # one window read
+        assert got == payload
+        # holes kept their bytes
+        blob = os.pread(fd, 64 * 64, 0)
+        assert blob[8:64] == b"\xff" * 56
+        os.close(fd)
+    finally:
+        var.registry.reset_cache()
+        os.unlink(path)
+
+
+def test_every_write_takes_the_extent_lock(monkeypatch):
+    """Non-atomic writes lock their extent too (not just atomic mode):
+    the sieved write's read-modify-write of hole bytes must exclude every
+    other framework write, or a concurrent disjoint write into a hole
+    would be silently lost (MPI-4 §14.6.1 non-interference)."""
+    import fcntl as _fcntl
+
+    locks = []
+    real = _fcntl.lockf
+
+    def spy(fd, kind, *a):
+        locks.append(kind)
+        return real(fd, kind, *a)
+
+    import fcntl
+    monkeypatch.setattr(fcntl, "lockf", spy)
+    path = _tmppath()
+
+    def body(ctx):
+        f = File.open(ctx.comm_world, path, MODE_RDWR | MODE_CREATE)
+        assert not f.atomicity
+        f.write_at(0, np.arange(8, dtype=np.int32))     # plain write
+        n_after_write = len(locks)
+        got = np.zeros(8, np.int32)
+        f.read_at(0, got)                               # non-atomic read
+        f.close()
+        assert n_after_write >= 2          # EX + UN around the write
+        assert len(locks) == n_after_write  # read took NO lock
+        import fcntl as fc
+        assert fc.LOCK_EX in locks[:n_after_write]
+        return True
+
+    try:
+        assert all(run(1, body))
+    finally:
+        os.unlink(path)
