@@ -1,0 +1,51 @@
+"""SHMEM producer-consumer pipeline via put-with-signal (≙ the
+shmem_put_signal pattern, oshmem/shmem/c/shmem_put_signal.c): each stage
+pushes a chunk AND its ready-flag in ONE one-sided op — the signal is
+ordered after the data, so the consumer needs no fence/quiet/barrier.
+
+Run:  python -m ompi_tpu.tools.tpurun -np 3 examples/shmem_pipeline.py
+"""
+
+import numpy as np
+
+from ompi_tpu import runtime, shmem
+
+CHUNKS = 4
+N = 16
+
+
+def main() -> int:
+    ctx = runtime.init()
+    shmem.init(ctx)
+    me, n = shmem.my_pe(), shmem.n_pes()
+    data = shmem.smalloc((CHUNKS, N), np.float64)
+    sig = shmem.smalloc((1,), np.int64)
+    shmem.barrier_all()          # allocation visible everywhere
+
+    nxt = (me + 1) % n
+    for c in range(CHUNKS):
+        if me != 0:
+            # wait for chunk c from the left — no fence: the signal's
+            # arrival ORDERING is the consistency point
+            shmem.wait_until(sig, "ge", c + 1, timeout=30)
+        if me == n - 1 and n > 1:
+            continue                             # sink: verify below
+        chunk = (np.arange(N, dtype=np.float64) + 100.0 * c if me == 0
+                 else data.local[c] + 1.0)       # stage transform
+        shmem.put_signal(data, chunk, sig, 1, nxt,
+                         offset=c * N, sig_op=shmem.SIGNAL_ADD)
+    shmem.barrier_all()
+    if me == n - 1 and n > 1:
+        # each intermediate stage (1..n-2) added 1.0 exactly once
+        expect = np.arange(N) + 100.0 * (CHUNKS - 1) + (n - 2)
+        got = data.local[CHUNKS - 1]
+        assert np.allclose(got, expect), (got, expect)
+        print(f"pipeline of {n} stages x {CHUNKS} chunks PASSED",
+              flush=True)
+    shmem.finalize()
+    ctx.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

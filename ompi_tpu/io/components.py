@@ -114,14 +114,22 @@ class locked_extent:
         self._locked = False
 
     def __enter__(self):
+        import errno
         import fcntl
         _extent_table(self.f.path).acquire(self.lo, self.hi)
         try:
             fcntl.lockf(self.f._fd, self.kind,
                         self.hi - self.lo, self.lo, 0)
             self._locked = True
-        except OSError:
-            pass                     # FS without byte-range locks
+        except OSError as exc:
+            # ONLY "this FS has no byte-range locks" degrades to the
+            # intra-process-only guarantee; a real failure (EDEADLK,
+            # EINTR, lockd outage) must propagate — swallowing it would
+            # silently void atomic-mode exclusion
+            if exc.errno not in (errno.ENOLCK, errno.EOPNOTSUPP,
+                                 errno.EINVAL):
+                _extent_table(self.f.path).release(self.lo, self.hi)
+                raise
         return self
 
     def __exit__(self, *exc):

@@ -197,6 +197,14 @@ class File:
             # (no fsync here: atomicity is inter-process *visibility*,
             # which the shared page cache + the byte-range lock already
             # give; durability is MPI_File_sync's job)
+            from ..core import var as _var
+            if not self.atomicity and \
+                    _var.get("io_posix_ds_write", "auto") == "disable":
+                # sieving globally off (the policy is env-propagated, so
+                # uniform across ranks): no RMW can exist anywhere to
+                # exclude — skip the per-write lock entirely
+                return self._fbtl.writev(self._fd, runs, data,
+                                         allow_sieve=False)
             return _components.locked_writev(self, runs, data)
         if self.atomicity and runs:
             # atomic-mode read (MPI-4 §14.6.1): shared fcntl lock against

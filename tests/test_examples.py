@@ -44,6 +44,11 @@ def test_example_oshmem():
     assert "symmetric put/verify on 4 PEs PASSED" in out
 
 
+def test_example_shmem_pipeline():
+    out = _tpurun_example("shmem_pipeline.py", np_=3)
+    assert "pipeline of 3 stages x 4 chunks PASSED" in out
+
+
 def test_example_device_allreduce():
     out = _tpurun_example("device_allreduce.py", np_=2,
                           extra=("--device-plane", "cpu"))
