@@ -254,9 +254,18 @@ def _flagship_ab(base_cfg, batch: int, rng) -> list:
                 # bwd kernels (dq; dk/dv) tile independently (r4 verdict
                 # item 8): sweep their block with the fwd pinned at auto
                 ("flash bwd block 512", {"attn_bwd_block": 512}),
-                ("flash bwd block 256", {"attn_bwd_block": 256})]
+                ("flash bwd block 256", {"attn_bwd_block": 256}),
+                # chunked CE: the (b, s, vocab) f32 logits never
+                # materialize whole (~1 GB at flagship shape) — measured
+                # both at the baseline batch (pure overhead check) and
+                # with the freed HBM spent on 2x batch (the MFU lever)
+                ("chunked CE 512", {"loss_chunk": 512}),
+                ("chunked CE 512 + batch x2", {"loss_chunk": 512,
+                                               "_batch": 2})]
     out = []
     for label, delta in variants:
+        delta = dict(delta)
+        batch_mult = delta.pop("_batch", 1)
         for key in ("attn_block", "attn_bwd_block"):
             if key in delta:
                 # a block override clamped to the sequence (or equal to
@@ -280,7 +289,7 @@ def _flagship_ab(base_cfg, batch: int, rng) -> list:
         cfg = Config(**{**base_cfg.__dict__, **delta})
         try:
             dt, tokens_per_s, _n, _loss = _measure_steps(
-                cfg, batch, rng, reps=6)
+                cfg, batch * batch_mult, rng, reps=6)
             out.append({"variant": label, "step_ms": round(dt * 1e3, 2),
                         "tokens_per_s": round(tokens_per_s, 0),
                         "tf_per_s": round(

@@ -56,6 +56,11 @@ class Config:
     #   (dq; dk/dv tile independently of the fwd — they carry extra VMEM
     #   accumulators, so their optimum can sit a notch lower); swept by
     #   the A/B harness's "flash bwd block" rows
+    loss_chunk: Optional[int] = None   # chunked cross-entropy: process the
+    #   sequence in slices of this many positions so the (b, s, vocab)
+    #   float32 logits never materialize whole (jax.checkpoint per slice;
+    #   ~1 GB HBM at the flagship shape). Single-controller path only —
+    #   on a mesh the seq slicing would cross sp shards.
     opt_moment_dtype: str = "float32"  # Adam first-moment dtype; "bfloat16"
     #   halves the mu buffer's HBM (the MFU lever VERDICT r3 item 9 names:
     #   less optimizer traffic on an HBM-bound chip). Second moment stays
@@ -237,10 +242,9 @@ def _remat_wrap(fn, mode: str):
     return fn
 
 
-def forward(params: Dict, tokens: jax.Array, cfg: Config,
-            mesh: Optional[Mesh] = None) -> jax.Array:
-    """tokens: (batch, seq) int32 → logits (batch, seq, vocab); with
-    cfg.mlp == "moe" returns (logits, router_aux_loss)."""
+def _backbone(params: Dict, tokens: jax.Array, cfg: Config,
+              mesh: Optional[Mesh] = None):
+    """tokens (b, s) → (hidden (b, s, d) after final norm, router aux)."""
     x = params["embed"].astype(cfg.dtype)[tokens]      # (b, s, d)
     aux_total = jnp.zeros((), jnp.float32)
     layer_fn = _remat_wrap(
@@ -248,17 +252,71 @@ def forward(params: Dict, tokens: jax.Array, cfg: Config,
     for layer in params["layers"]:
         x, aux = layer_fn(x, layer)
         aux_total = aux_total + aux
-    x = _rms_norm(x, params["final_norm"])
+    return _rms_norm(x, params["final_norm"]), aux_total
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: Config,
+            mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab); with
+    cfg.mlp == "moe" returns (logits, router_aux_loss)."""
+    x, aux_total = _backbone(params, tokens, cfg, mesh)
     logits = x @ params["embed"].astype(cfg.dtype).T   # tied embedding
     logits = logits.astype(jnp.float32)
     return (logits, aux_total) if cfg.mlp == "moe" else logits
 
 
+def _chunked_ce(x: jax.Array, embed: jax.Array, targets: jax.Array,
+                chunk: int) -> jax.Array:
+    """Mean CE WITHOUT ever materializing the full (b, s, vocab) float32
+    logits: the sequence axis is processed in ``chunk``-sized slices, and
+    each slice's projection + logsumexp is wrapped in jax.checkpoint so
+    the backward recomputes its (b, chunk, vocab) logits from the (b,
+    chunk, d) hidden slice instead of saving them. Peak logits memory
+    drops from s/chunk× to 1× per slice — at the flagship shape (seq
+    2048, vocab 32k, f32) that is ~1 GB of HBM freed for batch/remat
+    headroom. The chunked and dense paths are bit-equivalent reductions
+    over the same values (logsumexp is per-position)."""
+    b, s, d = x.shape
+    n = s // chunk
+    xs = x[:, :n * chunk].reshape(b, n, chunk, d).swapaxes(0, 1)
+    ts = targets[:, :n * chunk].reshape(b, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def one(x_c, t_c):                         # (b, chunk, d), (b, chunk)
+        logits = (x_c @ embed.T).astype(jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t_c[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.sum(lse - gold)
+
+    total = jnp.sum(jax.lax.map(lambda a: one(*a), (xs, ts)))
+    if n * chunk < s:                          # ragged tail: same
+        total = total + one(x[:, n * chunk:],  # checkpointed kernel
+                            targets[:, n * chunk:])
+    return total / (b * s)
+
+
 def loss_fn(params: Dict, tokens: jax.Array, cfg: Config,
             mesh: Optional[Mesh] = None) -> jax.Array:
+    targets = tokens[:, 1:]
+    if cfg.loss_chunk:
+        # chunked CE is single-controller, dense-MLP only: seq slicing
+        # would cross sp shards on a mesh, and the MoE loss carries the
+        # router aux term. A silent dense fallback would record
+        # loss_chunk as active while measuring the baseline — refuse
+        # instead
+        if mesh is not None or cfg.mlp == "moe":
+            raise ValueError(
+                "loss_chunk is only supported single-controller with "
+                "mlp='dense' (got "
+                f"mesh={'set' if mesh is not None else None}, "
+                f"mlp={cfg.mlp!r}); unset loss_chunk for this path")
+        x, _ = _backbone(params, tokens[:, :-1], cfg, mesh)
+        ce = _chunked_ce(x, params["embed"].astype(cfg.dtype), targets,
+                         int(cfg.loss_chunk))
+        return ce
     out = forward(params, tokens[:, :-1], cfg, mesh)
     logits, aux = out if cfg.mlp == "moe" else (out, 0.0)
-    targets = tokens[:, 1:]
     # logsumexp-form CE: one (b, s) reduction instead of materializing a
     # second (b, s, vocab) float32 log-probability tensor — at flagship
     # scale that second tensor alone is GBs of HBM
