@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -276,11 +277,15 @@ def run_device_sweep(iters: int, sizes=None):
     return rows, winners
 
 
-def emit_device_rules(winners: dict, path: str) -> None:
+def emit_device_rules(winners: dict, path: str,
+                      platform: str = "unknown") -> None:
     """Winners → a coll/xla dynamic-rules file: one line per mode change
     walking sizes ascending (rules apply at >= min_bytes, later lines win,
-    matching _load_device_rules/_mode semantics)."""
-    lines = ["# device decision rules measured by coll_tune --device",
+    matching _load_device_rules/_mode semantics). The header records the
+    fabric that produced the numbers — a cpu-derived ruleset applied on a
+    real TPU would override the correct native-always platform default."""
+    lines = [f"# device decision rules measured by coll_tune --device "
+             f"on platform={platform}",
              "# <coll> <min_ndev> <min_bytes> <native|staged>"]
     for coll, by_size in winners.items():
         prev = None
@@ -306,14 +311,38 @@ def main(argv=None) -> int:
                     help="Sweep the DEVICE path (native ICI vs staged "
                          "host) and emit coll/xla decision rules.")
     ap.add_argument("--device-rules-out", default="DEVICE_RULES.txt")
+    ap.add_argument("--platform", default=None,
+                    help="Force a jax platform (e.g. cpu). Uses "
+                         "jax.config, NOT the JAX_PLATFORMS env var — "
+                         "on this host the env route still touches the "
+                         "TPU tunnel plugin and hangs when the tunnel "
+                         "is wedged; config wins if set before any "
+                         "backend initializes.")
     args = ap.parse_args(argv)
+    if args.platform and not args.device:
+        ap.error("--platform only applies to --device (the host sweep "
+                 "never initializes jax)")
 
     if args.device:
+        if args.platform == "cpu" and "host_platform_device_count" \
+                not in os.environ.get("XLA_FLAGS", ""):
+            # a 1-device cpu sweep would emit degenerate rules (native
+            # arms become no-ops over a size-1 axis) — force the 8-way
+            # virtual mesh exactly as bench.py does
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8").strip()
         import jax
 
+        if args.platform:
+            jax.config.update("jax_platforms", args.platform)
+
         rows, winners = run_device_sweep(args.iters)
-        emit_device_rules(winners, args.device_rules_out)
+        platform = jax.devices()[0].platform
+        emit_device_rules(winners, args.device_rules_out,
+                          platform=platform)
         out = {"ndev": len(jax.devices()), "iters": args.iters,
+               "platform": platform,
                "winners": {c: {str(k): v for k, v in w.items()}
                            for c, w in winners.items()},
                "results": rows}
