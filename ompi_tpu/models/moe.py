@@ -128,8 +128,10 @@ def moe_block(h: jax.Array, params: Dict, n_experts: int, top_k: int = 2,
 # router's per-token expert ids (a few bytes/token, the decision metadata
 # any dropless router exchanges) from which the counts matrix and gather
 # maps are derived. All data movement is cached ICI programs
-# (DeviceComm.row_gather + alltoallv), and routing changes hit the same
-# executables because the maps travel as device arguments.
+# (DeviceComm.row_gather + alltoallv_from_rows — the sliced dense-rows
+# exchange, so no padded (R, R, cap) block tensor ever materializes), and
+# routing changes hit the same executables because the maps travel as
+# device arguments.
 
 
 def ragged_ep_route(dc, tokens, owner: np.ndarray):
@@ -147,42 +149,28 @@ def ragged_ep_route(dc, tokens, owner: np.ndarray):
     owner = np.asarray(owner)
     R, T = owner.shape
     C = np.stack([np.bincount(owner[i], minlength=R) for i in range(R)])
-    cap = dc._bucket(int(C.max()) if C.size else 1)
-    # one stable argsort per row builds every block map (no per-(i,j)
-    # scans): token t of rank i lands at slot (owner, position-in-segment)
-    send_idx = np.full((R, R, cap), -1, np.int32)
+    # one stable argsort per row puts every rank's tokens DENSE in
+    # destination order — exactly the alltoallv_from_rows send layout,
+    # so the (R, R, cap) padded block tensor never materializes (it was
+    # both the route's and the combine's peak-HBM term; the sliced
+    # exchange keeps the transient to O(R·slice) per device)
     orders = np.argsort(owner, axis=1, kind="stable")     # (R, T)
-    starts = np.concatenate(
-        [np.zeros((R, 1), np.int64), np.cumsum(C, axis=1)[:, :-1]], axis=1)
-    for i in range(R):
-        order = orders[i]
-        seg_pos = np.arange(T) - starts[i, owner[i, order]]
-        send_idx[i, owner[i, order], seg_pos] = order
-    blocks = dc.row_gather(tokens, send_idx.reshape(R, R * cap))
-    blocks = blocks.reshape((R, R, cap) + tokens.shape[2:])
-    recv, recv_counts = dc.alltoallv(blocks, C)
-    return recv, recv_counts, {"C": C, "cap": cap, "owner": owner,
-                               "orders": orders}
+    sorted_tokens = dc.row_gather(tokens, orders.astype(np.int32))
+    recv, recv_counts = dc.alltoallv_from_rows(sorted_tokens, C)
+    return recv, recv_counts, {"C": C, "owner": owner, "orders": orders}
 
 
 def ragged_ep_combine(dc, outputs, ctx):
     """Inverse route: expert outputs (R, cap_out, d) — same padded layout
     ragged_ep_route returned — back to (R, T, d) in original token order
     (the transposed-counts alltoallv)."""
-    C, cap, owner = ctx["C"], ctx["cap"], ctx["owner"]
+    C, owner = ctx["C"], ctx["owner"]
     R, T = owner.shape
-    # received row j is contiguous source segments: seg i starts at
-    # sum(C[:i, j])
-    seg_start = np.concatenate(
-        [np.zeros((1, R), np.int64), np.cumsum(C, axis=0)[:-1]], axis=0)
-    back_idx = np.full((R, R, cap), -1, np.int32)
-    ar = np.arange(cap)
-    for j in range(R):
-        m = ar[None, :] < C[:, j, None]                  # (R, cap) valid
-        back_idx[j][m] = (seg_start[:, j, None] + ar[None, :])[m]
-    bblocks = dc.row_gather(outputs, back_idx.reshape(R, R * cap))
-    bblocks = bblocks.reshape((R, R, cap) + outputs.shape[2:])
-    returned, _ = dc.alltoallv(bblocks, C.T)
+    # received row j IS already dense contiguous source segments ordered
+    # by source — which is precisely the alltoallv_from_rows send layout
+    # for the transposed counts: no block formation at all on the way
+    # back
+    returned, _ = dc.alltoallv_from_rows(outputs, C.T)
     # returned row i: own tokens ordered by (owner, original order) —
     # invert the route's stable sort (carried in ctx) to restore positions
     order = np.empty((R, T), np.int32)

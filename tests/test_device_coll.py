@@ -855,3 +855,77 @@ class Test32RanksOn8Devices:
             np.testing.assert_allclose(
                 got[5, recv_off:recv_off + c],
                 host_rows[i, send_off:send_off + c], rtol=1e-6)
+
+
+@pytest.mark.parametrize("slice_cap", [None, 2, 3, 64])
+def test_alltoallv_from_rows_matches_block_form(dc, slice_cap):
+    """The dense-rows sliced exchange produces EXACTLY the block-form
+    alltoallv result without ever materializing the (R, R, cap) padding
+    (the r4/r5 sweep-truncation shape)."""
+    rng = np.random.default_rng(11)
+    per = 5
+    vbase = [(per - 2) if j % 2 == 0 else (per + 2) for j in range(N)]
+    C = np.stack([np.roll(vbase, -i) for i in range(N)])
+    rows = rng.normal(size=(N, int(C.sum(axis=1).max()))
+                      ).astype(np.float32)
+    cap = dc._bucket(int(C.max()))
+    blocks = dc.pack_ragged_blocks(rows, C, cap)
+    xb = jax.device_put(jnp.asarray(blocks), dc.sharding())
+    want, want_counts = dc.alltoallv(xb, C)
+    xr = jax.device_put(jnp.asarray(rows), dc.sharding())
+    got, got_counts = dc.alltoallv_from_rows(xr, C, slice_cap=slice_cap)
+    assert got_counts == want_counts
+    np.testing.assert_allclose(np.asarray(jax.device_get(got)),
+                               np.asarray(jax.device_get(want)),
+                               rtol=1e-6)
+
+
+def test_alltoallv_from_rows_with_elem_dims(dc):
+    """EP-shaped payloads: ragged token blocks with a trailing feature
+    dim route identically through the dense-rows form."""
+    rng = np.random.default_rng(3)
+    d = 4
+    C = rng.integers(0, 4, size=(N, N))
+    L = max(1, int(C.sum(axis=1).max()))
+    rows = rng.normal(size=(N, L, d)).astype(np.float32)
+    cap = dc._bucket(max(1, int(C.max())))
+    blocks = np.zeros((N, N, cap, d), np.float32)
+    for i in range(N):
+        off = 0
+        for j in range(N):
+            c = int(C[i, j])
+            blocks[i, j, :c] = rows[i, off:off + c]
+            off += c
+    xb = jax.device_put(jnp.asarray(blocks), dc.sharding())
+    want, _ = dc.alltoallv(xb, C)
+    xr = jax.device_put(jnp.asarray(rows), dc.sharding())
+    got, _ = dc.alltoallv_from_rows(xr, C, slice_cap=2)
+    np.testing.assert_allclose(np.asarray(jax.device_get(got)),
+                               np.asarray(jax.device_get(want)),
+                               rtol=1e-6)
+
+
+def test_alltoallv_from_rows_cache_not_stale_across_caps(dc):
+    """Same shapes + slice_cap but a LARGER max count must not reuse a
+    scan executable compiled with fewer slices (it would silently zero
+    the tail — caught by review in round 5; k is in the cache key)."""
+    d0 = np.zeros((N, N), np.int64)
+    C1 = d0 + 1
+    np.fill_diagonal(C1, 2)               # max 2 → k=1 at slice_cap=2
+    C2 = d0 + 1
+    np.fill_diagonal(C2, 3)               # max 3 → k=2 at slice_cap=2
+    L = max(int(C1.sum(axis=1).max()), int(C2.sum(axis=1).max()))
+    rng = np.random.default_rng(5)
+    rows = rng.normal(size=(N, L)).astype(np.float32)
+    x = jax.device_put(jnp.asarray(rows), dc.sharding())
+    dc.alltoallv_from_rows(x, C1, slice_cap=2)      # warm a k=1 program
+    got, _ = dc.alltoallv_from_rows(x, C2, slice_cap=2)
+    host = np.asarray(jax.device_get(got))
+    for j in range(N):
+        pos = 0
+        for i in range(N):
+            c = int(C2[i, j])
+            off = int(C2[i, :j].sum())
+            np.testing.assert_allclose(host[j, pos:pos + c],
+                                       rows[i, off:off + c], rtol=1e-6)
+            pos += c
