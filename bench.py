@@ -583,6 +583,32 @@ def run_sweep(platform: str) -> dict:
                     y.reshape(rows, rows, count // rows)).reshape(
                         rows, count),
             }.get(coll)
+            chain_inputs = xs
+            if coll == "allgatherv" and int(vxs[0].shape[1]) > sum(
+                    counts_list):
+                pass          # bucketed cap exceeds the gathered total:
+                #             the carry slice couldn't refill the padded
+                #             input; leave the row single-op (latent at
+                #             rows=2 with non-power-of-two sizes)
+            elif coll == "allgatherv":
+                # carry back to the (R, cap) padded input: the first cap
+                # columns of the gathered row carry the payload; one
+                # element from every source's segment start keeps every
+                # shard's contribution live (displs are static ints)
+                vcap_ag = int(vxs[0].shape[1])
+                ag_displs = np.concatenate(
+                    [[0], np.cumsum(counts_list)[:-1]]).astype(np.int32)
+                chain_step = lambda y: (
+                    lambda g: g[:, :vcap_ag]
+                    + g[:, ag_displs].sum(axis=1, keepdims=True))(
+                        dc.allgatherv(y, counts_list))
+                chain_inputs = vxs
+            elif coll == "alltoallv_rows":
+                # the dense-rows output's valid region per row is exactly
+                # count (conserving circulant), so the carry consumes
+                # every received element — fully data-dependent
+                chain_step = lambda y: dc.alltoallv_from_rows(
+                    y, vC)[0][:, :count]
             if chain_step is not None:
                 CHAIN_K = 8
 
@@ -594,7 +620,8 @@ def run_sweep(platform: str) -> dict:
 
                 cj = jax.jit(chain_fn)
                 try:
-                    chained = lambda k: _settle(cj(xs[k % len(xs)]))
+                    chained = lambda k: _settle(
+                        cj(chain_inputs[k % len(chain_inputs)]))
                     ct = _time_op(chained, max_reps=max_reps) / CHAIN_K
                     row["device_us_chained"] = round(ct * 1e6, 1)
                     row["device_GBps_chained"] = round(
