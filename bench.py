@@ -473,25 +473,15 @@ def run_sweep(platform: str) -> dict:
                     row_nbytes = nbytes
                     coll = "alltoallv_rows"
 
-                    soff_h = np.zeros((rows, rows), np.int64)
-                    soff_h[:, 1:] = np.cumsum(vC, axis=1)[:, :-1]
-
                     def staged(k):
                         # fair host arm: direct dense row→row reshuffle
                         # (O(total) segment copies) — packing into the
                         # >128 MiB padded block tensor would charge the
                         # host path work the dense exchange never does
                         h = np.asarray(jax.device_get(xs[k % len(xs)]))
-                        out = np.zeros((rows, out_cap), np.float32)
-                        for j in range(rows):
-                            pos = 0
-                            for i in range(rows):
-                                c = int(vC[i, j])
-                                out[j, pos:pos + c] = \
-                                    h[i, soff_h[i, j]:soff_h[i, j] + c]
-                                pos += c
-                        _settle(jax.device_put(jnp.asarray(out),
-                                               dc.sharding()))
+                        _settle(jax.device_put(jnp.asarray(
+                            dc.compact_from_rows(h, vC, out_cap)),
+                            dc.sharding()))
                 else:
                     bxs = [jax.device_put(jnp.asarray(
                         dc.pack_ragged_blocks(host_rows + np.float32(i),

@@ -432,26 +432,53 @@ class XlaModule(CollModule):
         return self.host.basic.scatterv(comm, self._to_host(sendbuf),
                                         recvbuf, counts, displs, root)
 
+    @staticmethod
+    def _check_recvcounts(C, recvcounts):
+        if recvcounts is None:
+            return
+        RC = np.asarray(recvcounts)
+        # accept either the per-destination totals vector or the stacked
+        # per-rank matrix (row j = what j receives from each source, C.T)
+        ok = (np.array_equal(RC, C.T) if RC.ndim == 2
+              else np.array_equal(RC.ravel(), C.sum(axis=0)))
+        if not ok:
+            raise ValueError(
+                "alltoallv: recvcounts disagree with sendcounts "
+                f"({recvcounts} vs column sums "
+                f"{C.sum(axis=0).tolist()})")
+
     def alltoallv(self, comm, sendbuf, recvbuf, sendcounts, recvcounts,
                   sdispls=None, rdispls=None):
         C = np.asarray(sendcounts)
         if (recvbuf is None and sdispls is None and rdispls is None
                 and C.ndim == 2 and C.shape[0] == C.shape[1]
+                and self._rows_ok(sendbuf, 2) and sendbuf.ndim in (2, 3)
+                and (sendbuf.ndim == 2
+                     or sendbuf.shape[1] != sendbuf.shape[0])
+                and sendbuf.shape[0] == C.shape[0]
+                and sendbuf.shape[1] >= int(C.sum(axis=1).max())):
+            # DENSE-ROWS form — MPI's actual buffer layout (contiguous
+            # sends in destination order, default displacements), with
+            # optional trailing elem dims (the EP token shape): the
+            # sliced exchange never materializes the (R, R, cap) padded
+            # blocks (alltoallv_from_rows; round-5). The one ambiguous
+            # 3-D shape (L == R, indistinguishable from padded blocks)
+            # keeps the block interpretation below.
+            self._check_recvcounts(C, recvcounts)
+            if self._mode("alltoallv", sendbuf) == "staged":
+                h = self._stage_out(sendbuf)           # (R, L, *e)
+                out_cap = self.dc._bucket(
+                    int(C.sum(axis=0).max()) if C.size else 1)
+                return self._stage_in(
+                    self.dc.compact_from_rows(h, C, out_cap))
+            out, _tot = self.dc.alltoallv_from_rows(sendbuf, C)
+            return out
+        if (recvbuf is None and sdispls is None and rdispls is None
+                and C.ndim == 2 and C.shape[0] == C.shape[1]
                 and self._rows_ok(sendbuf, 3)
                 and sendbuf.shape[0] == sendbuf.shape[1] == C.shape[0]
                 and sendbuf.shape[2] >= int(C.max())):
-            if recvcounts is not None:
-                RC = np.asarray(recvcounts)
-                # accept either the per-destination totals vector or the
-                # stacked per-rank matrix (row j = what j receives from
-                # each source, i.e. C.T)
-                ok = (np.array_equal(RC, C.T) if RC.ndim == 2
-                      else np.array_equal(RC.ravel(), C.sum(axis=0)))
-                if not ok:
-                    raise ValueError(
-                        "alltoallv: recvcounts disagree with sendcounts "
-                        f"({recvcounts} vs column sums "
-                        f"{C.sum(axis=0).tolist()})")
+            self._check_recvcounts(C, recvcounts)
             if self._mode("alltoallv", sendbuf) == "staged":
                 h = self._stage_out(sendbuf)       # (R, R, cap, *e)
                 out_cap = self.dc._bucket(

@@ -910,6 +910,28 @@ class DeviceComm:
         out = self._compiled(key, build)(x, idx_dev)
         return out, [int(t) for t in recv_tot]
 
+    @staticmethod
+    def compact_from_rows(rows: np.ndarray, C: np.ndarray,
+                          out_cap: int) -> np.ndarray:
+        """Host oracle/staged arm for :meth:`alltoallv_from_rows`: dense
+        per-rank send rows + counts matrix → the compact padded receive
+        rows, by direct O(total) segment copies (no padded block
+        intermediate). One implementation shared by the coll/xla staged
+        arm, the bench, and tests."""
+        rows = np.asarray(rows)
+        C = np.asarray(C, dtype=np.int64)
+        R = C.shape[0]
+        soff = np.zeros((R, R), np.int64)
+        soff[:, 1:] = np.cumsum(C, axis=1)[:, :-1]
+        out = np.zeros((R, int(out_cap)) + rows.shape[2:], rows.dtype)
+        for j in range(R):
+            pos = 0
+            for i in range(R):
+                c = int(C[i, j])
+                out[j, pos:pos + c] = rows[i, soff[i, j]:soff[i, j] + c]
+                pos += c
+        return out
+
     def alltoallv_from_rows(self, x: jax.Array, counts,
                             slice_cap: Optional[int] = None
                             ) -> Tuple[jax.Array, list]:
