@@ -45,7 +45,7 @@ import numpy as np
 NORTH_STAR_COUNT = 4 * 1024 * 1024          # float32[4M] per rank
 SIZES = [2, 256, 16 * 1024, 262_144, NORTH_STAR_COUNT, 16 * 1024 * 1024]
 # counts of float32 → 8B, 1KB, 64KB, 1MB, 16MB, 64MB per rank
-COLLS = ["allreduce", "bcast", "allgather", "alltoall",
+COLLS = ["allreduce", "bcast", "allgather", "reduce_scatter", "alltoall",
          "allgatherv", "alltoallv"]
 
 
@@ -396,6 +396,24 @@ def run_sweep(platform: str) -> dict:
                     _settle(jax.device_put(
                         jnp.asarray(np.broadcast_to(h[0], h.shape)),
                         dc.sharding()))
+            elif coll == "reduce_scatter" and count % rows == 0:
+                dev = lambda k: _settle(dc.reduce_scatter(
+                    xs[k % len(xs)], SUM))
+                ref = None
+
+                def staged(k):
+                    h = np.asarray(jax.device_get(xs[k % len(xs)]))
+                    red = h.sum(axis=0, dtype=np.float32)
+                    _settle(jax.device_put(jnp.asarray(
+                        red.reshape(rows, count // rows)),
+                        dc.sharding()))
+            elif coll == "reduce_scatter":
+                results.append({
+                    "collective": coll, "bytes_per_rank": nbytes,
+                    "ranks": rows,
+                    "skipped": f"count {count} not divisible by {rows} "
+                               f"ranks"})
+                continue
             elif coll == "allgather":
                 # dedup layout: one gathered copy per DEVICE (ranks on the
                 # same chip share it) — the reference's per-process memory
@@ -524,6 +542,7 @@ def run_sweep(platform: str) -> dict:
             bus_factor = {
                 "allreduce": 2 * (rows - 1) / rows,
                 "bcast": 1.0,
+                "reduce_scatter": (rows - 1) / rows,
                 "allgather": float(rows - 1),
                 "allgatherv": float(rows - 1),
                 "alltoall": (rows - 1) / rows,
@@ -572,6 +591,12 @@ def run_sweep(platform: str) -> dict:
                 "alltoall": lambda y: dc.alltoall(
                     y.reshape(rows, rows, count // rows)).reshape(
                         rows, count),
+                # refill: tile the scattered block back across the carry
+                # (an extra (R, count) write per step, same class as the
+                # allgather chain's fold — noted, not hidden)
+                "reduce_scatter": lambda y: jnp.tile(
+                    dc.reduce_scatter(y, SUM).reshape(rows, -1),
+                    (1, rows)),
             }.get(coll)
             chain_inputs = xs
             if coll == "allgatherv" and int(vxs[0].shape[1]) > sum(
