@@ -649,6 +649,77 @@ def run_sweep(platform: str) -> dict:
                 except Exception as exc:
                     row["chain_error"] = (f"{type(exc).__name__}: "
                                           f"{exc}".splitlines()[0][:200])
+            # Quantized third arm (coll/quant): the same payload through
+            # the block-quantized tier — int8 + per-block scales on the
+            # wire. Only meaningful with a real axis (ndev > 1; the
+            # single-chip local-fold regime has no wire to compress).
+            # Every row carries its numerics (max-abs-err relative to the
+            # f32 reference, SNR) so coll_tune only emits a quant rule
+            # with the error bar on record, plus the exact wire-byte
+            # ratio from quant.wire_bytes.
+            if coll in ("allreduce", "reduce_scatter") and ndev > 1:
+                try:
+                    from ompi_tpu.coll import quant as _q
+                    qc = dc.quant
+                    qred = host_rows.sum(axis=0, dtype=np.float32)
+                    if coll == "allreduce":
+                        qdev = lambda k: _settle(qc.allreduce(
+                            xs[k % len(xs)], SUM))
+                        qref = qred
+                        qgot = np.asarray(jax.device_get(
+                            qc.allreduce(x, SUM)))[rows - 1]
+                        qchain = lambda y: qc.allreduce(y, SUM)
+                    else:
+                        qdev = lambda k: _settle(qc.reduce_scatter(
+                            xs[k % len(xs)], SUM))
+                        qref = qred.reshape(rows, count // rows)
+                        qgot = np.asarray(jax.device_get(
+                            qc.reduce_scatter(x, SUM)))
+                        # same refill idiom as the native chain row
+                        qchain = lambda y: jnp.tile(
+                            qc.reduce_scatter(y, SUM).reshape(rows, -1),
+                            (1, rows))
+                    scale_ref = float(np.max(np.abs(qref))) or 1.0
+                    noise = float(np.sum((qgot - qref) ** 2))
+                    sig = float(np.sum(qref.astype(np.float64) ** 2))
+                    wb = _q.wire_bytes(coll, count, ndev, np.float32)
+                    if (coll == "allreduce" and nbytes >= 1 << 20):
+                        # the headline byte-accounting contract: at >= 1
+                        # MiB/rank the quantized chain moves <= ~0.3x the
+                        # native f32 bytes (1/4 payload + scale overhead)
+                        assert wb["ratio"] <= 0.3, (
+                            f"quant wire ratio {wb['ratio']:.4f} > 0.3 at "
+                            f"{nbytes}B/rank")
+                    qt = _time_op(qdev, max_reps=max_reps)
+                    row.update({
+                        "device_us_quant": round(qt * 1e6, 1),
+                        "device_GBps_quant": round(
+                            row_nbytes / qt / 1e9, 3),
+                        "busbw_GBps_quant": round(
+                            bus_factor * row_nbytes / qt / 1e9, 3),
+                        "quant_bytes_ratio": round(wb["ratio"], 4),
+                        "quant_max_abs_err_rel": round(
+                            float(np.max(np.abs(qgot - qref))) / scale_ref,
+                            6),
+                        "quant_snr_db": round(float(
+                            10 * np.log10(sig / max(noise, 1e-30))), 1),
+                    })
+                    qcj = jax.jit(lambda y: jax.lax.scan(
+                        lambda c, _: (qchain(c), None), y, None,
+                        length=8)[0])
+                    qct = _time_op(
+                        lambda k: _settle(qcj(xs[k % len(xs)])),
+                        max_reps=max_reps) / 8
+                    row.update({
+                        "device_us_quant_chained": round(qct * 1e6, 1),
+                        "busbw_GBps_quant_chained": round(
+                            bus_factor * row_nbytes / qct / 1e9, 3),
+                    })
+                except AssertionError:
+                    raise
+                except Exception as exc:
+                    row["quant_error"] = (f"{type(exc).__name__}: "
+                                          f"{exc}".splitlines()[0][:200])
             results.append(row)
     # device-resident one-sided: steady-state fence latency for a halo-ish
     # epoch (2 puts + 1 accumulate + 1 get per fence), swept 16 KB – 16 MB
@@ -981,24 +1052,32 @@ def update_baseline_md(sweep: dict) -> None:
         "utilization across different collectives:",
         "",
         "| collective | bytes/rank | device µs | chained µs/op | "
-        "staged µs | chained GB/s | chained busbw | speedup |",
-        "|---|---|---|---|---|---|---|---|",
+        "staged µs | chained GB/s | chained busbw | "
+        "quant µs/op (byte-ratio, rel-err) | speedup |",
+        "|---|---|---|---|---|---|---|---|---|",
     ]
     for r in sweep["results"]:
         if "skipped" in r:
             lines.append(
                 f"| {r['collective']} | {r['bytes_per_rank']} | "
-                f"*skipped: {r['skipped']}* | | | | | |")
+                f"*skipped: {r['skipped']}* | | | | | | |")
         else:
             ch_us = r.get("device_us_chained", "—")
             ch_gb = r.get("device_GBps_chained", "—")
             ch_bb = r.get("busbw_GBps_chained", "—")
             sp = r.get("speedup_vs_staged")
+            q_us = r.get("device_us_quant_chained",
+                         r.get("device_us_quant"))
+            if q_us is not None:
+                q_cell = (f"{q_us} ({r['quant_bytes_ratio']}×B, "
+                          f"{r['quant_max_abs_err_rel']:.0e})")
+            else:
+                q_cell = "—"
             lines.append(
                 f"| {r['collective']} | {r['bytes_per_rank']} | "
                 f"{r['device_us']} | {ch_us} | "
                 f"{r.get('staged_us') or '—'} | "
-                f"{ch_gb} | {ch_bb} | "
+                f"{ch_gb} | {ch_bb} | {q_cell} | "
                 f"{f'{sp}×' if sp is not None else '—'} |")
     lines += ["", end]
     block = "\n".join(lines)

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+from ompi_tpu.jaxcompat import shard_map
 
 
 def cg_solver(mesh: Mesh, n: int, iters: int):

@@ -13,7 +13,9 @@ Components in-tree:
   * ``tuned``    — algorithm library + size-based decision rules
                    (≙ coll/base + coll/tuned)
   * ``xla``      — ICI-native device collectives for communicators that map
-                   onto a TPU mesh (replaces coll/accelerator host staging)
+                   onto a TPU mesh (replaces coll/accelerator host staging);
+                   its decision layer also owns the block-quantized tier
+                   (``coll/quant``) as a third arm next to native/staged
 """
 
 from __future__ import annotations
@@ -106,7 +108,10 @@ def _ensure_components() -> None:
     analog of the reference opening a framework's components before any
     selection (mca_base_framework.c:161)."""
     import importlib
-    for m in ("basic", "selfcoll", "tuned", "xla", "nbc", "adapt"):
+    # "quant" is not a Component — importing it registers the quantized
+    # tier's vars (block size, scale dtype, OMPI_TPU_COLL_QUANT) so env
+    # overrides and tpu_info see them; coll/xla dispatches into it
+    for m in ("basic", "selfcoll", "tuned", "xla", "nbc", "adapt", "quant"):
         try:
             importlib.import_module(f"{__package__}.{m}")
         except ImportError:  # pragma: no cover — reduced build

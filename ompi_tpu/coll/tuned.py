@@ -68,7 +68,16 @@ from .framework import CollModule
 
 def _sum_default(op):
     from .. import op as _op
-    return op or _op.SUM
+    op = op or _op.SUM
+    if op.name == "avg":
+        # decision plumbing for the quantized device tier: AVG has no
+        # pairwise fold, so no host algorithm can carry it — only the
+        # device plane's coll/quant arm (which finalizes sum/size) can.
+        raise ValueError(
+            "AVG reductions are only implemented by the quantized device "
+            "tier (coll/quant); host buffers must use SUM and divide, or "
+            "move to the device plane")
+    return op
 
 
 # ---------------------------------------------------------------------------

@@ -50,19 +50,37 @@ def _is_device(x) -> bool:
 
 _var.register("coll", "xla", "mode", "", type=str, level=3,
               help="Force device-collective mode for every entry: "
-                   "native|staged (empty = per-entry decision).")
+                   "native|staged|quant (empty = per-entry decision; "
+                   "quant applies to entries with a quantized arm, "
+                   "others keep the auto decision).")
 _var.register("coll", "xla", "dynamic_rules", "", type=str, level=4,
               help="Path to a device decision rules file: lines of "
-                   "'<coll> <min_ndev> <min_bytes> <native|staged>'.")
+                   "'<coll> <min_ndev> <min_bytes> "
+                   "<native|staged|quant>'.")
+# the blanket quantization switch (env OMPI_TPU_COLL_QUANT):
+#   on/force -> quantize every eligible reduction at any size
+#   off      -> never pick quant, even when a rules file says so
+#   (empty)  -> rules decide, subject to the min_bytes floor below
+_var.register("COLL_QUANT", "", "", "", type=str, level=2,
+              help="Blanket switch for the block-quantized device tier: "
+                   "on/force | off | empty (rules decide).")
+_var.register("coll", "quant", "min_bytes", 1 << 20, type=int, level=3,
+              help="Per-rank byte floor below which rule-selected quant "
+                   "keeps the exact arm (small messages are latency-, "
+                   "not wire-bound; quantization error buys nothing).")
 
 _DECIDED = ("allreduce", "reduce", "bcast", "allgather", "alltoall",
             "reduce_scatter_block", "scan", "exscan", "allgatherv",
             "gather", "gatherv", "scatter", "scatterv", "alltoallv",
             "reduce_scatter")
+# entries with a quantized arm (coll/quant engine entry points)
+_QUANT_COLLS = ("allreduce", "reduce_scatter_block", "reduce_scatter",
+                "allgather")
 for _c in _DECIDED:
     _var.register("coll", "xla", f"{_c}_mode", "", type=str, level=3,
-                  help=f"Force the {_c} device mode (native|staged; "
-                       "empty = auto).")
+                  help=f"Force the {_c} device mode (native|staged"
+                       + ("|quant" if _c in _QUANT_COLLS else "")
+                       + "; empty = auto).")
 
 
 def _load_device_rules():
@@ -88,10 +106,10 @@ def _load_device_rules():
                         f"{path}:{lineno}: bad device rule {line!r} "
                         "(want '<coll> <min_ndev> <min_bytes> "
                         f"<native|staged>'): {exc}") from None
-                if mode not in ("native", "staged"):
+                if mode not in ("native", "staged", "quant"):
                     raise ValueError(
                         f"{path}:{lineno}: unknown device mode {mode!r} "
-                        "(want native or staged)")
+                        "(want native, staged or quant)")
                 rules.append((coll, min_ndev, min_bytes, mode))
     return rules
 
@@ -132,19 +150,39 @@ class XlaModule(CollModule):
 
     # -- decision (native ICI program vs measured host staging) -------------
 
-    def _mode(self, coll: str, x) -> str:
-        """Pick per (collective, PER-RANK bytes) — the unit the sweep
-        measures and the rules file records (a canonical array's row 0 is
-        one rank's buffer), so thresholds line up with the evidence."""
-        forced = _var.get("coll_xla_mode", "") or \
-            _var.get(f"coll_xla_{coll}_mode", "")
+    def _mode(self, coll: str, x, op: Op = None) -> str:
+        """Pick per (collective, PER-RANK bytes, dtype) — the unit the
+        sweep measures and the rules file records (a canonical array's
+        row 0 is one rank's buffer), so thresholds line up with the
+        evidence. Three arms: native ICI program, measured host staging,
+        and the block-quantized tier (coll/quant) for float reductions."""
+        from .quant import check_quantizable
+
+        qvar = str(_var.get("COLL_QUANT", "") or "").strip().lower()
+        ent = _var.get(f"coll_xla_{coll}_mode", "")
+        forced = ent or _var.get("coll_xla_mode", "")
         if forced:
-            if forced not in ("native", "staged"):
+            if forced not in ("native", "staged", "quant"):
                 raise ValueError(
                     f"coll_xla mode for {coll!r} is {forced!r} "
-                    "(want native or staged)")
-            return forced
+                    "(want native, staged or quant)")
+            if forced != "quant":
+                return forced
+            if coll in _QUANT_COLLS:
+                # invalid op/dtype under an explicit quant force must
+                # fail loudly, not silently take the exact path
+                check_quantizable(op or SUM, x.dtype)
+                return "quant"
+            if ent:
+                raise ValueError(
+                    f"collective {coll!r} has no quantized arm "
+                    f"(quant applies to {', '.join(_QUANT_COLLS)})")
+            # global quant force: entries without a quantized arm keep
+            # the auto decision below
         nbytes = x.nbytes // max(x.shape[0], 1)
+        if qvar in ("1", "on", "true", "yes", "force") \
+                and self._quant_ok(coll, x, op):
+            return "quant"
         if self._platform == "cpu":
             # sweep-derived (BENCH_SWEEP_cpu_8dev.json): dense alltoall
             # staged wins 1KB-16MB/rank on the CPU fabric; all else native
@@ -152,10 +190,22 @@ class XlaModule(CollModule):
                                 and nbytes < (32 << 20)) else "native"
         else:
             pick = "native"       # staging crosses the host bridge
+        quant_off = qvar in ("0", "off", "false", "no")
+        floor = int(_var.get("coll_quant_min_bytes", 1 << 20))
         for c, mn, mb, mode in self._rules:
             if c == coll and self.dc.n >= mn and nbytes >= mb:
+                if mode == "quant" and (quant_off or nbytes < floor
+                                        or not self._quant_ok(coll, x, op)):
+                    continue      # rule doesn't apply; keep prior pick
                 pick = mode
         return pick
+
+    def _quant_ok(self, coll: str, x, op: Op = None) -> bool:
+        """Whether the quantized arm can carry this call at all
+        (decision-level gate; the engine re-checks and raises)."""
+        from ..op import quantizable
+
+        return coll in _QUANT_COLLS and quantizable(op or SUM, x.dtype)
 
     def _stage_out(self, x) -> np.ndarray:
         """The explicit D2H half of the staged arm (SPC-accounted);
@@ -187,8 +237,10 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.allreduce(comm, sendbuf, recvbuf, op)
-        if op.name in _NP_FOLD and \
-                self._mode("allreduce", sendbuf) == "staged":
+        mode = self._mode("allreduce", sendbuf, op)
+        if mode == "quant":
+            return self.dc.quant.allreduce(sendbuf, op)
+        if op.name in _NP_FOLD and mode == "staged":
             h = self._stage_out(sendbuf)
             red = _NP_FOLD[op.name](h, axis=0)
             return self._stage_in(np.broadcast_to(red, h.shape))
@@ -216,7 +268,10 @@ class XlaModule(CollModule):
     def allgather(self, comm, sendbuf, recvbuf=None):
         if not _is_device(sendbuf):
             return self.host.allgather(comm, sendbuf, recvbuf)
-        if self._mode("allgather", sendbuf) == "staged":
+        mode = self._mode("allgather", sendbuf)
+        if mode == "quant" and sendbuf.ndim >= 2:
+            return self.dc.quant.allgather(sendbuf)
+        if mode == "staged":
             return self._stage_in(_staged_allgather(self._stage_out(sendbuf)))
         return self.dc.allgather(sendbuf)
 
@@ -233,8 +288,10 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.reduce_scatter_block(comm, sendbuf, recvbuf, op)
-        if op.name in _NP_FOLD and self._mode(
-                "reduce_scatter_block", sendbuf) == "staged":
+        mode = self._mode("reduce_scatter_block", sendbuf, op)
+        if mode == "quant":
+            return self.dc.quant.reduce_scatter(sendbuf, op)
+        if op.name in _NP_FOLD and mode == "staged":
             h = self._stage_out(sendbuf)           # (R, R*b, *e)
             R = h.shape[0]
             b = h.shape[1] // R
@@ -495,6 +552,18 @@ class XlaModule(CollModule):
         if (recvbuf is None and self._rows_ok(sendbuf, 2)
                 and len(counts) == sendbuf.shape[0]
                 and int(np.sum(counts)) == sendbuf.shape[1]):
+            cs = [int(c) for c in counts]
+            if (len(set(cs)) == 1 and cs[0] > 0
+                    and self._mode("reduce_scatter", sendbuf,
+                                   op) == "quant"):
+                import jax.numpy as jnp
+                out = self.dc.quant.reduce_scatter(sendbuf, op)
+                cap = self.dc._bucket(cs[0])
+                if cap != cs[0]:   # match reduce_scatter_v's padded cap
+                    pad = [(0, 0), (0, cap - cs[0])]
+                    pad += [(0, 0)] * (out.ndim - 2)
+                    out = jnp.pad(out, pad)
+                return out
             if op.name in _NP_FOLD and self._mode(
                     "reduce_scatter", sendbuf) == "staged":
                 h = self._stage_out(sendbuf)       # (R, total, *e)

@@ -1,0 +1,74 @@
+"""Compatibility layer over jax's shard_map / VMA API surface.
+
+The repo targets the current jax API (``jax.shard_map``, ``lax.pcast``,
+``jax.typeof(...).vma``, the ``check_vma`` kwarg).  Older installs (jax
+0.4.x) only ship ``jax.experimental.shard_map.shard_map`` with the
+pre-VMA ``check_rep`` flag and no ``pcast`` at all.  Every shard_map
+program in the tree imports through this module so one shim carries the
+whole device plane across both API generations:
+
+  * ``shard_map``    — ``jax.shard_map`` when present, else the
+    experimental one.  ``check_vma`` passes through on new jax; on old
+    jax the static replication checker cannot type VMA-era programs
+    (``pcast`` is a no-op there), so programs run with
+    ``check_rep=False`` — the same semantics as ``check_vma=False``.
+  * ``pcast``        — ``lax.pcast`` when present, identity otherwise
+    (with rep-checking off nothing needs the cast).
+  * ``typeof_vma``   — the ``jax.typeof(x).vma`` axis set, or an empty
+    set on jax without VMA tracking.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax import lax
+
+NEW_SHARD_MAP = hasattr(jax, "shard_map")
+HAS_PCAST = hasattr(lax, "pcast")
+
+if NEW_SHARD_MAP:
+    _shard_map = jax.shard_map
+else:  # pragma: no cover - exercised on jax 0.4.x installs
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` across jax generations (usable as a decorator
+    via ``functools.partial(shard_map, mesh=..., ...)``)."""
+    if f is None:
+        return functools.partial(shard_map, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_vma=check_vma,
+                                 **kw)
+    if NEW_SHARD_MAP:
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+    else:
+        kw["check_rep"] = False
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def pcast(x, axes, to="varying"):
+    """``lax.pcast`` when the install has it; identity otherwise."""
+    if HAS_PCAST:
+        return lax.pcast(x, axes, to=to)
+    return x
+
+
+def auto_axis_types(n_axes: int) -> dict:
+    """``axis_types`` kwargs marking ``n_axes`` mesh axes as *Auto* —
+    ``{}`` on jax without ``jax.sharding.AxisType`` (where every axis is
+    implicitly auto already)."""
+    if hasattr(jax.sharding, "AxisType"):
+        return {"axis_types": (jax.sharding.AxisType.Auto,) * n_axes}
+    return {}
+
+
+def typeof_vma(x):
+    """The set of mesh axes ``x`` is device-varying over (empty when the
+    install predates VMA tracking)."""
+    if hasattr(jax, "typeof"):
+        return getattr(jax.typeof(x), "vma", frozenset())
+    return frozenset()

@@ -65,6 +65,15 @@ class Config:
     #   halves the mu buffer's HBM (the MFU lever VERDICT r3 item 9 names:
     #   less optimizer traffic on an HBM-bound chip). Second moment stays
     #   fp32 — bf16's 8-bit mantissa loses v's small-magnitude accumulation
+    grad_sync: str = "native"          # "native" | "quant" — how the dp
+    #   gradient allreduce moves: "native" lets GSPMD insert the exact
+    #   allreduce; "quant" syncs each gradient leaf with the block-
+    #   quantized tier (coll/quant.psum_quant: int8 payload + per-block
+    #   scales, ~4× fewer ICI bytes, ~1e-2 relative error on unit-scale
+    #   gradients). dp-only meshes — see make_train_step
+    grad_sync_block: int = 256         # quantization block for grad_sync
+    #   ="quant"; smaller blocks track outliers tighter at more scale
+    #   traffic (ratio (1 + 4/block)/4 of native bytes for f32)
 
 
 def flagship_config(seq: int = 2048) -> Config:
@@ -327,10 +336,51 @@ def loss_fn(params: Dict, tokens: jax.Array, cfg: Config,
 
 # -- training ---------------------------------------------------------------
 
+def _quant_grad_sync(cfg: Config, mesh: Mesh):
+    """Build value_and_grad with the dp allreduce carried by the block-
+    quantized tier instead of GSPMD's exact one: per-shard grads inside a
+    shard_map over dp, each leaf combined with coll/quant.psum_quant
+    (quantize → all_to_all int8+scales → f32 accumulate → requantize →
+    all_gather), loss pmean'd exactly (it is a scalar — nothing to save).
+
+    dp-only meshes: a shard_map over dp replicates every other axis, which
+    would silently undo tp/sp parameter sharding — refuse instead, matching
+    the loss_chunk contract above."""
+    from ..coll.quant import psum_quant
+    from ..jaxcompat import shard_map
+
+    if "dp" not in mesh.axis_names:
+        raise ValueError(
+            "grad_sync='quant' needs a 'dp' mesh axis to sync over "
+            f"(mesh axes: {mesh.axis_names})")
+    for a in mesh.axis_names:
+        if a != "dp" and mesh.shape[a] > 1:
+            raise ValueError(
+                "grad_sync='quant' is dp-only: the shard_map over dp would "
+                f"replicate axis {a!r} (size {mesh.shape[a]}) and undo its "
+                "parameter sharding; use grad_sync='native' on dp×tp/sp "
+                "meshes")
+    n = mesh.shape["dp"]
+    data_spec = P(*("dp" if a == "dp" else None for a in mesh.axis_names))
+
+    def local(params, tokens):
+        # mesh=None inside: the model sees only its batch shard; the one
+        # cross-shard exchange is the gradient sync below
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, None)
+        grads = jax.tree.map(
+            lambda g: psum_quant(g, "dp", n, avg=True,
+                                 block=cfg.grad_sync_block), grads)
+        return lax.pmean(loss, "dp"), grads
+
+    return shard_map(local, mesh=mesh, in_specs=(P(), data_spec),
+                     out_specs=(P(), P()))
+
+
 def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
                     learning_rate: float = 1e-3):
     """Returns (init_opt_state, step). step is jit-compiled; with a mesh the
-    data batch is dp-sharded and gradients allreduce over dp automatically."""
+    data batch is dp-sharded and gradients allreduce over dp automatically —
+    or, with cfg.grad_sync == "quant", through the block-quantized tier."""
     import optax
 
     tx = optax.adamw(learning_rate,
@@ -339,8 +389,22 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
     def init_opt(params):
         return tx.init(params)
 
+    if cfg.grad_sync not in ("native", "quant"):
+        raise ValueError(f"unknown grad_sync {cfg.grad_sync!r} "
+                         "(expected 'native' or 'quant')")
+    quant_vg = None
+    if cfg.grad_sync == "quant":
+        if mesh is None:
+            raise ValueError("grad_sync='quant' requires a mesh "
+                             "(single-controller has no dp axis to sync)")
+        quant_vg = _quant_grad_sync(cfg, mesh)
+
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        if quant_vg is not None:
+            loss, grads = quant_vg(params, tokens)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
+                                                      mesh)
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

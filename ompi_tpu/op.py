@@ -72,6 +72,39 @@ MAXLOC = Op("maxloc", _maxloc)
 MINLOC = Op("minloc", _minloc)
 
 
+def _avg_pairwise(a, b):
+    raise NotImplementedError(
+        "AVG has no pairwise fold (MPI itself has no MPI_AVG); only "
+        "collectives that know the communicator size implement it — "
+        "currently the quantized device tier (coll/quant), which "
+        "finalizes as sum/size.")
+
+
+# Mean-reduction op for gradient sync. Deliberately NOT foldable through
+# the generic host/device reduce chains (fn raises): any path that would
+# silently compute a sum for it fails loudly instead.
+AVG = Op("avg", _avg_pairwise)
+
+# float dtype names quantizable by the block-quantized tier (bfloat16 is
+# an ml_dtypes extension type, so np.issubdtype can't classify it)
+_QUANT_FLOAT_NAMES = ("float16", "float32", "float64", "bfloat16")
+
+
+def quantizable(op: Op, dtype) -> bool:
+    """Whether the block-quantized device tier may carry (op, dtype).
+
+    Float operands under SUM/AVG only: int/bool operands have no scale
+    to quantize against, non-linear ops (MAX/MIN/PROD/...) don't commute
+    with per-block rescaling, and MAXLOC/MINLOC pairs carry an exact
+    index that must never be rounded.
+    """
+    if op.name not in ("sum", "avg"):
+        return False
+    dt = np.dtype(dtype)
+    return dt.names is None and (np.issubdtype(dt, np.floating)
+                                 or dt.name in _QUANT_FLOAT_NAMES)
+
+
 def loc_dtype(value_dtype) -> np.dtype:
     """Structured dtype for MAXLOC/MINLOC pairs (≙ MPI_DOUBLE_INT etc.)."""
     return np.dtype([("v", np.dtype(value_dtype)), ("i", np.int64)])

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jaxcompat import shard_map
+
 
 @functools.lru_cache(maxsize=64)
 def _build_allgather_matmul(mesh: Mesh, axis: str, w_spec: P, reverse: bool):
@@ -65,10 +67,10 @@ def _build_allgather_matmul(mesh: Mesh, axis: str, w_spec: P, reverse: bool):
     # The output is value-replicated over `axis` (every rank fills all n
     # blocks) but provenance-varying (it flowed through ppermute), so the
     # static VMA check can't prove replication — disable it here.
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(x_spec, w_spec),
-                                 out_specs=P(None, w_spec[1]),
-                                 check_vma=False))
+    return jax.jit(shard_map(local, mesh=mesh,
+                             in_specs=(x_spec, w_spec),
+                             out_specs=P(None, w_spec[1]),
+                             check_vma=False))
 
 
 def allgather_matmul(x: jax.Array, w: jax.Array, mesh: Mesh, axis: str,
@@ -115,9 +117,9 @@ def _build_matmul_rs(mesh: Mesh, axis: str):
         acc = lax.fori_loop(1, n, step, acc)
         return acc.astype(jnp.promote_types(x.dtype, w.dtype))
 
-    return jax.jit(jax.shard_map(local, mesh=mesh,
-                                 in_specs=(P(None, axis), P(axis, None)),
-                                 out_specs=P(axis, None)))
+    return jax.jit(shard_map(local, mesh=mesh,
+                             in_specs=(P(None, axis), P(axis, None)),
+                             out_specs=P(axis, None)))
 
 
 def matmul_reduce_scatter(x: jax.Array, w: jax.Array, mesh: Mesh,

@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import jaxcompat as _compat
 from ..op import MAX, MIN, SUM, Op
 
 # ---------------------------------------------------------------------------
@@ -166,6 +167,7 @@ class DeviceComm:
         self._idx_cache_cap = 64
         self._spec = P(axis)
         self.spc = None          # optional SPC counters
+        self._quant = None       # lazy QuantDeviceComm (coll/quant)
 
     def _idx_cached(self, key: tuple, build: Callable) -> Any:
         hit = self._idx_cache.get(key)
@@ -227,11 +229,20 @@ class DeviceComm:
         return fn
 
     def _shard_map(self, fn, in_specs, out_specs):
-        return jax.jit(jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
-                                     out_specs=out_specs))
+        return jax.jit(_compat.shard_map(fn, mesh=self.mesh,
+                                         in_specs=in_specs,
+                                         out_specs=out_specs))
 
     def cache_info(self) -> Dict[str, int]:
         return {"entries": len(self._cache)}
+
+    @property
+    def quant(self):
+        """Block-quantized tier over the same axis/cache (coll/quant)."""
+        if self._quant is None:
+            from ..coll.quant import QuantDeviceComm
+            self._quant = QuantDeviceComm(self)
+        return self._quant
 
     # -- collectives --------------------------------------------------------
     #
@@ -1037,7 +1048,7 @@ class DeviceComm:
                 out0 = jnp.zeros((rr, out_cap + S) + e_shape, xs.dtype)
                 # the body's all_to_all makes the carry VARYING over the
                 # mesh axis; the zeros init must match (shard_map VMA)
-                out0 = lax.pcast(out0, (self.axis,), to="varying")
+                out0 = _compat.pcast(out0, (self.axis,), to="varying")
                 out, _ = lax.scan(body, out0,
                                   jnp.arange(k, dtype=jnp.int32))
                 return out[:, :out_cap]

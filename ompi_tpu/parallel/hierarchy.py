@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jaxcompat import shard_map
 from .mesh import classify_axes
 
 
@@ -46,8 +47,8 @@ def hierarchical_allreduce(x: jax.Array, mesh: Mesh, inner: str, outer: str
         out = hierarchical_psum(flat, inner, outer)
         return out[None, None]
 
-    fn = jax.jit(jax.shard_map(local, mesh=mesh, in_specs=spec,
-                               out_specs=spec))
+    fn = jax.jit(shard_map(local, mesh=mesh, in_specs=spec,
+                           out_specs=spec))
     return fn(x)
 
 

@@ -22,6 +22,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..jaxcompat import auto_axis_types
+
 # conventional axis names, outer→inner (DCN-most → ICI-most)
 STANDARD_AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
 
@@ -46,11 +48,10 @@ def make_mesh(axes: Dict[str, int],
         raise ValueError(
             f"mesh {dict(zip(names, sizes))} needs {total} devices, "
             f"have {len(devs)}")
-    auto = (jax.sharding.AxisType.Auto,) * len(names)
+    auto = auto_axis_types(len(names))
     if devices is None:
-        return jax.make_mesh(tuple(sizes), tuple(names), axis_types=auto)
-    return Mesh(np.asarray(devs).reshape(sizes), tuple(names),
-                axis_types=auto)
+        return jax.make_mesh(tuple(sizes), tuple(names), **auto)
+    return Mesh(np.asarray(devs).reshape(sizes), tuple(names), **auto)
 
 
 def axis_index_of(mesh: Mesh, axis: str, device) -> int:

@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from jax import shard_map
+from ..jaxcompat import shard_map
 
 
 def stack_stage_params(layer_params: list, n_stages: int):

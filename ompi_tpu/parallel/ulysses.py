@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..jaxcompat import shard_map
 from .ring import attention_reference
 
 
@@ -58,5 +59,5 @@ def _build_ulysses(mesh: Mesh, axis: str, causal: bool,
         return heads_to_seq(out)
 
     spec = P(None, axis, None, None)
-    return jax.jit(jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                                 out_specs=spec))
+    return jax.jit(shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec))

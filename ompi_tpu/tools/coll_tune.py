@@ -177,6 +177,13 @@ def run_device_sweep(iters: int, sizes=None):
                 lambda: jax.device_put(jnp.asarray(np.broadcast_to(
                     np.asarray(jax.device_get(x))[0], host.shape)),
                     dc.sharding()).block_until_ready()),
+            "reduce_scatter": (
+                lambda: dc.reduce_scatter(x).block_until_ready(),
+                lambda: jax.device_put(jnp.asarray(
+                    np.asarray(jax.device_get(x)).sum(
+                        axis=0, dtype=np.float32).reshape(
+                        rows_n, count // rows_n)),
+                    dc.sharding()).block_until_ready()),
             "alltoall": (
                 lambda: dc.alltoall(
                     x.reshape(rows_n, rows_n, count // rows_n)
@@ -218,18 +225,41 @@ def run_device_sweep(iters: int, sizes=None):
                 cases["alltoallv"] = (
                     lambda: dc.alltoallv(xb, C)[0].block_until_ready(),
                     staged_a2av)
+        # third arm: the block-quantized tier (coll/quant) for the
+        # quant-capable collectives — a measured quant row in the rules
+        # file is the only way the decision layer ever picks it on its
+        # own (the platform default never does). ndev > 1 only: on a
+        # size-1 axis the quant path degenerates to the local fold and
+        # the rule would be meaningless.
+        quant_cases = {}
+        if ndev > 1:
+            quant_cases = {
+                "allreduce": (
+                    lambda: dc.quant.allreduce(x).block_until_ready()),
+                "reduce_scatter": (
+                    lambda: dc.quant.reduce_scatter(x)
+                    .block_until_ready()),
+            }
         for coll, (native, staged) in cases.items():
             nus = timed(native)
             sus = timed(staged)
-            mode = "native" if nus <= sus else "staged"
+            arms = {"native": nus, "staged": sus}
+            if coll in quant_cases:
+                arms["quant"] = timed(quant_cases[coll])
+            mode = min(arms, key=arms.get)
             eff = eff_bytes.get(coll, nbytes)
-            rows.append({"coll": coll, "bytes": eff,
-                         "nominal_bytes": nbytes,
-                         "native_us": round(nus, 1),
-                         "staged_us": round(sus, 1), "winner": mode})
+            row = {"coll": coll, "bytes": eff,
+                   "nominal_bytes": nbytes,
+                   "native_us": round(nus, 1),
+                   "staged_us": round(sus, 1), "winner": mode}
+            qtxt = ""
+            if "quant" in arms:
+                row["quant_us"] = round(arms["quant"], 1)
+                qtxt = f"quant {arms['quant']:9.1f}us "
+            rows.append(row)
             winners.setdefault(coll, {})[eff] = mode
             print(f"device {coll:12s} {eff:>9d}B  native {nus:9.1f}us "
-                  f"staged {sus:9.1f}us -> {mode}", flush=True)
+                  f"staged {sus:9.1f}us {qtxt}-> {mode}", flush=True)
 
     # device-window RMA epochs: native program vs staged D2H/host/H2D per
     # payload size — emitted as rma_fence_epoch rules consumed by
@@ -286,7 +316,7 @@ def emit_device_rules(winners: dict, path: str,
     real TPU would override the correct native-always platform default."""
     lines = [f"# device decision rules measured by coll_tune --device "
              f"on platform={platform}",
-             "# <coll> <min_ndev> <min_bytes> <native|staged>"]
+             "# <coll> <min_ndev> <min_bytes> <native|staged|quant>"]
     for coll, by_size in winners.items():
         prev = None
         for nbytes in sorted(by_size):
