@@ -5,7 +5,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 quant-tests
+.PHONY: tier1 quant-tests trace-tests
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -24,3 +24,9 @@ tier1:
 quant-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_quant_coll.py -q \
 	  -p no:cacheprovider -p no:randomly
+
+# the tracing + decision-audit suite alone (fast iteration on
+# ompi_tpu/trace work: audit events, Chrome export, pvars, overflow)
+trace-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
+	  -q -k "trace or wire or handle" -p no:cacheprovider -p no:randomly

@@ -1091,6 +1091,71 @@ def update_baseline_md(sweep: dict) -> None:
         f.write(text)
 
 
+def run_trace_probe(platform: str) -> None:
+    """--trace: run the flagship allreduce config (float32[4M]/rank)
+    through the coll/xla decision layer with tracing on, save a
+    perfetto-loadable Chrome trace, and ASSERT the decision-audit arm
+    matches the arm that actually executed (derived from SPC counter
+    deltas) — the rules-file-drift guard the observability PR exists
+    for.  Exits nonzero on mismatch."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import runtime, trace
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+
+    ndev = len(jax.devices())
+    rows = ndev if ndev > 1 else 8
+    trace.enable()
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": ndev}), "x")
+        host = np.random.default_rng(0).standard_normal(
+            (rows, NORTH_STAR_COUNT)).astype(np.float32)
+        x = jax.device_put(jnp.asarray(host), c.device_comm.sharding())
+        x.block_until_ready()
+        jax.block_until_ready(c.coll.allreduce(c, x))   # warm/compile
+        before = {k: ctx.spc.get(k) for k in
+                  ("coll_staged_fallbacks", "device_quant_collectives")}
+        t0 = time.perf_counter()
+        jax.block_until_ready(c.coll.allreduce(c, x))
+        us = (time.perf_counter() - t0) * 1e6
+        if ctx.spc.get("coll_staged_fallbacks") > \
+                before["coll_staged_fallbacks"]:
+            executed = "staged"
+        elif ctx.spc.get("device_quant_collectives") > \
+                before["device_quant_collectives"]:
+            executed = "quant"
+        else:
+            executed = "native"
+        return trace.explain_last("allreduce"), executed, us
+
+    exp, executed, us = runtime.run_ranks(1, fn, timeout=600)[0]
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, f"TRACE_{platform}.json")
+    trace.save_chrome(path)
+    trace.disable()
+    ok = exp is not None and exp["arm"] == executed
+    print(json.dumps({
+        "metric": "trace_check",
+        "value": 1.0 if ok else 0.0,
+        "unit": "decision-audit arm == timed arm",
+        "platform": platform, "ndev": ndev,
+        "bytes_per_rank": NORTH_STAR_COUNT * 4,
+        "arm_decided": exp["arm"] if exp else None,
+        "arm_timed": executed,
+        "reason": exp["reason"] if exp else None,
+        "flagship_us": round(us, 1),
+        "chrome_trace": path,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(
+            f"trace probe: decision-audit arm "
+            f"{exp['arm'] if exp else None!r} != timed arm {executed!r} "
+            "(rules-file drift — re-run coll_tune --device)")
+
+
 def main() -> None:
     t_start = time.time()
     try:
@@ -1107,6 +1172,10 @@ def main() -> None:
             jax.config.update("jax_platforms", platform)
         # accel: leave selection alone — see pick_platform
         platform = jax.devices()[0].platform
+
+        if "--trace" in sys.argv[1:]:
+            run_trace_probe(platform)
+            return
 
         # Phase control + incremental banking: the tunneled chip wedges
         # mid-run, so each phase's result is persisted the moment it

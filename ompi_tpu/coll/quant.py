@@ -48,6 +48,7 @@ import math
 
 import numpy as np
 
+from .. import trace
 from ..core import var as _var
 from ..op import SUM, Op, quantizable
 
@@ -230,6 +231,19 @@ def wire_bytes(coll: str, count: int, n: int, dtype, block: int = None,
 
 # -- canonical-layout engine (mirrors DeviceComm's entry points) ------------
 
+def _span_args(wb: dict, block: int, sdt, roundings: int,
+               requantize_count: int) -> dict:
+    """Trace-span payload for one quantized execution: the EQuARX
+    accounting (wire bytes, block config, how many stochastic roundings
+    touch each element, whether an accumulated value is requantized)."""
+    ratio = wb["ratio"]
+    return {"wire_bytes": wb["quant_bytes"],
+            "native_bytes": wb["native_bytes"],
+            "ratio": round(ratio, 4) if math.isfinite(ratio) else None,
+            "block": block, "scale_dtype": sdt.name,
+            "roundings": roundings, "requantize_count": requantize_count}
+
+
 class QuantDeviceComm:
     """Quantized collectives over a DeviceComm's mesh axis, same
     canonical (R, *elem) dim-0-sharded layout and executable cache
@@ -297,7 +311,16 @@ class QuantDeviceComm:
             return dc._shard_map(inner, dc._spec, dc._spec)
 
         self._spc("device_quant_collectives")
-        out = dc._compiled(key, build)(self._padded(x, L, Lpad))
+        xp = self._padded(x, L, Lpad)
+        if trace.enabled:
+            # allreduce = quantized reduce_scatter ring (accumulate in
+            # f32, requantize once per forward) + quantized allgather
+            with trace.span("quant:allreduce", "quant", args=_span_args(
+                    wire_bytes("allreduce", L, n, x.dtype, block, sdt),
+                    block, sdt, roundings=2, requantize_count=1)):
+                out = dc._compiled(key, build)(xp)
+        else:
+            out = dc._compiled(key, build)(xp)
         return out[:, :L].reshape((R,) + elem)
 
     def reduce_scatter(self, x, op: Op = SUM, block: int = None,
@@ -342,7 +365,17 @@ class QuantDeviceComm:
 
         self._spc("device_quant_collectives")
         flat = self._padded(x, R * b * E, R * b * E)
-        out = dc._compiled(key, build)(flat)
+        if trace.enabled:
+            # ring phase alone: one rounding per element, accumulation
+            # stays f32 (never requantized)
+            with trace.span("quant:reduce_scatter", "quant",
+                            args=_span_args(
+                    wire_bytes("reduce_scatter", R * b * E, n, x.dtype,
+                               block, sdt),
+                    block, sdt, roundings=1, requantize_count=0)):
+                out = dc._compiled(key, build)(flat)
+        else:
+            out = dc._compiled(key, build)(flat)
         return out.reshape((R, b) + elem)
 
     def allgather(self, x, block: int = None, scale_dtype=None):
@@ -374,5 +407,13 @@ class QuantDeviceComm:
             return dc._shard_map(inner, dc._spec, dc._spec)
 
         self._spc("device_quant_collectives")
-        out = dc._compiled(key, build)(self._padded(x, L, Lpad))
+        xp = self._padded(x, L, Lpad)
+        if trace.enabled:
+            # each contribution quantized exactly once on the wire
+            with trace.span("quant:allgather", "quant", args=_span_args(
+                    wire_bytes("allgather", L, n, x.dtype, block, sdt),
+                    block, sdt, roundings=1, requantize_count=0)):
+                out = dc._compiled(key, build)(xp)
+        else:
+            out = dc._compiled(key, build)(xp)
         return out.reshape((R, R * b) + e)

@@ -142,6 +142,7 @@ class XlaModule(CollModule):
         self.dc: "DeviceComm" = comm.device_comm
         self.dc.spc = getattr(comm.ctx, "spc", None)
         self.host = TunedModule(comm)   # fallback for host buffers
+        self._comm = comm               # decision-audit wire accounting
         self._rules = _load_device_rules()
         self._platform = next(iter(self.dc.mesh.devices.flat)).platform
 
@@ -150,39 +151,79 @@ class XlaModule(CollModule):
 
     # -- decision (native ICI program vs measured host staging) -------------
 
-    def _mode(self, coll: str, x, op: Op = None) -> str:
+    _ALL_ARMS = ("native", "staged", "quant")
+
+    def _mode(self, coll: str, x, op: Op = None,
+              allowed=_ALL_ARMS) -> str:
         """Pick per (collective, PER-RANK bytes, dtype) — the unit the
         sweep measures and the rules file records (a canonical array's
         row 0 is one rank's buffer), so thresholds line up with the
         evidence. Three arms: native ICI program, measured host staging,
-        and the block-quantized tier (coll/quant) for float reductions."""
+        and the block-quantized tier (coll/quant) for float reductions.
+
+        ``allowed`` is the set of arms the CALLING entry can actually
+        execute for this buffer/op (a non-foldable op has no host staging
+        kernel; a 1-D allgather has no quantized layout) — the decision
+        never names an arm the entry would silently ignore, so the audit
+        event always matches the executed path.  Every device dispatch
+        funnels through here exactly once: one decision-audit record per
+        collective."""
+        pick, reason, chain = self._decide(coll, x, op, allowed)
+        self._audit(coll, x, op, pick, reason, chain)
+        return pick
+
+    def _decide(self, coll: str, x, op: Op, allowed) -> tuple:
+        """The precedence chain, returned as (arm, reason, chain):
+        per-entry force var > blanket coll_xla_mode > blanket COLL_QUANT
+        > platform default, then DEVICE_RULES rows (later lines win;
+        quant rows vetoed by the off switch, the coll_quant_min_bytes
+        floor, or op/dtype/layout ineligibility).  ``reason`` is the link
+        that decided; ``chain`` records every vetoed/skipped link so
+        trace.explain_last can show the full evaluation."""
         from .quant import check_quantizable
 
+        chain: list = []
         qvar = str(_var.get("COLL_QUANT", "") or "").strip().lower()
         ent = _var.get(f"coll_xla_{coll}_mode", "")
         forced = ent or _var.get("coll_xla_mode", "")
+        src = f"coll_xla_{coll}_mode" if ent else "coll_xla_mode"
         if forced:
             if forced not in ("native", "staged", "quant"):
                 raise ValueError(
                     f"coll_xla mode for {coll!r} is {forced!r} "
                     "(want native, staged or quant)")
-            if forced != "quant":
-                return forced
-            if coll in _QUANT_COLLS:
-                # invalid op/dtype under an explicit quant force must
-                # fail loudly, not silently take the exact path
-                check_quantizable(op or SUM, x.dtype)
-                return "quant"
-            if ent:
-                raise ValueError(
-                    f"collective {coll!r} has no quantized arm "
-                    f"(quant applies to {', '.join(_QUANT_COLLS)})")
-            # global quant force: entries without a quantized arm keep
-            # the auto decision below
+            if forced == "quant":
+                if coll in _QUANT_COLLS:
+                    if "quant" in allowed:
+                        # invalid op/dtype under an explicit quant force
+                        # must fail loudly, not silently take the exact
+                        # path
+                        check_quantizable(op or SUM, x.dtype)
+                        return "quant", f"force:{src}=quant", chain
+                    chain.append(f"force:{src}=quant skipped "
+                                 "(layout has no quantized arm)")
+                elif ent:
+                    raise ValueError(
+                        f"collective {coll!r} has no quantized arm "
+                        f"(quant applies to {', '.join(_QUANT_COLLS)})")
+                else:
+                    chain.append("force:coll_xla_mode=quant skipped "
+                                 "(entry has no quantized arm)")
+                # global quant force: entries without a quantized arm
+                # keep the auto decision below
+            elif forced in allowed:
+                return forced, f"force:{src}={forced}", chain
+            else:
+                chain.append(f"force:{src}={forced} skipped "
+                             f"(no {forced} kernel for this op/layout)")
         nbytes = x.nbytes // max(x.shape[0], 1)
-        if qvar in ("1", "on", "true", "yes", "force") \
-                and self._quant_ok(coll, x, op):
-            return "quant"
+        quant_ok = "quant" in allowed and self._quant_ok(coll, x, op)
+        if qvar in ("1", "on", "true", "yes", "force"):
+            if quant_ok:
+                return "quant", f"blanket:COLL_QUANT={qvar}", chain
+            if coll in _QUANT_COLLS:
+                chain.append(f"blanket:COLL_QUANT={qvar} skipped "
+                             "(op/dtype/layout ineligible)")
         if self._platform == "cpu":
             # sweep-derived (BENCH_SWEEP_cpu_8dev.json): dense alltoall
             # staged wins 1KB-16MB/rank on the CPU fabric; all else native
@@ -190,15 +231,95 @@ class XlaModule(CollModule):
                                 and nbytes < (32 << 20)) else "native"
         else:
             pick = "native"       # staging crosses the host bridge
+        if pick not in allowed:
+            pick = "native"
+        reason = f"default:platform={self._platform}"
         quant_off = qvar in ("0", "off", "false", "no")
         floor = int(_var.get("coll_quant_min_bytes", 1 << 20))
         for c, mn, mb, mode in self._rules:
-            if c == coll and self.dc.n >= mn and nbytes >= mb:
-                if mode == "quant" and (quant_off or nbytes < floor
-                                        or not self._quant_ok(coll, x, op)):
-                    continue      # rule doesn't apply; keep prior pick
-                pick = mode
-        return pick
+            if c != coll or self.dc.n < mn or nbytes < mb:
+                continue
+            rule = f"rule:{c} {mn} {mb} {mode}"
+            if mode == "quant":
+                # vetoed rule: keep the prior pick, but the veto IS the
+                # deciding word unless a later rule overrides it
+                if quant_off:
+                    reason = f"off:COLL_QUANT={qvar} (vetoed {rule})"
+                    chain.append(reason)
+                    continue
+                if not quant_ok:
+                    reason = f"ineligible:op/dtype/layout (vetoed {rule})"
+                    chain.append(reason)
+                    continue
+                if nbytes < floor:
+                    reason = (f"floor:coll_quant_min_bytes={floor}"
+                              f">{nbytes} (vetoed {rule})")
+                    chain.append(reason)
+                    continue
+            elif mode not in allowed:
+                chain.append(f"{rule} skipped (no {mode} kernel)")
+                continue
+            pick = mode
+            reason = rule
+            chain.append(rule)
+        return pick, reason, chain
+
+    # modeled wire-byte collectives: coll -> coll/quant hop-table name
+    _WIRE_MODEL = {"allreduce": "allreduce",
+                   "reduce_scatter_block": "reduce_scatter",
+                   "reduce_scatter": "reduce_scatter",
+                   "allgather": "allgather"}
+
+    def _audit(self, coll: str, x, op: Op, arm: str, reason: str,
+               chain: list) -> None:
+        """ONE decision-audit record per device-dispatched collective.
+        Always: the arm-count + wire-byte pvars (plain dict adds, same
+        cost class as every other SPC site) and the monitoring wire-byte
+        correction when the quant arm will carry the call (the logical
+        f32 size the dispatch layer recorded is not what travels).
+        When tracing is on: the full decision event with the precedence
+        chain, feeding trace.explain_last."""
+        from .. import trace
+
+        rows = max(x.shape[0], 1)
+        nbytes = x.nbytes // rows
+        wire = nbytes
+        ratio = None
+        qcoll = self._WIRE_MODEL.get(coll)
+        if qcoll is not None:
+            from .quant import wire_bytes
+            try:
+                wb = wire_bytes(qcoll, max(x.size // rows, 1), self.dc.n,
+                                x.dtype)
+            except (ValueError, TypeError):
+                wb = None
+            if wb is not None:
+                ratio = wb["ratio"]
+                if arm == "quant":
+                    wire = wb["quant_bytes"]
+                elif arm == "native":
+                    wire = wb["native_bytes"]
+                if arm == "quant":
+                    from .. import monitoring
+                    # satellite fix: record_coll logged the logical size;
+                    # correct the coll matrix to int8-payload+scales
+                    monitoring.coll_wire_event(self._comm, coll,
+                                               wb["quant_bytes"], x.nbytes)
+        spc = self.dc.spc
+        if spc is not None:
+            spc.inc(f"coll_arm_{arm}_count")
+            spc.inc("coll_wire_bytes", wire)
+        if trace.enabled:
+            bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
+            ctx = getattr(self._comm, "ctx", None)
+            trace.decision(
+                coll, arm=arm, reason=reason,
+                nbytes=nbytes, rank=getattr(ctx, "rank", 0),
+                shape_bucket=bucket, shape=tuple(x.shape),
+                dtype=str(x.dtype),
+                reduce_op=getattr(op, "name", None),
+                ndev=self.dc.n, wire_bytes=wire, quant_ratio=ratio,
+                chain=list(chain))
 
     def _quant_ok(self, coll: str, x, op: Op = None) -> bool:
         """Whether the quantized arm can carry this call at all
@@ -237,10 +358,13 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.allreduce(comm, sendbuf, recvbuf, op)
-        mode = self._mode("allreduce", sendbuf, op)
+        mode = self._mode("allreduce", sendbuf, op,
+                          allowed=self._ALL_ARMS
+                          if op.name in _NP_FOLD
+                          else ("native", "quant"))
         if mode == "quant":
             return self.dc.quant.allreduce(sendbuf, op)
-        if op.name in _NP_FOLD and mode == "staged":
+        if mode == "staged":
             h = self._stage_out(sendbuf)
             red = _NP_FOLD[op.name](h, axis=0)
             return self._stage_in(np.broadcast_to(red, h.shape))
@@ -250,8 +374,10 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.reduce(comm, sendbuf, recvbuf, op, root)
-        if op.name in _NP_FOLD and \
-                self._mode("reduce", sendbuf) == "staged":
+        mode = self._mode("reduce", sendbuf, op,
+                          allowed=("native", "staged")
+                          if op.name in _NP_FOLD else ("native",))
+        if mode == "staged":
             h = self._stage_out(sendbuf)
             red = _NP_FOLD[op.name](h, axis=0)
             return self._stage_in(np.broadcast_to(red, h.shape))
@@ -268,8 +394,10 @@ class XlaModule(CollModule):
     def allgather(self, comm, sendbuf, recvbuf=None):
         if not _is_device(sendbuf):
             return self.host.allgather(comm, sendbuf, recvbuf)
-        mode = self._mode("allgather", sendbuf)
-        if mode == "quant" and sendbuf.ndim >= 2:
+        mode = self._mode("allgather", sendbuf,
+                          allowed=self._ALL_ARMS if sendbuf.ndim >= 2
+                          else ("native", "staged"))
+        if mode == "quant":
             return self.dc.quant.allgather(sendbuf)
         if mode == "staged":
             return self._stage_in(_staged_allgather(self._stage_out(sendbuf)))
@@ -288,10 +416,13 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.reduce_scatter_block(comm, sendbuf, recvbuf, op)
-        mode = self._mode("reduce_scatter_block", sendbuf, op)
+        mode = self._mode("reduce_scatter_block", sendbuf, op,
+                          allowed=self._ALL_ARMS
+                          if op.name in _NP_FOLD
+                          else ("native", "quant"))
         if mode == "quant":
             return self.dc.quant.reduce_scatter(sendbuf, op)
-        if op.name in _NP_FOLD and mode == "staged":
+        if mode == "staged":
             h = self._stage_out(sendbuf)           # (R, R*b, *e)
             R = h.shape[0]
             b = h.shape[1] // R
@@ -303,8 +434,10 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.scan(comm, sendbuf, recvbuf, op)
-        if op.name in ("sum", "prod") and \
-                self._mode("scan", sendbuf) == "staged":
+        mode = self._mode("scan", sendbuf, op,
+                          allowed=("native", "staged")
+                          if op.name in ("sum", "prod") else ("native",))
+        if mode == "staged":
             h = self._stage_out(sendbuf)
             fn = np.cumsum if op.name == "sum" else np.cumprod
             return self._stage_in(fn(h, axis=0))
@@ -314,8 +447,10 @@ class XlaModule(CollModule):
         op = op or SUM
         if not _is_device(sendbuf):
             return self.host.exscan(comm, sendbuf, recvbuf, op)
-        if op.name == "sum" and \
-                self._mode("exscan", sendbuf) == "staged":
+        mode = self._mode("exscan", sendbuf, op,
+                          allowed=("native", "staged")
+                          if op.name == "sum" else ("native",))
+        if mode == "staged":
             h = self._stage_out(sendbuf)
             out = np.zeros_like(h)
             out[1:] = np.cumsum(h, axis=0)[:-1]
@@ -553,9 +688,14 @@ class XlaModule(CollModule):
                 and len(counts) == sendbuf.shape[0]
                 and int(np.sum(counts)) == sendbuf.shape[1]):
             cs = [int(c) for c in counts]
-            if (len(set(cs)) == 1 and cs[0] > 0
-                    and self._mode("reduce_scatter", sendbuf,
-                                   op) == "quant"):
+            allowed = ["native"]
+            if op.name in _NP_FOLD:
+                allowed.append("staged")
+            if len(set(cs)) == 1 and cs[0] > 0:
+                allowed.append("quant")   # ragged counts: no quant layout
+            mode = self._mode("reduce_scatter", sendbuf, op,
+                              allowed=tuple(allowed))
+            if mode == "quant":
                 import jax.numpy as jnp
                 out = self.dc.quant.reduce_scatter(sendbuf, op)
                 cap = self.dc._bucket(cs[0])
@@ -564,8 +704,7 @@ class XlaModule(CollModule):
                     pad += [(0, 0)] * (out.ndim - 2)
                     out = jnp.pad(out, pad)
                 return out
-            if op.name in _NP_FOLD and self._mode(
-                    "reduce_scatter", sendbuf) == "staged":
+            if mode == "staged":
                 h = self._stage_out(sendbuf)       # (R, total, *e)
                 red = _NP_FOLD[op.name](h, axis=0)
                 cap = self.dc._bucket(max(int(c) for c in counts))

@@ -108,6 +108,14 @@ class Monitor:
             if w != comm.ctx.rank:
                 self.record("coll", w, nbytes)
 
+    def adjust_coll(self, comm, delta: int) -> None:
+        """Re-price the bytes of the collective record_coll just logged —
+        same per-peer attribution, NO message-count bump (it is a
+        correction to an already-counted call, not new traffic)."""
+        for w in comm.group.world_ranks:
+            if w != comm.ctx.rank:
+                self.extra["coll"][int(w)][1] += int(delta)
+
     # -- output -------------------------------------------------------------
 
     def as_dict(self) -> dict:
@@ -201,6 +209,22 @@ def coll_event(comm, name: str, sendbuf) -> None:
     if _hooks:
         _emit({"api": name, "phase": "pre", "peer": -1, "bytes": nbytes,
                "comm": comm.cid, "t": time.monotonic()})
+
+
+def coll_wire_event(comm, name: str, wire_bytes: int,
+                    logical_bytes: int) -> None:
+    """Called from the coll/xla decision audit when the quantized arm
+    carries a collective: the dispatch layer's coll_event recorded the
+    LOGICAL (f32) buffer size, but what travels is the int8 payload plus
+    block scales — correct the coll matrix to actual wire bytes and tell
+    the PMPI-analog hooks (phase "wire")."""
+    mon = getattr(comm.ctx, "_monitor", None)
+    if mon is not None:
+        mon.adjust_coll(comm, int(wire_bytes) - int(logical_bytes))
+    if _hooks:
+        _emit({"api": name, "phase": "wire", "peer": -1,
+               "bytes": int(wire_bytes), "comm": comm.cid,
+               "t": time.monotonic()})
 
 
 def osc_event(ctx, op: str, target: int, nbytes: int) -> None:

@@ -18,6 +18,7 @@ tools get the same dicts via these functions.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -136,13 +137,18 @@ class PvarHandle:
 
     ``obj`` must carry the pvar's bind type: a Context (or anything with
     ``.spc``) for counter pvars; a Comm whose context has monitoring
-    installed for the matrix pvars."""
+    installed for the matrix pvars.
+
+    The handle holds only WEAK references to the bound object and its
+    counter source: a tool's handle must neither keep an MPI object alive
+    past its free (the reference's handles die with the object) nor keep
+    reporting the last value it happened to cache — reading through a
+    garbage-collected binding raises MPI_T_ERR_INVALID_HANDLE."""
 
     def __init__(self, session: PvarSession, meta: Dict[str, Any],
                  obj: Any) -> None:
         self.session = session
         self.meta = dict(meta)
-        self.obj = obj
         self._freed = False
         if meta["bind"] == "context":
             ctx = getattr(obj, "ctx", obj)     # a Comm binds via its ctx
@@ -151,7 +157,8 @@ class PvarHandle:
                 raise MPITError("invalid_handle",
                                 f"{meta['name']} binds a Context "
                                 f"(object with .spc), got {type(obj)}")
-            self._spc = spc
+            self._obj_ref = weakref.ref(ctx)
+            self._src_ref = weakref.ref(spc)
             self.count = 1
         else:                                   # comm-bound matrix pvar
             ctx = getattr(obj, "ctx", None)
@@ -160,19 +167,34 @@ class PvarHandle:
                 raise MPITError("invalid_handle",
                                 f"{meta['name']} binds a Comm with "
                                 "monitoring installed (monitoring.install)")
-            self._mon = mon
+            self._obj_ref = weakref.ref(obj)
+            self._src_ref = weakref.ref(mon)
             self.count = obj.size
         # non-continuous counters start STOPPED with zero accumulation
         self.started = bool(meta["continuous"])
         self._acc = 0.0
         self._base = self._source() if self.started else 0.0
 
+    @property
+    def obj(self) -> Any:
+        o = self._obj_ref()
+        if o is None:
+            raise MPITError("invalid_handle",
+                            f"{self.meta['name']}: bound object was "
+                            "garbage-collected")
+        return o
+
     # raw source value, independent of handle state
     def _source(self):
+        src = self._src_ref()
+        if src is None:
+            raise MPITError("invalid_handle",
+                            f"{self.meta['name']}: pvar source was "
+                            "garbage-collected")
         if self.meta["bind"] == "context":
-            return float(self._spc.get(self.meta["name"]))
-        cls = self.meta["name"][len("monitoring_"):-len("_bytes")]
-        rows = self._mon.peers.get(cls, {})
+            return float(src.get(self.meta["name"]))
+        rows = src.peers.get(
+            self.meta["name"][len("monitoring_"):-len("_bytes")], {})
         out = np.zeros(self.count)
         group = self.obj.group      # peers() keys are WORLD ranks: map to
         for peer, (msgs, nbytes) in rows.items():   # the bound comm's rank
@@ -185,6 +207,10 @@ class PvarHandle:
         self.session._check()
         if self._freed:
             raise MPITError("invalid_handle", "handle was freed")
+        if self._obj_ref() is None or self._src_ref() is None:
+            raise MPITError("invalid_handle",
+                            f"{self.meta['name']}: bound object was "
+                            "garbage-collected")
 
     def start(self) -> None:
         self._check()

@@ -43,6 +43,7 @@ and shared/exclusive arbitration all hold.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -50,6 +51,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import trace
 from ..core import var as _var
 from ..op import SUM, Op
 from .window import LOCK_EXCLUSIVE, LOCK_SHARED  # one source of truth
@@ -294,10 +296,23 @@ class DeviceWindow:
     def _execute(self, ops: List[Tuple]) -> None:
         if not ops:
             return
-        if self._mode(ops) == "staged":
+        mode = self._mode(ops)
+        if not trace.enabled:
+            if mode == "staged":
+                self._execute_staged(ops)
+            else:
+                self._execute_native(ops)
+            return
+        t0 = time.perf_counter()
+        n_in = len(ops)
+        if mode == "staged":
             self._execute_staged(ops)
         else:
             self._execute_native(ops)
+        trace.record_span("rma:epoch", "osc", t0, time.perf_counter(),
+                          args={"mode": mode, "ops": n_in,
+                                "window": self.name,
+                                "nranks": self.nranks})
 
     def _execute_staged(self, ops: List[Tuple]) -> None:
         """The epoch the coll/accelerator way (a measured CHOICE here, not
@@ -339,7 +354,12 @@ class DeviceWindow:
         """Run a recorded op list as one cached device program. The
         execution mutex serializes the donated-array swap so passive
         epochs from concurrent controller threads never race the buffer."""
+        n_in = len(ops)
         ops = self._coalesce(ops)
+        if trace.enabled and len(ops) < n_in:
+            trace.instant("rma:coalesce", "osc",
+                          args={"ops_in": n_in, "runs_out": len(ops),
+                                "window": self.name})
         sig = self._signature(ops)
         with self._exec_mu:
             fn = self._cache.get(sig)

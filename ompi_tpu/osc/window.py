@@ -460,12 +460,23 @@ class Window:
         complete everywhere (the osc/rdma fence recipe). A failed op's
         error surfaces AFTER the barrier — skipping it would desynchronize
         the epoch across ranks."""
+        from .. import trace
+        if trace.enabled:
+            import time as _time
+            t0 = _time.perf_counter()
+            outstanding = sum(len(v) for v in self._outstanding.values())
         err = None
         try:
             self.flush_all()
         except Exception as exc:
             err = exc
         self.comm.barrier()
+        if trace.enabled:
+            trace.record_span(
+                "rma:fence", "osc", t0, _time.perf_counter(),
+                rank=self.comm.ctx.rank,
+                args={"outstanding": outstanding,
+                      "win": self.win_id, "mode": "host"})
         if err is not None:
             raise err
 

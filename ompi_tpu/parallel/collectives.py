@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -35,7 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .. import jaxcompat as _compat
+from .. import jaxcompat as _compat, trace
 from ..op import MAX, MIN, SUM, Op
 
 # ---------------------------------------------------------------------------
@@ -220,10 +221,24 @@ class DeviceComm:
     def _compiled(self, key: tuple, build: Callable) -> Callable:
         fn = self._cache.get(key)
         if fn is None:
-            fn = build()
+            if trace.enabled:
+                # build() constructs + jits the program; XLA compiles
+                # lazily, so the first-execution compile lands inside
+                # whatever execution span surrounds the miss
+                t0 = time.perf_counter()
+                fn = build()
+                trace.record_span(f"build:{key[0]}", "compile", t0,
+                                  time.perf_counter(),
+                                  args={"key": repr(key)})
+            else:
+                fn = build()
             self._cache[key] = fn
             if self.spc is not None:
                 self.spc.inc("device_cache_misses")
+                self.spc.inc("cache_miss_count")
+        elif trace.enabled:
+            trace.instant(f"cache_hit:{key[0]}", "cache",
+                          args={"key": repr(key)})
         if self.spc is not None:
             self.spc.inc("device_collectives")
         return fn

@@ -26,6 +26,7 @@ depth, not per layer).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable
 
 import jax
@@ -33,6 +34,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import trace
 from ..jaxcompat import shard_map
 
 
@@ -60,6 +62,41 @@ def shard_stage_params(stacked, mesh: Mesh, axis: str = "pp"):
 _RUN_CACHE: dict = {}
 
 
+def _traced_run(jitted: Callable, stage_params, microbatches,
+                n_stages: int, m_count: int, axis: str,
+                cached: bool) -> jax.Array:
+    """Execute the jitted schedule; when tracing is on, record one
+    MEASURED run span (block_until_ready bounds it) plus per-tick spans.
+    The host cannot observe tick boundaries inside the single compiled
+    shard_map program, so tick spans are an even subdivision of the run —
+    marked ``synthetic`` — annotating what each tick's ppermute ring
+    shift sends and which stage ingests/emits a microbatch."""
+    if not trace.enabled or isinstance(microbatches, jax.core.Tracer):
+        # under an outer jit/grad trace there is nothing to time: the
+        # schedule inlines into the caller's program
+        return jitted(stage_params, microbatches)
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(jitted(stage_params, microbatches))
+    t1 = time.perf_counter()
+    ticks = m_count + n_stages - 1
+    trace.record_span(
+        "pipeline:run", "pipeline", t0, t1,
+        args={"stages": n_stages, "microbatches": m_count,
+              "ticks": ticks, "axis": axis,
+              "cache": "hit" if cached else "miss"})
+    per = (t1 - t0) / max(ticks, 1)
+    for t in range(ticks):
+        trace.record_span(
+            "pipeline:tick", "pipeline-ticks",
+            t0 + t * per, t0 + (t + 1) * per,
+            args={"tick": t, "synthetic": True,
+                  "send": "ppermute ring shift (stage i -> i+1)",
+                  "ingest": t if t < m_count else None,
+                  "emit": t - (n_stages - 1)
+                  if t >= n_stages - 1 else None})
+    return out
+
+
 def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
              stage_params, microbatches: jax.Array, mesh: Mesh,
              axis: str = "pp", checkpoint: bool = True) -> jax.Array:
@@ -80,7 +117,8 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
                  microbatches.ndim, jax.tree.structure(stage_params))
     cached = _RUN_CACHE.get(cache_key)
     if cached is not None:
-        return cached(stage_params, microbatches)
+        return _traced_run(cached, stage_params, microbatches,
+                           n_stages, m_count, axis, cached=True)
     while len(_RUN_CACHE) >= 32:
         _RUN_CACHE.pop(next(iter(_RUN_CACHE)))
     fn = jax.checkpoint(stage_fn) if checkpoint else stage_fn
@@ -136,4 +174,5 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
     # nested jit is a no-op when the caller already traces)
     jitted = jax.jit(run)
     _RUN_CACHE[cache_key] = jitted
-    return jitted(stage_params, microbatches)
+    return _traced_run(jitted, stage_params, microbatches,
+                       n_stages, m_count, axis, cached=False)

@@ -43,6 +43,13 @@ COUNTERS = [
     ("comm_splits", "communicators created by split/dup"),
     ("progress_polls", "progress engine passes"),
     ("time_in_wait", "seconds spent waiting for completions"),
+    # decision-audit pvars (fed by the coll/xla audit + trace subsystem)
+    ("coll_arm_native_count", "device collectives decided onto the native arm"),
+    ("coll_arm_staged_count", "device collectives decided onto the staged arm"),
+    ("coll_arm_quant_count", "device collectives decided onto the quant arm"),
+    ("coll_wire_bytes", "modeled per-rank wire bytes for device collectives"),
+    ("cache_miss_count", "device executable-cache misses (audit alias)"),
+    ("trace_dropped_events", "trace events lost to ring-buffer overflow"),
 ]
 
 
@@ -62,10 +69,19 @@ class Counters:
             self._peer_msgs[(direction, peer)] += 1
 
     def get(self, name: str) -> float:
+        # trace_dropped_events lives in the tracer (one ring set per
+        # process, not per Context) — read through so every pvar path
+        # (pvar_read, pvar_read_all, handles) sees the same value
+        if name == "trace_dropped_events":
+            from . import trace
+            return trace.dropped_events()
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
-        return dict(self._v)
+        out = dict(self._v)
+        from . import trace
+        out["trace_dropped_events"] = trace.dropped_events()
+        return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:
         """per-peer {direction: {peer: (messages, bytes)}} (monitoring dump)."""

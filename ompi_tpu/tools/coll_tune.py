@@ -332,6 +332,70 @@ def emit_device_rules(winners: dict, path: str,
         fh.write("\n".join(lines) + "\n")
 
 
+def explain_rules(rules_path: str, winners: dict, quiet: bool = False):
+    """Round-trip the just-emitted rules file through the coll/xla
+    decision layer: re-dispatch one collective per (coll, bytes) sweep
+    row with tracing on and print ``trace.explain_last`` — the arm the
+    decision layer picks under the new rules and the precedence link
+    that chose it (force var / blanket / rules row / floor veto).  A row
+    whose decided arm differs from the measured winner is exactly the
+    drift the audit exists to surface (e.g. a quant winner held under
+    the coll_quant_min_bytes floor)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import runtime, trace
+    from ompi_tpu.core import var
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+
+    ndev = len(jax.devices())
+    rows_n = ndev if ndev > 1 else 8
+    dispatched = ("allreduce", "bcast", "reduce_scatter", "alltoall")
+    var.registry.set_cli("coll_xla_dynamic_rules", rules_path)
+    var.registry.reset_cache()
+    trace.enable()
+    try:
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": ndev}), "x")
+            lines = []
+            for coll in dispatched:
+                for nbytes in sorted(winners.get(coll, {})):
+                    count = max(rows_n, int(nbytes) // 4)
+                    count -= count % rows_n
+                    x = jax.device_put(
+                        jnp.ones((rows_n, count), jnp.float32),
+                        c.device_comm.sharding())
+                    if coll == "allreduce":
+                        c.coll.allreduce(c, x)
+                    elif coll == "bcast":
+                        c.coll.bcast(c, x)
+                    elif coll == "reduce_scatter":
+                        c.coll.reduce_scatter(
+                            c, x, None, [count // rows_n] * rows_n)
+                    else:
+                        c.coll.alltoall(c, x.reshape(
+                            rows_n, rows_n, count // rows_n))
+                    exp = trace.explain_last(coll)
+                    if exp is not None:
+                        lines.append(
+                            f"explain {coll:14s} {int(nbytes):>9d}B -> "
+                            f"{exp['arm']:6s} (measured "
+                            f"{winners[coll][nbytes]:6s}) "
+                            f"because {exp['reason']}")
+            return lines
+
+        lines = runtime.run_ranks(1, fn, timeout=300)[0]
+        if not quiet:
+            for line in lines:
+                print(line, flush=True)
+        return lines
+    finally:
+        trace.disable()
+        var.registry.set_cli("coll_xla_dynamic_rules", "")
+        var.registry.reset_cache()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ranks", type=int, default=4)
@@ -380,6 +444,9 @@ def main(argv=None) -> int:
                   else "TUNE_DEVICE.json", "w") as fh:
             json.dump(out, fh, indent=1)
         print(f"wrote {args.device_rules_out}")
+        # decision-audit round trip: why does each sweep row take its arm
+        # under the rules we just wrote?
+        explain_rules(args.device_rules_out, winners)
         return 0
 
     rows = []

@@ -1,0 +1,291 @@
+"""ompi_tpu.trace — unified tracing + decision audit.
+
+One event schema shared by every instrumented layer:
+
+  * ``coll/xla``              — one DECISION instant per device-dispatched
+    collective: op, shape bucket, per-rank bytes, the arm chosen
+    (native | staged | quant) and the precedence link that chose it
+    (force var > blanket switch > rules row > byte floor > platform
+    default).  ``explain_last(op)`` returns the most recent one.
+  * ``parallel/collectives``  — executable-cache build spans + hit instants.
+  * ``coll/quant``            — quantized-arm execution spans with wire
+    bytes, block config and requantize count (the EQuARX accounting).
+  * ``osc``                   — epoch spans (mode native/staged) and
+    coalesced-put run instants; host-window fence spans.
+  * ``parallel/pipeline``     — one measured run span plus synthetic
+    per-tick spans (the host cannot see inside the jitted shard_map
+    program, so ticks are an even subdivision, marked ``synthetic``).
+
+Cost contract: every instrumented call site is gated on the module-level
+``trace.enabled`` flag — ONE attribute read on the disabled path, no
+argument construction, no locking.  Recording goes into a fixed-capacity
+per-rank ring buffer; overflow overwrites the oldest event and counts
+``trace_dropped_events`` (surfaced as an MPI_T pvar via ``spc``).
+
+Exporters: ``save_chrome(path)`` writes Chrome-trace JSON (object form,
+perfetto-loadable; pid = rank, tid = one lane per category so nested
+spans from different layers never collide), ``stats()``/``format_stats()``
+aggregate counts and span time per (category, name).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..core import var as _var
+
+_var.register("trace", "", "enabled", False, type=bool, level=3,
+              help="Record trace events (spans, instants, collective "
+                   "decision audits) into the per-rank ring buffers; "
+                   "off = one flag check per instrumented call site.")
+_var.register("trace", "", "buffer_events", 65536, type=int, level=4,
+              help="Per-rank trace ring-buffer capacity in events; "
+                   "overflow overwrites the oldest event and counts "
+                   "the trace_dropped_events pvar.")
+
+# THE gate.  Call sites do `if trace.enabled:` and nothing else on the
+# disabled path — keep this a plain module attribute, not a function.
+enabled: bool = bool(_var.get("trace_enabled", False))
+
+_lock = threading.Lock()
+_capacity: int = max(1, int(_var.get("trace_buffer_events", 65536)))
+_rings: Dict[int, "_Ring"] = {}
+_dropped: int = 0
+_last: Dict[str, Dict[str, Any]] = {}      # op -> most recent decision
+_t0: float = time.perf_counter()           # trace epoch (ts origin)
+
+
+class _Ring:
+    """Fixed-capacity overwrite-oldest event buffer (one per rank)."""
+
+    __slots__ = ("buf", "cap", "idx", "n")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = max(1, int(cap))
+        self.buf: List[Optional[dict]] = [None] * self.cap
+        self.idx = 0
+        self.n = 0
+
+    def append(self, ev: dict) -> bool:
+        """Store ``ev``; True when an old event was overwritten."""
+        overwrote = self.n == self.cap
+        self.buf[self.idx] = ev
+        self.idx = (self.idx + 1) % self.cap
+        if not overwrote:
+            self.n += 1
+        return overwrote
+
+    def events(self) -> List[dict]:
+        if self.n < self.cap:
+            return list(self.buf[:self.n])
+        return self.buf[self.idx:] + self.buf[:self.idx]
+
+
+# -- recording ---------------------------------------------------------------
+
+def enable(capacity: Optional[int] = None) -> None:
+    """Switch tracing on; ``capacity`` resizes the per-rank rings
+    (resizing drops already-recorded events)."""
+    global enabled, _capacity
+    if capacity is not None:
+        cap = max(1, int(capacity))
+        with _lock:
+            if cap != _capacity:
+                _capacity = cap
+                _rings.clear()
+    enabled = True
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+
+
+def clear() -> None:
+    """Drop all recorded events, decisions and the dropped counter."""
+    global _dropped
+    with _lock:
+        _rings.clear()
+        _last.clear()
+        _dropped = 0
+
+
+def _emit(ev: dict) -> None:
+    global _dropped
+    with _lock:
+        ring = _rings.get(ev["rank"])
+        if ring is None:
+            ring = _rings[ev["rank"]] = _Ring(_capacity)
+        if ring.append(ev):
+            _dropped += 1
+
+
+def instant(name: str, cat: str = "event", rank: int = 0,
+            args: Optional[dict] = None) -> None:
+    _emit({"name": name, "cat": cat, "ph": "i", "t": time.perf_counter(),
+           "rank": int(rank), "args": args or {}})
+
+
+def record_span(name: str, cat: str, t_begin: float, t_end: float,
+                rank: int = 0, args: Optional[dict] = None) -> None:
+    """Record an already-timed complete span (perf_counter() endpoints)."""
+    _emit({"name": name, "cat": cat, "ph": "X", "t": t_begin,
+           "dur": max(0.0, t_end - t_begin), "rank": int(rank),
+           "args": args or {}})
+
+
+class span:
+    """Context manager recording one complete span on exit.  Construct it
+    only behind a ``trace.enabled`` check — building ``args`` is the cost."""
+
+    __slots__ = ("name", "cat", "rank", "args", "_begin")
+
+    def __init__(self, name: str, cat: str = "span", rank: int = 0,
+                 args: Optional[dict] = None) -> None:
+        self.name, self.cat, self.rank, self.args = name, cat, rank, args
+
+    def __enter__(self) -> "span":
+        self._begin = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        record_span(self.name, self.cat, self._begin, time.perf_counter(),
+                    self.rank, self.args)
+        return False
+
+
+def decision(op: str, arm: str, reason: str, nbytes: int, rank: int = 0,
+             **details: Any) -> None:
+    """Record one collective decision-audit event and remember it for
+    ``explain_last(op)``."""
+    rec = {"op": op, "arm": arm, "reason": reason, "nbytes": int(nbytes),
+           "rank": int(rank)}
+    rec.update(details)
+    with _lock:
+        _last[op] = rec
+    _emit({"name": f"decide:{op}", "cat": "decision", "ph": "i",
+           "t": time.perf_counter(), "rank": int(rank), "args": rec})
+
+
+def explain_last(op: str) -> Optional[Dict[str, Any]]:
+    """Full precedence evaluation of the most recent decision for ``op``:
+    arm, reason (the link that chose it) and ``chain`` (every vetoed or
+    skipped link on the way).  None when no decision has been recorded
+    (e.g. tracing was off when the collective ran)."""
+    with _lock:
+        rec = _last.get(op)
+    return dict(rec) if rec is not None else None
+
+
+# -- accessors ---------------------------------------------------------------
+
+def events(rank: Optional[int] = None) -> List[dict]:
+    with _lock:
+        if rank is not None:
+            ring = _rings.get(int(rank))
+            return ring.events() if ring is not None else []
+        out: List[dict] = []
+        for r in sorted(_rings):
+            out.extend(_rings[r].events())
+    out.sort(key=lambda e: e["t"])
+    return out
+
+
+def dropped_events() -> int:
+    """Events lost to ring overflow since the last clear() (process-wide;
+    exported as the ``trace_dropped_events`` pvar)."""
+    return _dropped
+
+
+# -- exporters ---------------------------------------------------------------
+
+def _jsonable(d: Optional[dict]) -> dict:
+    out: Dict[str, Any] = {}
+    for k, v in (d or {}).items():
+        if isinstance(v, float) and not math.isfinite(v):
+            out[k] = None
+        elif isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        elif isinstance(v, (list, tuple)):
+            out[k] = [x if isinstance(x, (str, int, float, bool))
+                      or x is None else repr(x) for x in v]
+        else:
+            out[k] = repr(v)
+    return out
+
+
+def save_chrome(path: str, rank: Optional[int] = None) -> str:
+    """Write the buffered events as Chrome-trace JSON (object form with a
+    ``traceEvents`` list — loadable in perfetto / chrome://tracing).
+
+    pid = rank; tid = one lane per event category, so spans from
+    different layers (a compile span inside a quant span) never overlap
+    within a (pid, tid) lane.  Timestamps are µs since the trace epoch,
+    floor-rounded so span ends never cross the next span's start.
+    """
+    evs = events(rank)
+    tids: Dict[str, int] = {}
+    pids = set()
+    rows: List[dict] = []
+    for e in evs:
+        tid = tids.get(e["cat"])
+        if tid is None:
+            tid = tids[e["cat"]] = len(tids) + 1
+        pids.add(e["rank"])
+        ts = int((e["t"] - _t0) * 1e6)
+        row = {"name": e["name"], "cat": e["cat"], "ph": e["ph"],
+               "ts": ts, "pid": e["rank"], "tid": tid,
+               "args": _jsonable(e["args"])}
+        if e["ph"] == "X":
+            # floor both endpoints: ts+dur <= the true end, so ordered
+            # spans stay non-overlapping after µs rounding
+            row["dur"] = max(0, int((e["t"] + e["dur"] - _t0) * 1e6) - ts)
+        elif e["ph"] == "i":
+            row["s"] = "t"
+        rows.append(row)
+    meta: List[dict] = []
+    for pid in sorted(pids):
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": f"rank {pid}"}})
+        for cat, tid in sorted(tids.items(), key=lambda kv: kv[1]):
+            meta.append({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid, "args": {"name": cat}})
+    with open(path, "w") as fh:
+        json.dump({"traceEvents": meta + rows,
+                   "displayTimeUnit": "ms"}, fh)
+    return path
+
+
+def stats(rank: Optional[int] = None) -> Dict[str, Any]:
+    """Aggregate table: event count + total span µs per (cat, name),
+    decision-arm totals, and the dropped-event count."""
+    agg: Dict[str, Dict[str, float]] = {}
+    arms: Dict[str, int] = {}
+    for e in events(rank):
+        row = agg.setdefault(f"{e['cat']}:{e['name']}",
+                             {"count": 0, "total_us": 0.0})
+        row["count"] += 1
+        if e["ph"] == "X":
+            row["total_us"] += e["dur"] * 1e6
+        if e["cat"] == "decision":
+            arm = e["args"].get("arm", "?")
+            arms[arm] = arms.get(arm, 0) + 1
+    return {"events": dict(sorted(agg.items())), "decision_arms": arms,
+            "dropped_events": _dropped}
+
+
+def format_stats(rank: Optional[int] = None) -> str:
+    s = stats(rank)
+    lines = [f"{'cat:name':40s} {'count':>7s} {'total_us':>12s}"]
+    for key, row in s["events"].items():
+        lines.append(f"{key:40s} {row['count']:7.0f} "
+                     f"{row['total_us']:12.1f}")
+    if s["decision_arms"]:
+        lines.append("decision arms: " + ", ".join(
+            f"{a}={n}" for a, n in sorted(s["decision_arms"].items())))
+    lines.append(f"dropped events: {s['dropped_events']}")
+    return "\n".join(lines)

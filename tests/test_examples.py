@@ -34,9 +34,13 @@ def test_example_connectivity():
     assert "Connectivity test on 4 processes PASSED" in out
 
 
-def test_example_hello_and_spc():
+def test_example_hello_and_observability():
     assert "Hello, world" in _tpurun_example("hello.py", np_=2)
-    assert "sends" in _tpurun_example("spc_counters.py", np_=2)
+    out = _tpurun_example("observability_tour.py", np_=2)
+    assert "decision audit: allreduce -> quant" in out
+    assert "coll_arm_quant_count = 1" in out
+    assert "chrome trace written" in out
+    assert "observability tour PASSED" in out
 
 
 def test_example_oshmem():

@@ -481,3 +481,362 @@ def test_debugger_message_queue_dump():
         return True
 
     assert all(runtime.run_ranks(2, fn))
+
+
+# ---------------------------------------------------------------------------
+# Unified tracing + decision audit (ompi_tpu/trace): one audit event per
+# device collective matching the EXECUTED arm, Chrome-trace export,
+# disabled-path silence, ring-overflow accounting — plus the two satellite
+# fixes (quant wire bytes in the monitoring matrix; GC'd pvar handles).
+# ---------------------------------------------------------------------------
+
+import json
+
+import pytest
+
+from ompi_tpu import spc, trace
+
+
+class _Box:
+    """Minimal pvar bind target: anything with ``.spc`` is a Context to
+    the handle machinery — lets the tests control object lifetime."""
+
+    def __init__(self) -> None:
+        self.spc = spc.Counters()
+
+
+class TestTrace:
+    N = 8
+
+    @pytest.fixture(autouse=True)
+    def _tracing(self):
+        trace.clear()
+        trace.enable(capacity=65536)
+        yield
+        trace.disable()
+        trace.clear()
+
+    def _with_cli(self, settings, fn):
+        from ompi_tpu.core import var
+        for k, v in settings.items():
+            var.registry.set_cli(k, v)
+        var.registry.reset_cache()
+        try:
+            return runtime.run_ranks(1, fn)[0]
+        finally:
+            for k in settings:
+                var.registry.set_cli(k, "")
+            var.registry.reset_cache()
+
+    @staticmethod
+    def _device_rows(c, shape, seed=0, dtype=np.float32):
+        import jax
+        import jax.numpy as jnp
+        host = np.random.default_rng(seed).standard_normal(shape).astype(
+            dtype)
+        return host, jax.device_put(jnp.asarray(host),
+                                    c.device_comm.sharding())
+
+    # -- decision audit vs executed arm, one per precedence link ------------
+
+    def test_trace_audit_force(self):
+        pytest.importorskip("jax")
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 512), seed=1)
+            c.coll.allreduce(c, x)
+            rec = trace.explain_last("allreduce")
+            assert rec is not None
+            assert rec["arm"] == "quant"
+            assert rec["reason"] == "force:coll_xla_allreduce_mode=quant"
+            # the arm the audit NAMES is the arm the engine RAN
+            assert ctx.spc._v.get("device_quant_collectives", 0) == 1
+            assert ctx.spc.get("coll_arm_quant_count") == 1
+            assert ctx.spc.get("coll_wire_bytes") == rec["wire_bytes"]
+            assert rec["wire_bytes"] < rec["nbytes"] * 2 * (self.N - 1)
+            return True
+
+        assert self._with_cli({"coll_xla_allreduce_mode": "quant"}, fn)
+
+    def test_trace_audit_blanket(self):
+        pytest.importorskip("jax")
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 512), seed=2)
+            c.coll.allreduce(c, x)
+            rec = trace.explain_last("allreduce")
+            assert rec["arm"] == "quant"
+            assert rec["reason"] == "blanket:COLL_QUANT=on"
+            assert ctx.spc._v.get("device_quant_collectives", 0) == 1
+            return True
+
+        assert self._with_cli({"COLL_QUANT": "on"}, fn)
+
+    def test_trace_audit_rules(self, tmp_path):
+        pytest.importorskip("jax")
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        rules = tmp_path / "rules.conf"
+        rules.write_text("allreduce 1 0 staged\n")
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 512), seed=3)
+            c.coll.allreduce(c, x)
+            rec = trace.explain_last("allreduce")
+            assert rec["arm"] == "staged"
+            assert rec["reason"] == "rule:allreduce 1 0 staged"
+            assert ctx.spc._v.get("coll_staged_fallbacks", 0) == 1
+            assert ctx.spc.get("coll_arm_staged_count") == 1
+            return True
+
+        assert self._with_cli({"coll_xla_dynamic_rules": str(rules)}, fn)
+
+    def test_trace_audit_floor(self, tmp_path):
+        """A quant rule below the byte floor is vetoed; the veto is the
+        deciding word and the exact arm carries the call."""
+        pytest.importorskip("jax")
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        rules = tmp_path / "rules.conf"
+        rules.write_text("allreduce 1 0 quant\n")
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 512), seed=4)  # 2 KiB/rank
+            c.coll.allreduce(c, x)
+            rec = trace.explain_last("allreduce")
+            assert rec["arm"] == "native"
+            assert rec["reason"] == ("floor:coll_quant_min_bytes=1048576"
+                                     ">2048 (vetoed rule:allreduce 1 0 "
+                                     "quant)")
+            assert rec["reason"] in rec["chain"]
+            assert ctx.spc._v.get("device_quant_collectives", 0) == 0
+            assert ctx.spc._v.get("coll_staged_fallbacks", 0) == 0
+            assert ctx.spc.get("coll_arm_native_count") == 1
+            return True
+
+        assert self._with_cli({"coll_xla_dynamic_rules": str(rules)}, fn)
+
+    def test_trace_one_decision_per_collective(self):
+        """Every entry that funnels through the coll/xla decision layer
+        emits EXACTLY one decision-audit event per dispatch (the ISSUE
+        acceptance), on the full 8-device CPU mesh."""
+        pytest.importorskip("jax")
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 64), seed=5)
+            _, x2 = self._device_rows(c, (self.N, self.N), seed=6)
+            _, x3 = self._device_rows(c, (self.N, self.N, 4), seed=7)
+            _, xa = self._device_rows(c, (self.N, self.N, 8), seed=8)
+            c.coll.allreduce(c, x)
+            c.coll.bcast(c, x)
+            c.coll.allgather(c, x)
+            c.coll.alltoall(c, xa)
+            c.coll.reduce_scatter_block(c, x)
+            c.coll.reduce(c, x)
+            c.coll.scan(c, x)
+            c.coll.exscan(c, x)
+            c.coll.gather(c, x)
+            c.coll.scatter(c, x3)
+            c.coll.reduce_scatter(c, x, None, [8] * self.N)
+            c.coll.allgatherv(c, x2, counts=[4] * self.N)
+            expected = {"allreduce", "bcast", "allgather", "alltoall",
+                        "reduce_scatter_block", "reduce", "scan",
+                        "exscan", "gather", "scatter", "reduce_scatter",
+                        "allgatherv"}
+            per_op = {}
+            for e in trace.events():
+                if e["cat"] != "decision":
+                    continue
+                per_op[e["args"]["op"]] = per_op.get(e["args"]["op"], 0) + 1
+                assert e["args"]["arm"] in ("native", "staged", "quant")
+                assert e["args"]["reason"]
+                assert e["args"]["ndev"] == self.N
+            assert per_op == {op: 1 for op in expected}
+            # default decisions on the CPU fabric: alltoall stages below
+            # 32 MB/rank, everything else (quant off) runs native
+            assert trace.explain_last("alltoall")["arm"] == "staged"
+            assert trace.explain_last("allreduce")["arm"] == "native"
+            arms = sum(ctx.spc.get(f"coll_arm_{a}_count")
+                       for a in ("native", "staged", "quant"))
+            assert arms == len(expected)
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
+
+    # -- Chrome-trace export -------------------------------------------------
+
+    def test_trace_chrome_roundtrip(self, tmp_path):
+        """save_chrome output loads back through json.load; per (pid, tid)
+        lane the complete spans are monotonic and non-overlapping after µs
+        rounding (the synthetic pipeline ticks are adjacent spans — the
+        worst case for the rounding guarantee)."""
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+        from ompi_tpu.parallel.pipeline import (pipeline,
+                                                shard_stage_params,
+                                                stack_stage_params)
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 512), seed=9)
+            c.coll.allreduce(c, x)     # forced quant: quant span + decision
+            return True
+
+        assert self._with_cli({"coll_xla_allreduce_mode": "quant"}, fn)
+
+        mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+        d = 8
+        layers = [{"w": jnp.eye(d) * 0.5, "b": jnp.zeros((d,))}
+                  for _ in range(4)]
+
+        def stage_fn(stage_params, x):
+            def body(h, p):
+                return jnp.tanh(h @ p["w"] + p["b"]), None
+            out, _ = jax.lax.scan(body, x, stage_params)
+            return out
+
+        sharded = shard_stage_params(stack_stage_params(layers, 4),
+                                     mesh, "pp")
+        mbs = jnp.ones((4, 2, d))
+        pipeline(stage_fn, sharded, mbs, mesh, "pp")
+
+        path = tmp_path / "trace.json"
+        assert trace.save_chrome(str(path)) == str(path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        evs = doc["traceEvents"]
+        assert isinstance(evs, list) and evs
+        assert {"M", "X", "i"} <= {e["ph"] for e in evs}
+        names = {e["name"] for e in evs}
+        assert {"decide:allreduce", "quant:allreduce",
+                "pipeline:run", "pipeline:tick"} <= names
+        assert any(e["ph"] == "M" and e["name"] == "process_name"
+                   for e in evs)
+        lanes = {}
+        for e in evs:
+            if e["ph"] != "X":
+                continue
+            assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+            assert e["dur"] >= 0
+            lanes.setdefault((e["pid"], e["tid"]), []).append(e)
+        assert lanes
+        for spans in lanes.values():
+            ordered = sorted(spans, key=lambda e: e["ts"])
+            for a, b in zip(ordered, ordered[1:]):
+                assert a["ts"] + a["dur"] <= b["ts"], (a, b)
+        # 7 adjacent synthetic ticks (M=4 microbatches + P=4 stages - 1)
+        assert sum(e["name"] == "pipeline:tick" for e in evs) == 7
+
+    # -- disabled path + overflow -------------------------------------------
+
+    def test_trace_disabled_zero_events(self):
+        pytest.importorskip("jax")
+        from ompi_tpu.parallel import attach_mesh, make_mesh
+
+        trace.disable()
+        trace.clear()
+
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": self.N}), "x")
+            _, x = self._device_rows(c, (self.N, 64), seed=10)
+            c.coll.allreduce(c, x)
+            # arm pvars still count (plain SPC adds, not trace events)
+            assert ctx.spc.get("coll_arm_native_count") == 1
+            assert ctx.spc.get("trace_dropped_events") == 0
+            return True
+
+        assert runtime.run_ranks(1, fn)[0]
+        assert trace.events() == []
+        assert trace.explain_last("allreduce") is None
+
+    def test_trace_ring_overflow_counts_dropped(self):
+        trace.enable(capacity=8)
+        for i in range(20):
+            trace.instant(f"e{i}", "event")
+        assert len(trace.events()) == 8
+        assert trace.dropped_events() == 12
+        # newest survive; oldest were overwritten
+        assert [e["name"] for e in trace.events()] == [
+            f"e{i}" for i in range(12, 20)]
+        # surfaced through every pvar read path with no inventory changes
+        box = _Box()
+        assert box.spc.get("trace_dropped_events") == 12
+        assert mpit.pvar_read_all(box)["trace_dropped_events"] == 12
+        assert mpit.pvar_read(box, "trace_dropped_events") == 12
+        trace.clear()
+        assert trace.dropped_events() == 0
+
+
+# -- satellite: quantized collectives price the monitoring matrix at wire
+# bytes (int8 payload + block scales), not the logical f32 size ------------
+
+def test_trace_quant_wire_bytes_in_monitoring():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from ompi_tpu import monitoring
+    from ompi_tpu.coll.quant import wire_bytes
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": 2}, devices=jax.devices()[:2]), "x")
+        if ctx.rank == 0:
+            mon = monitoring.install(ctx)
+            host = np.random.default_rng(11).standard_normal(
+                (2, 512)).astype(np.float32)
+            x = jax.device_put(jnp.asarray(host), c.device_comm.sharding())
+            c.coll.allreduce(c, x)
+            expect = wire_bytes("allreduce", 512, 2,
+                                np.float32)["quant_bytes"]
+            msgs, nbytes = mon.peers["coll"][1]
+            assert msgs == 1 and nbytes == expect, (msgs, nbytes, expect)
+        c.barrier()
+        return True
+
+    var.registry.set_cli("coll_xla_allreduce_mode", "quant")
+    var.registry.reset_cache()
+    try:
+        assert all(runtime.run_ranks(2, fn))
+    finally:
+        var.registry.set_cli("coll_xla_allreduce_mode", "")
+        var.registry.reset_cache()
+
+
+# -- satellite: reading a pvar handle whose bound object was GC'd raises
+# MPI_T_ERR_INVALID_HANDLE instead of reporting a stale cached value -------
+
+def test_trace_pvar_handle_gc_raises():
+    import gc
+
+    box = _Box()
+    s = mpit.pvar_session_create()
+    h = mpit.pvar_handle_alloc(s, "isends", box)
+    h.start()
+    assert h.read() == 0.0           # alive: reads fine
+    del box
+    gc.collect()
+    with pytest.raises(mpit.MPITError) as ei:
+        h.read()
+    assert "MPI_T_ERR_INVALID_HANDLE" in str(ei.value)
+    assert "garbage-collected" in str(ei.value)
+    assert ei.value.code == "invalid_handle"
+    # every handle operation is fenced, not just read
+    for op in (h.start, h.stop, h.reset, h.readreset):
+        with pytest.raises(mpit.MPITError):
+            op()
