@@ -5,7 +5,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 quant-tests trace-tests
+.PHONY: tier1 quant-tests trace-tests overlap-tests
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -30,3 +30,11 @@ quant-tests:
 trace-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
 	  -q -k "trace or wire or handle" -p no:cacheprovider -p no:randomly
+
+# the comm/compute overlap tier: bucketed grad sync + collective-matmul
+# rings, INCLUDING the multi-device tests marked slow (excluded from
+# tier-1 to keep its wall clock inside the 870 s budget)
+overlap-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_overlap.py \
+	  tests/test_ops.py -k "CollectiveMatmul or overlap" -q \
+	  -p no:cacheprovider -p no:randomly

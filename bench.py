@@ -204,13 +204,15 @@ def run_flagship(platform: str, do_ab: bool = True,
                                            f"{last_err}"}
 
 
-def _measure_steps(cfg, batch: int, rng, reps: int):
+def _measure_steps(cfg, batch: int, rng, reps: int, mesh=None):
     """ONE copy of the chained-donated-steps timing discipline, shared by
     the main flagship run and every A/B variant: init, 2 warmup steps
     (compile + donation cycle), `reps` timed chained steps, device-value
     read barrier. Everything allocated here (params, optimizer, compiled
     step) is dropped before return, so successive calls see clean HBM.
-    Returns (seconds_per_step, tokens_per_s, n_params, final_loss)."""
+    With a mesh the token batch is dp-sharded (the grad-sync arms need
+    the real multi-device layout). Returns (seconds_per_step,
+    tokens_per_s, n_params, final_loss)."""
     import jax
     import jax.numpy as jnp
 
@@ -219,11 +221,16 @@ def _measure_steps(cfg, batch: int, rng, reps: int):
     params = opt_state = step = toks = loss = None
     try:
         params = init_params(jax.random.key(0), cfg)
-        init_opt, step = make_train_step(cfg)
+        init_opt, step = make_train_step(cfg, mesh)
         opt_state = init_opt(params)
         toks = [jnp.asarray(rng.integers(0, cfg.vocab,
                                          (batch, cfg.seq + 1)), jnp.int32)
                 for _ in range(4)]
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            spec = P("dp" if "dp" in mesh.axis_names else None, None)
+            toks = [jax.device_put(t, NamedSharding(mesh, spec))
+                    for t in toks]
         for k in range(2):
             params, opt_state, loss = step(params, opt_state, toks[k])
         float(jax.device_get(loss))            # sync before timing
@@ -302,6 +309,100 @@ def _flagship_ab(base_cfg, batch: int, rng) -> list:
             out.append({"variant": label,
                         "error": msg.replace("|", "\\|")[:200]})
     return out
+
+
+def run_gradsync(platform: str) -> list:
+    """Gradient-sync scheduler arms on the dp mesh, through the SAME
+    chained-donated-steps discipline as the flagship: per-leaf native
+    pmean storm (the baseline), bucketed backward-overlapped sync
+    (parallel/overlap, ~4 MiB buckets), GSPMD native, and the unsynced
+    compute floor. The floor turns arm deltas into overlap efficiency:
+    eff = 1 − (t_arm − t_floor)/(t_perleaf − t_floor) — 1.0 means the
+    sync cost fully hid behind backward compute. busbw is the allreduce
+    convention (2(R−1)/R × grad bytes) over the arm's sync time (t_arm −
+    t_floor). Returns banked result rows (one comparison row; a skip row
+    on a single device, where there is no dp axis to sync)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.models.transformer import Config, init_params
+    from ompi_tpu.parallel import make_mesh, overlap
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        return [{"collective": "grad_sync_bucketed_vs_perleaf",
+                 "bytes_per_rank": 0,
+                 "skipped": "needs >= 2 devices for a dp axis"}]
+    mesh = make_mesh({"dp": ndev})
+    # bucket target: the ~4 MiB coll_xla_grad_bucket_bytes default on a
+    # real fabric (amortizes the per-collective dispatch latency the
+    # bucketing exists to kill); on the cpu host fabric dispatch is
+    # nearly free and the flat-buffer copies dominate, so the tuned
+    # bucket sits much smaller — docs/overlap.md, "picking the bucket
+    # size"
+    bucket_bytes = (256 << 10) if platform == "cpu" else None
+    base = dict(vocab=2048, d_model=256, n_layers=4, n_heads=4,
+                head_dim=64, d_ff=1024, seq=256, dtype=jnp.float32,
+                attn="dense", grad_bucket_bytes=bucket_bytes)
+    batch = ndev
+    reps = 5 if platform == "cpu" else 10
+
+    params = init_params(jax.random.key(0), Config(**base))
+    leaves = jax.tree.leaves(params)
+    total_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+    plan = overlap.bucket_plan(leaves, overlap.resolve_bucket_bytes(
+        bucket_bytes))
+    del params, leaves
+
+    times, losses = {}, {}
+    for arm in ("perleaf", "bucketed", "native", "unsynced"):
+        cfg = Config(**base, grad_sync=arm)
+        # fresh identically-seeded rng per arm: every arm must train on
+        # the SAME token stream or the comparison times different work
+        dt, _tps, _n, final = _measure_steps(
+            cfg, batch, np.random.default_rng(0), reps=reps, mesh=mesh)
+        times[arm], losses[arm] = dt, final
+        print(f"gradsync {arm:9s} step {dt * 1e3:8.2f} ms  "
+              f"loss {final:.4f}", flush=True)
+
+    floor = times["unsynced"]
+    comm_span = times["perleaf"] - floor
+
+    def eff(arm):
+        if comm_span <= 0:
+            return None        # noise swamped the sync cost — no signal
+        return round(1.0 - (times[arm] - floor) / comm_span, 3)
+
+    def busbw(arm):
+        t_sync = times[arm] - floor
+        if t_sync <= 0:
+            return None
+        return round(2 * (ndev - 1) / ndev * total_bytes / t_sync / 1e9,
+                     3)
+
+    return [{
+        "collective": "grad_sync_bucketed_vs_perleaf",
+        "bytes_per_rank": total_bytes,
+        "ranks": ndev,
+        "device_us": round(times["bucketed"] * 1e6, 1),
+        "staged_us": round(times["perleaf"] * 1e6, 1),
+        "native_us": round(times["native"] * 1e6, 1),
+        "unsynced_us": round(floor * 1e6, 1),
+        "speedup_vs_staged": round(times["perleaf"] / times["bucketed"],
+                                   3),
+        "collectives_perleaf": plan.n_leaves,
+        "collectives_bucketed": plan.n_buckets,
+        "max_buckets": plan.max_buckets,
+        "bucket_bytes": plan.bucket_bytes,
+        "busbw_GBps_bucketed": busbw("bucketed"),
+        "busbw_GBps_perleaf": busbw("perleaf"),
+        "overlap_efficiency_bucketed": eff("bucketed"),
+        "overlap_efficiency_perleaf": eff("perleaf"),
+        "loss_finite": all(np.isfinite(v) for v in losses.values()),
+        "batch": batch, "seq": base["seq"],
+        "note": "full train-step times; step config d_model "
+                f"{base['d_model']} x {base['n_layers']}L, f32",
+    }]
 
 
 def run_sweep(platform: str) -> dict:
@@ -1018,7 +1119,10 @@ def update_baseline_md(sweep: dict) -> None:
     # 8 B collective takes milliseconds, the device column is measuring the
     # tunnel round trip, not the chip — label the table so those rows are
     # never quoted as device performance
-    measured_us = [r["device_us"] for r in sweep["results"]
+    gradsync_rows = [r for r in sweep["results"]
+                     if str(r.get("collective", "")).startswith("grad_sync")]
+    coll_rows = [r for r in sweep["results"] if r not in gradsync_rows]
+    measured_us = [r["device_us"] for r in coll_rows
                    if "device_us" in r]
     floor_bound = (not is_cpu and sweep["ndev"] == 1 and measured_us
                    and min(measured_us) > 5000.0)
@@ -1056,7 +1160,7 @@ def update_baseline_md(sweep: dict) -> None:
         "quant µs/op (byte-ratio, rel-err) | speedup |",
         "|---|---|---|---|---|---|---|---|---|",
     ]
-    for r in sweep["results"]:
+    for r in coll_rows:
         if "skipped" in r:
             lines.append(
                 f"| {r['collective']} | {r['bytes_per_rank']} | "
@@ -1079,6 +1183,44 @@ def update_baseline_md(sweep: dict) -> None:
                 f"{r.get('staged_us') or '—'} | "
                 f"{ch_gb} | {ch_bb} | {q_cell} | "
                 f"{f'{sp}×' if sp is not None else '—'} |")
+    if gradsync_rows:
+        lines += [
+            "",
+            "Gradient-sync scheduler arms (parallel/overlap; FULL "
+            "train-step wall clock per arm, chained donated steps — the "
+            "overlap win must survive the whole step, not a collective "
+            "microbench). `overlap eff` = 1 − (t_arm − t_floor)/"
+            "(t_perleaf − t_floor) against the unsynced compute floor "
+            "(1.0 = sync fully hidden behind backward); `busbw` = "
+            "2(R−1)/R × grad bytes / (t_arm − t_floor):",
+            "",
+            "| arm comparison | grad bytes/rank | collectives "
+            "(perleaf→bucketed ≤ cap) | bucketed µs | perleaf µs | "
+            "native µs | floor µs | busbw bucketed | busbw perleaf | "
+            "overlap eff (bucketed / perleaf) | speedup |",
+            "|---|---|---|---|---|---|---|---|---|---|---|",
+        ]
+        for r in gradsync_rows:
+            if "skipped" in r:
+                lines.append(f"| {r['collective']} | — | *skipped: "
+                             f"{r['skipped']}* | | | | | | | | |")
+                continue
+
+            def _f(v, unit=""):
+                return f"{v}{unit}" if v is not None else "—"
+
+            lines.append(
+                f"| {r['collective']} | {r['bytes_per_rank']} | "
+                f"{r['collectives_perleaf']}→{r['collectives_bucketed']} "
+                f"≤ {r['max_buckets']} | {r['device_us']} | "
+                f"{r['staged_us']} | {r['native_us']} | "
+                f"{r['unsynced_us']} | "
+                f"{_f(r['busbw_GBps_bucketed'], ' GB/s')} | "
+                f"{_f(r['busbw_GBps_perleaf'], ' GB/s')} | "
+                f"{_f(r['overlap_efficiency_bucketed'])} / "
+                f"{_f(r['overlap_efficiency_perleaf'])} | "
+                f"{r['speedup_vs_staged']}× |")
+        lines.append("")
     lines += ["", end]
     block = "\n".join(lines)
     if begin in text and end in text:
@@ -1183,7 +1325,8 @@ def main() -> None:
         # flagship headline first, then continue with ab/sweep in a
         # later healthy window without re-measuring what already landed)
         phases = [p.strip() for p in os.environ.get(
-            "OMPI_TPU_BENCH_PHASES", "flagship,ab,sweep").split(",") if p]
+            "OMPI_TPU_BENCH_PHASES",
+            "flagship,ab,sweep,gradsync").split(",") if p]
         here = os.path.dirname(os.path.abspath(__file__))
         ck_path = os.path.join(here, f"BENCH_FLAGSHIP_{platform}.json")
         fname = f"BENCH_SWEEP_{platform}_{len(jax.devices())}dev.json"
@@ -1238,6 +1381,13 @@ def main() -> None:
         else:
             sweep = {"platform": platform, "ndev": len(jax.devices()),
                      "ranks": len(jax.devices()) or 1, "results": []}
+        if "gradsync" in phases:
+            # fresh grad-sync rows replace any banked ones (a reused
+            # sweep may carry stale arms from an older bucket config)
+            sweep["results"] = [
+                r for r in sweep.get("results", [])
+                if not str(r.get("collective", "")).startswith("grad_sync")
+            ] + run_gradsync(platform)
         sweep["flagship"] = flagship
         # platform + device count in the FILENAME — a cpu fallback writes
         # alongside tpu evidence, never over it
@@ -1245,7 +1395,9 @@ def main() -> None:
             json.dump(sweep, f, indent=1)
         update_baseline_md(sweep)
 
-        measured = [r for r in sweep["results"] if "skipped" not in r]
+        measured = [r for r in sweep["results"] if "skipped" not in r
+                    and not str(r.get("collective", ""))
+                    .startswith("grad_sync")]
         ns = [r for r in measured
               if r["collective"] == "allreduce"
               and r["bytes_per_rank"] == NORTH_STAR_COUNT * 4]

@@ -244,6 +244,20 @@ def _span_args(wb: dict, block: int, sdt, roundings: int,
             "roundings": roundings, "requantize_count": requantize_count}
 
 
+def grad_bucket_span_args(nbytes: int, n: int, dtype, block: int = None,
+                          scale_dtype=None) -> dict:
+    """EQuARX accounting for ONE quantized grad-sync bucket of `nbytes`
+    raw gradient bytes allreduced over `n` devices — the detail payload
+    attached to parallel/overlap's per-bucket decision events and spans.
+    psum_quant's path rounds each element twice (quantize + the
+    post-accumulate requantize) and requantizes the accumulated value
+    once, hence the fixed counts."""
+    block, sdt = _params(block, scale_dtype)
+    count = max(1, int(nbytes) // np.dtype(dtype).itemsize)
+    wb = wire_bytes("allreduce", count, n, dtype, block, sdt)
+    return _span_args(wb, block, sdt, roundings=2, requantize_count=1)
+
+
 class QuantDeviceComm:
     """Quantized collectives over a DeviceComm's mesh axis, same
     canonical (R, *elem) dim-0-sharded layout and executable cache

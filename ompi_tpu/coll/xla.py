@@ -56,7 +56,13 @@ _var.register("coll", "xla", "mode", "", type=str, level=3,
 _var.register("coll", "xla", "dynamic_rules", "", type=str, level=4,
               help="Path to a device decision rules file: lines of "
                    "'<coll> <min_ndev> <min_bytes> "
-                   "<native|staged|quant>'.")
+                   "<native|staged|quant|bidir>'.")
+_var.register("coll", "xla", "grad_bucket_bytes", 4 << 20, type=int, level=3,
+              help="Target bytes per gradient-sync bucket for the "
+                   "bucketed overlap tier (parallel/overlap): grads are "
+                   "flattened into fixed-byte buckets in reverse-layer "
+                   "order and each bucket allreduces as soon as its "
+                   "leaves are produced in the backward pass.")
 # the blanket quantization switch (env OMPI_TPU_COLL_QUANT):
 #   on/force -> quantize every eligible reduction at any size
 #   off      -> never pick quant, even when a rules file says so
@@ -73,14 +79,29 @@ _DECIDED = ("allreduce", "reduce", "bcast", "allgather", "alltoall",
             "reduce_scatter_block", "scan", "exscan", "allgatherv",
             "gather", "gatherv", "scatter", "scatterv", "alltoallv",
             "reduce_scatter")
-# entries with a quantized arm (coll/quant engine entry points)
+# entries with a quantized arm (coll/quant engine entry points; grad_sync
+# buckets ride psum_quant so they carry one too)
 _QUANT_COLLS = ("allreduce", "reduce_scatter_block", "reduce_scatter",
-                "allgather")
+                "allgather", "grad_sync")
 for _c in _DECIDED:
     _var.register("coll", "xla", f"{_c}_mode", "", type=str, level=3,
                   help=f"Force the {_c} device mode (native|staged"
                        + ("|quant" if _c in _QUANT_COLLS else "")
                        + "; empty = auto).")
+# overlap-tier decision points (not XlaModule entries): the bucketed
+# gradient sync (parallel/overlap) and the collective-matmul ring
+# direction (ops/collective_matmul via Config(tp_overlap="fused"))
+_var.register("coll", "xla", "grad_sync_mode", "", type=str, level=3,
+              help="Force the gradient-sync bucket arm (native|quant; "
+                   "empty = auto via DEVICE_RULES grad_sync rows).")
+_var.register("coll", "xla", "collmm_mode", "", type=str, level=3,
+              help="Force the collective-matmul ring schedule "
+                   "(native = unidirectional ring | bidir = two "
+                   "half-rings on both ICI directions; empty = auto "
+                   "via DEVICE_RULES collmm rows).")
+
+# every mode any decision point can name (rules-file vocabulary)
+_MODES = ("native", "staged", "quant", "bidir")
 
 
 def _load_device_rules():
@@ -106,12 +127,117 @@ def _load_device_rules():
                         f"{path}:{lineno}: bad device rule {line!r} "
                         "(want '<coll> <min_ndev> <min_bytes> "
                         f"<native|staged>'): {exc}") from None
-                if mode not in ("native", "staged", "quant"):
+                if mode not in _MODES:
                     raise ValueError(
                         f"{path}:{lineno}: unknown device mode {mode!r} "
-                        "(want native, staged or quant)")
+                        f"(want one of {', '.join(_MODES)})")
                 rules.append((coll, min_ndev, min_bytes, mode))
     return rules
+
+
+def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
+                rules, allowed, quant_ok: bool = False,
+                dtype=None, op: Op = None) -> tuple:
+    """The device decision-precedence chain as a reusable module-level
+    function, returned as (arm, reason, chain): per-entry force var >
+    blanket coll_xla_mode > blanket COLL_QUANT > platform default, then
+    DEVICE_RULES rows (later lines win; quant rows vetoed by the off
+    switch, the coll_quant_min_bytes floor, or op/dtype/layout
+    ineligibility).  ``reason`` is the link that decided; ``chain``
+    records every vetoed/skipped link so trace.explain_last can show the
+    full evaluation.
+
+    ``allowed`` is the set of arms the calling entry can actually execute
+    for this buffer/op — the decision never names an arm the entry would
+    silently ignore.  XlaModule dispatches funnel through here (via
+    ``_decide``); the overlap tier calls it directly with the coll names
+    ``grad_sync`` (bucketed dp gradient sync, native|quant) and
+    ``collmm`` (collective-matmul ring direction, native|bidir).
+    """
+    from .quant import check_quantizable
+
+    chain: list = []
+    qvar = str(_var.get("COLL_QUANT", "") or "").strip().lower()
+    ent = _var.get(f"coll_xla_{coll}_mode", "")
+    forced = ent or _var.get("coll_xla_mode", "")
+    src = f"coll_xla_{coll}_mode" if ent else "coll_xla_mode"
+    if forced:
+        if forced not in _MODES:
+            raise ValueError(
+                f"coll_xla mode for {coll!r} is {forced!r} "
+                f"(want one of {', '.join(_MODES)})")
+        if forced == "quant":
+            if coll in _QUANT_COLLS:
+                if "quant" in allowed:
+                    # invalid op/dtype under an explicit quant force
+                    # must fail loudly, not silently take the exact
+                    # path
+                    check_quantizable(op or SUM,
+                                      dtype if dtype is not None
+                                      else np.float32)
+                    return "quant", f"force:{src}=quant", chain
+                chain.append(f"force:{src}=quant skipped "
+                             "(layout has no quantized arm)")
+            elif ent:
+                raise ValueError(
+                    f"collective {coll!r} has no quantized arm "
+                    f"(quant applies to {', '.join(_QUANT_COLLS)})")
+            else:
+                chain.append("force:coll_xla_mode=quant skipped "
+                             "(entry has no quantized arm)")
+            # global quant force: entries without a quantized arm
+            # keep the auto decision below
+        elif forced in allowed:
+            return forced, f"force:{src}={forced}", chain
+        else:
+            chain.append(f"force:{src}={forced} skipped "
+                         f"(no {forced} kernel for this op/layout)")
+    q_ok = quant_ok and "quant" in allowed
+    if qvar in ("1", "on", "true", "yes", "force"):
+        if q_ok:
+            return "quant", f"blanket:COLL_QUANT={qvar}", chain
+        if coll in _QUANT_COLLS:
+            chain.append(f"blanket:COLL_QUANT={qvar} skipped "
+                         "(op/dtype/layout ineligible)")
+    if platform == "cpu":
+        # sweep-derived (BENCH_SWEEP_cpu_8dev.json): dense alltoall
+        # staged wins 1KB-16MB/rank on the CPU fabric; all else native
+        pick = "staged" if (coll == "alltoall"
+                            and nbytes < (32 << 20)) else "native"
+    else:
+        pick = "native"       # staging crosses the host bridge
+    if pick not in allowed:
+        pick = "native"
+    reason = f"default:platform={platform}"
+    quant_off = qvar in ("0", "off", "false", "no")
+    floor = int(_var.get("coll_quant_min_bytes", 1 << 20))
+    for c, mn, mb, mode in rules:
+        if c != coll or ndev < mn or nbytes < mb:
+            continue
+        rule = f"rule:{c} {mn} {mb} {mode}"
+        if mode == "quant":
+            # vetoed rule: keep the prior pick, but the veto IS the
+            # deciding word unless a later rule overrides it
+            if quant_off:
+                reason = f"off:COLL_QUANT={qvar} (vetoed {rule})"
+                chain.append(reason)
+                continue
+            if not q_ok:
+                reason = f"ineligible:op/dtype/layout (vetoed {rule})"
+                chain.append(reason)
+                continue
+            if nbytes < floor:
+                reason = (f"floor:coll_quant_min_bytes={floor}"
+                          f">{nbytes} (vetoed {rule})")
+                chain.append(reason)
+                continue
+        elif mode not in allowed:
+            chain.append(f"{rule} skipped (no {mode} kernel)")
+            continue
+        pick = mode
+        reason = rule
+        chain.append(rule)
+    return pick, reason, chain
 
 
 # numpy reduction kernels for the staged arm (standard MPI ops only; a
@@ -173,96 +299,13 @@ class XlaModule(CollModule):
         return pick
 
     def _decide(self, coll: str, x, op: Op, allowed) -> tuple:
-        """The precedence chain, returned as (arm, reason, chain):
-        per-entry force var > blanket coll_xla_mode > blanket COLL_QUANT
-        > platform default, then DEVICE_RULES rows (later lines win;
-        quant rows vetoed by the off switch, the coll_quant_min_bytes
-        floor, or op/dtype/layout ineligibility).  ``reason`` is the link
-        that decided; ``chain`` records every vetoed/skipped link so
-        trace.explain_last can show the full evaluation."""
-        from .quant import check_quantizable
-
-        chain: list = []
-        qvar = str(_var.get("COLL_QUANT", "") or "").strip().lower()
-        ent = _var.get(f"coll_xla_{coll}_mode", "")
-        forced = ent or _var.get("coll_xla_mode", "")
-        src = f"coll_xla_{coll}_mode" if ent else "coll_xla_mode"
-        if forced:
-            if forced not in ("native", "staged", "quant"):
-                raise ValueError(
-                    f"coll_xla mode for {coll!r} is {forced!r} "
-                    "(want native, staged or quant)")
-            if forced == "quant":
-                if coll in _QUANT_COLLS:
-                    if "quant" in allowed:
-                        # invalid op/dtype under an explicit quant force
-                        # must fail loudly, not silently take the exact
-                        # path
-                        check_quantizable(op or SUM, x.dtype)
-                        return "quant", f"force:{src}=quant", chain
-                    chain.append(f"force:{src}=quant skipped "
-                                 "(layout has no quantized arm)")
-                elif ent:
-                    raise ValueError(
-                        f"collective {coll!r} has no quantized arm "
-                        f"(quant applies to {', '.join(_QUANT_COLLS)})")
-                else:
-                    chain.append("force:coll_xla_mode=quant skipped "
-                                 "(entry has no quantized arm)")
-                # global quant force: entries without a quantized arm
-                # keep the auto decision below
-            elif forced in allowed:
-                return forced, f"force:{src}={forced}", chain
-            else:
-                chain.append(f"force:{src}={forced} skipped "
-                             f"(no {forced} kernel for this op/layout)")
+        """Module-entry shim over :func:`decide_mode`: per-RANK bytes from
+        the canonical layout, quant eligibility from the op/dtype gate."""
         nbytes = x.nbytes // max(x.shape[0], 1)
-        quant_ok = "quant" in allowed and self._quant_ok(coll, x, op)
-        if qvar in ("1", "on", "true", "yes", "force"):
-            if quant_ok:
-                return "quant", f"blanket:COLL_QUANT={qvar}", chain
-            if coll in _QUANT_COLLS:
-                chain.append(f"blanket:COLL_QUANT={qvar} skipped "
-                             "(op/dtype/layout ineligible)")
-        if self._platform == "cpu":
-            # sweep-derived (BENCH_SWEEP_cpu_8dev.json): dense alltoall
-            # staged wins 1KB-16MB/rank on the CPU fabric; all else native
-            pick = "staged" if (coll == "alltoall"
-                                and nbytes < (32 << 20)) else "native"
-        else:
-            pick = "native"       # staging crosses the host bridge
-        if pick not in allowed:
-            pick = "native"
-        reason = f"default:platform={self._platform}"
-        quant_off = qvar in ("0", "off", "false", "no")
-        floor = int(_var.get("coll_quant_min_bytes", 1 << 20))
-        for c, mn, mb, mode in self._rules:
-            if c != coll or self.dc.n < mn or nbytes < mb:
-                continue
-            rule = f"rule:{c} {mn} {mb} {mode}"
-            if mode == "quant":
-                # vetoed rule: keep the prior pick, but the veto IS the
-                # deciding word unless a later rule overrides it
-                if quant_off:
-                    reason = f"off:COLL_QUANT={qvar} (vetoed {rule})"
-                    chain.append(reason)
-                    continue
-                if not quant_ok:
-                    reason = f"ineligible:op/dtype/layout (vetoed {rule})"
-                    chain.append(reason)
-                    continue
-                if nbytes < floor:
-                    reason = (f"floor:coll_quant_min_bytes={floor}"
-                              f">{nbytes} (vetoed {rule})")
-                    chain.append(reason)
-                    continue
-            elif mode not in allowed:
-                chain.append(f"{rule} skipped (no {mode} kernel)")
-                continue
-            pick = mode
-            reason = rule
-            chain.append(rule)
-        return pick, reason, chain
+        return decide_mode(coll, nbytes, self.dc.n, self._platform,
+                           self._rules, allowed,
+                           quant_ok=self._quant_ok(coll, x, op),
+                           dtype=x.dtype, op=op)
 
     # modeled wire-byte collectives: coll -> coll/quant hop-table name
     _WIRE_MODEL = {"allreduce": "allreduce",

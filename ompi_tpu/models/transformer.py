@@ -65,15 +65,33 @@ class Config:
     #   halves the mu buffer's HBM (the MFU lever VERDICT r3 item 9 names:
     #   less optimizer traffic on an HBM-bound chip). Second moment stays
     #   fp32 — bf16's 8-bit mantissa loses v's small-magnitude accumulation
-    grad_sync: str = "native"          # "native" | "quant" — how the dp
-    #   gradient allreduce moves: "native" lets GSPMD insert the exact
-    #   allreduce; "quant" syncs each gradient leaf with the block-
-    #   quantized tier (coll/quant.psum_quant: int8 payload + per-block
-    #   scales, ~4× fewer ICI bytes, ~1e-2 relative error on unit-scale
-    #   gradients). dp-only meshes — see make_train_step
+    grad_sync: str = "native"          # how the dp gradient allreduce moves:
+    #   "native"   — GSPMD inserts the exact allreduce
+    #   "quant"    — one block-quantized psum_quant per leaf (coll/quant:
+    #                int8 payload + per-block scales, ~4× fewer ICI bytes,
+    #                ~1e-2 relative error on unit-scale gradients)
+    #   "perleaf"  — one native pmean per leaf after the full backward
+    #                (the explicit collective storm; the bench baseline)
+    #   "bucketed" — fixed-byte buckets issued DURING backward so each
+    #                bucket's exchange overlaps remaining compute; arm per
+    #                bucket (native|quant) via the decision layer — see
+    #                parallel/overlap.py
+    #   "unsynced" — no gradient exchange (measurement-only compute floor)
+    #   quant/perleaf/bucketed/unsynced are dp-only — see make_train_step
     grad_sync_block: int = 256         # quantization block for grad_sync
     #   ="quant"; smaller blocks track outliers tighter at more scale
     #   traffic (ratio (1 + 4/block)/4 of native bytes for f32)
+    grad_bucket_bytes: Optional[int] = None  # grad_sync="bucketed" bucket
+    #   target; None = the coll_xla_grad_bucket_bytes var (~4 MiB).
+    #   Bigger buckets amortize dispatch latency, smaller ones start the
+    #   first exchange earlier — docs/overlap.md
+    tp_overlap: str = "none"           # "none" | "fused" — "fused" carries
+    #   the tp-parallel matmuls on the ring-overlap kernels
+    #   (ops/collective_matmul): the residual stream is sequence-sharded
+    #   over tp (Megatron sequence parallelism), qkv/gate/up run
+    #   allgather_matmul, wo/down run matmul_reduce_scatter; ring
+    #   direction per call site (native|bidir) via the decision layer.
+    #   Needs a tp>=2 mesh, dense attn+mlp, running seq divisible by tp
 
 
 def flagship_config(seq: int = 2048) -> Config:
@@ -199,9 +217,107 @@ def _rope(x, positions, base):
     return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
 
 
+def _layer_apply_fused(x: jax.Array, layer: Dict, cfg: Config,
+                       mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
+    """The tp_overlap='fused' decoder layer: Megatron sequence
+    parallelism with the collectives fused into the matmuls. The
+    residual stream lives sequence-sharded over tp; each column-parallel
+    matmul (qkv, gate, up) is an ``allgather_matmul`` (the ring gather
+    overlaps the MXU blocks) and each row-parallel one (wo, down) is a
+    ``matmul_reduce_scatter`` (partial sums ride the ring), so no
+    standalone all-gather/psum ever serializes against the dots. Ring
+    direction per call site (native | bidir two half-rings) comes from
+    the decision layer under the coll name ``collmm``."""
+    from ..ops.collective_matmul import (allgather_matmul,
+                                         matmul_reduce_scatter)
+    from ..parallel import overlap
+
+    tp = mesh.shape["tp"]
+    if tp < 2:
+        raise ValueError("tp_overlap='fused' needs a tp mesh axis of "
+                         f"size >= 2 (mesh axes: {dict(mesh.shape)})")
+    if cfg.attn != "dense" or cfg.mlp != "dense":
+        raise ValueError(
+            "tp_overlap='fused' supports dense attention + dense MLP "
+            f"only (got attn={cfg.attn!r}, mlp={cfg.mlp!r})")
+    b, s = x.shape[0], x.shape[1]
+    h_dim = cfg.n_heads * cfg.head_dim
+    if s % tp:
+        raise ValueError(
+            f"tp_overlap='fused' sequence-shards the residual over tp: "
+            f"running seq {s} must be divisible by tp={tp} (the training "
+            f"loss drops one position — pick cfg.seq = k*tp + 1)")
+    if cfg.n_heads % tp or cfg.d_ff % tp:
+        raise ValueError(
+            f"tp_overlap='fused' needs n_heads ({cfg.n_heads}) and d_ff "
+            f"({cfg.d_ff}) divisible by tp={tp}")
+    batch_axis = ("dp" if "dp" in mesh.axis_names
+                  and mesh.shape["dp"] > 1 else None)
+    x = lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P(batch_axis, "tp", None)))
+    positions = jnp.arange(s)
+    # per-rank ring payload of the sequence-sharded activations — the
+    # byte count DEVICE_RULES rows for `collmm` match against
+    shard_bytes = (b * (s // tp) * cfg.d_model
+                   * jnp.dtype(cfg.dtype).itemsize)
+    if batch_axis is not None:
+        shard_bytes //= mesh.shape["dp"]
+    bidir_ok = (s // tp) % 2 == 0
+
+    def ring(kind: str) -> bool:
+        return overlap.decide_collmm(kind, shard_bytes, mesh, "tp",
+                                     bidir_ok) == "bidir"
+
+    h = _rms_norm(x, layer["attn_norm"])
+    qkv = allgather_matmul(h, layer["wqkv"].astype(cfg.dtype), mesh, "tp",
+                           w_sharded_axis="tp",
+                           bidirectional=ring("qkv"),
+                           batch_axis=batch_axis)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    v = v.reshape(b, s, cfg.n_heads, cfg.head_dim)
+    q = _rope(q, positions, cfg.rope_base)
+    k = _rope(k, positions, cfg.rope_base)
+    # full-sequence attention with heads tp-sharded under GSPMD — the
+    # fused matmuls bracket it, so only the (cheap) head resharding of
+    # qkv/att crosses tp here
+    att = attention_reference(q, k, v, causal=True)
+    att = att.reshape(b, s, h_dim)
+    x = x + matmul_reduce_scatter(att, layer["wo"].astype(cfg.dtype),
+                                  mesh, "tp",
+                                  bidirectional=ring("wo"),
+                                  batch_axis=batch_axis)
+    h = _rms_norm(x, layer["mlp_norm"])
+    gate = jax.nn.silu(
+        allgather_matmul(h, layer["w_gate"].astype(cfg.dtype), mesh, "tp",
+                         w_sharded_axis="tp",
+                         bidirectional=ring("gate"),
+                         batch_axis=batch_axis))
+    up = allgather_matmul(h, layer["w_up"].astype(cfg.dtype), mesh, "tp",
+                          w_sharded_axis="tp",
+                          bidirectional=ring("up"),
+                          batch_axis=batch_axis)
+    down = matmul_reduce_scatter(gate * up,
+                                 layer["w_down"].astype(cfg.dtype),
+                                 mesh, "tp",
+                                 bidirectional=ring("down"),
+                                 batch_axis=batch_axis)
+    return x + down, jnp.zeros((), jnp.float32)
+
+
 def _layer_apply(x: jax.Array, layer: Dict, cfg: Config,
                  mesh: Optional[Mesh]) -> Tuple[jax.Array, jax.Array]:
     """One decoder layer; returns (x, router_aux)."""
+    if cfg.tp_overlap not in ("none", "fused"):
+        raise ValueError(f"unknown tp_overlap {cfg.tp_overlap!r} "
+                         "(expected 'none' or 'fused')")
+    if cfg.tp_overlap == "fused":
+        if mesh is None or "tp" not in mesh.axis_names:
+            raise ValueError(
+                "tp_overlap='fused' needs a mesh with a tp axis "
+                f"(got mesh={'set' if mesh is not None else None})")
+        return _layer_apply_fused(x, layer, cfg, mesh)
     b, s = x.shape[0], x.shape[1]
     positions = jnp.arange(s)
     h = _rms_norm(x, layer["attn_norm"])
@@ -380,7 +496,9 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
                     learning_rate: float = 1e-3):
     """Returns (init_opt_state, step). step is jit-compiled; with a mesh the
     data batch is dp-sharded and gradients allreduce over dp automatically —
-    or, with cfg.grad_sync == "quant", through the block-quantized tier."""
+    or through an explicit scheduler per cfg.grad_sync: "quant" (per-leaf
+    block-quantized tier), "perleaf"/"bucketed"/"unsynced"
+    (parallel/overlap — bucketed is the backward-overlapped tier)."""
     import optax
 
     tx = optax.adamw(learning_rate,
@@ -389,19 +507,36 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
     def init_opt(params):
         return tx.init(params)
 
-    if cfg.grad_sync not in ("native", "quant"):
+    _MODES = ("native", "quant", "perleaf", "bucketed", "unsynced")
+    if cfg.grad_sync not in _MODES:
         raise ValueError(f"unknown grad_sync {cfg.grad_sync!r} "
-                         "(expected 'native' or 'quant')")
-    quant_vg = None
-    if cfg.grad_sync == "quant":
+                         f"(expected one of {_MODES})")
+    if cfg.tp_overlap == "fused" and cfg.grad_sync != "native":
+        # the explicit grad-sync schedulers shard_map over dp with
+        # mesh=None inside — the fused layer cannot run there
+        raise ValueError(
+            f"tp_overlap='fused' requires grad_sync='native' "
+            f"(got {cfg.grad_sync!r}): the dp-only grad-sync shard_map "
+            "would replicate tp and lose the fused layer's mesh")
+    custom_vg = None
+    if cfg.grad_sync != "native":
         if mesh is None:
-            raise ValueError("grad_sync='quant' requires a mesh "
-                             "(single-controller has no dp axis to sync)")
-        quant_vg = _quant_grad_sync(cfg, mesh)
+            raise ValueError(f"grad_sync={cfg.grad_sync!r} requires a "
+                             "mesh (single-controller has no dp axis to "
+                             "sync)")
+        if cfg.grad_sync == "quant":
+            custom_vg = _quant_grad_sync(cfg, mesh)
+        else:
+            from ..parallel import overlap
+            custom_vg = overlap.make_grad_sync(
+                cfg.grad_sync, mesh,
+                lambda p, t: loss_fn(p, t, cfg, None),
+                bucket_bytes=cfg.grad_bucket_bytes,
+                quant_block=cfg.grad_sync_block)
 
     def step(params, opt_state, tokens):
-        if quant_vg is not None:
-            loss, grads = quant_vg(params, tokens)
+        if custom_vg is not None:
+            loss, grads = custom_vg(params, tokens)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
                                                       mesh)

@@ -21,7 +21,13 @@ Model" recipe):
     sums, the matmul for hop i is computed just-in-time before it is added.
 
 Both are expressed in ``shard_map`` so they compose with any outer pjit
-program; correctness reference in tests/test_ops.py.
+program; correctness reference in tests/test_ops.py. Both accept an
+optional leading batch dimension (activations shaped (b, m, k), optionally
+sharded over ``batch_axis``) and a ``bidirectional`` schedule that splits
+the payload across the two ICI ring directions — two half-rings of
+concurrent ppermutes — so each link carries half the bytes. The decision
+layer arbitrates unidirectional vs bidirectional per call site under the
+coll name ``collmm`` (see parallel/overlap.decide_collmm).
 """
 
 from __future__ import annotations
@@ -38,97 +44,210 @@ from ..jaxcompat import shard_map
 
 
 @functools.lru_cache(maxsize=64)
-def _build_allgather_matmul(mesh: Mesh, axis: str, w_spec: P, reverse: bool):
+def _build_allgather_matmul(mesh: Mesh, axis: str, w_spec: P, reverse: bool,
+                            bidir: bool, batch_axis: Optional[str],
+                            ndim: int):
     n = mesh.shape[axis]
 
     def local(x, w):
-        # x: (m_local, k) — this rank's shard; w: (k, n_local or n)
-        m_local = x.shape[0]
+        # x: (..., m_local, k) — this rank's shard; w: (k, n_local or n)
+        m_local = x.shape[-2]
         my = lax.axis_index(axis)
-        shift = 1 if not reverse else -1
-        perm = [(j, (j + shift) % n) for j in range(n)]
+        lead = (0,) * (x.ndim - 2)
+
+        def place(out, block, row0):
+            return lax.dynamic_update_slice(
+                out, block.astype(out.dtype), lead + (row0, 0))
+
+        out0 = jnp.zeros(x.shape[:-2] + (m_local * n, w.shape[1]),
+                         jnp.promote_types(x.dtype, w.dtype))
+
+        if not bidir:
+            shift = 1 if not reverse else -1
+            perm = [(j, (j + shift) % n) for j in range(n)]
+
+            def step(i, carry):
+                out, xs = carry
+                # the shard visiting at step i originated at rank
+                # (my - i*shift)
+                src = (my - i * shift) % n
+                block = jnp.dot(xs, w, preferred_element_type=out.dtype)
+                out = place(out, block, src * m_local)
+                xs = lax.ppermute(xs, axis, perm)
+                return out, xs
+
+            out, _ = lax.fori_loop(0, n, step, (out0, x))
+            return out
+
+        # Bidirectional ring: split the local rows in half and rotate the
+        # halves in OPPOSITE directions — two concurrent ppermutes per step
+        # drive both ICI link directions at once, so each link carries half
+        # the bytes of the unidirectional schedule. The +1 half visiting at
+        # step i originated at (my - i); the -1 half at (my + i).
+        mh = m_local // 2
+        xa = lax.slice_in_dim(x, 0, mh, axis=-2)
+        xb = lax.slice_in_dim(x, mh, m_local, axis=-2)
+        perm_f = [(j, (j + 1) % n) for j in range(n)]
+        perm_b = [(j, (j - 1) % n) for j in range(n)]
 
         def step(i, carry):
-            out, xs = carry
-            # the shard visiting at step i originated at rank (my - i*shift)
-            src = (my - i * shift) % n
-            block = jnp.dot(xs, w, preferred_element_type=out.dtype)
-            out = lax.dynamic_update_slice(
-                out, block.astype(out.dtype), (src * m_local, 0))
-            xs = lax.ppermute(xs, axis, perm)
-            return out, xs
+            out, xf, xr = carry
+            src_f = (my - i) % n
+            src_b = (my + i) % n
+            bf = jnp.dot(xf, w, preferred_element_type=out.dtype)
+            br = jnp.dot(xr, w, preferred_element_type=out.dtype)
+            out = place(out, bf, src_f * m_local)
+            out = place(out, br, src_b * m_local + mh)
+            xf = lax.ppermute(xf, axis, perm_f)
+            xr = lax.ppermute(xr, axis, perm_b)
+            return out, xf, xr
 
-        out0 = jnp.zeros((m_local * n, w.shape[1]),
-                         jnp.promote_types(x.dtype, w.dtype))
-        out, _ = lax.fori_loop(0, n, step, (out0, x))
+        out, _, _ = lax.fori_loop(0, n, step, (out0, xa, xb))
         return out
 
-    x_spec = P(axis, None)
+    if batch_axis is not None or ndim == 3:
+        x_spec = P(batch_axis, axis, None)
+        out_spec = P(batch_axis, None, w_spec[1])
+    else:
+        x_spec = P(axis, None)
+        out_spec = P(None, w_spec[1])
     # The output is value-replicated over `axis` (every rank fills all n
     # blocks) but provenance-varying (it flowed through ppermute), so the
     # static VMA check can't prove replication — disable it here.
     return jax.jit(shard_map(local, mesh=mesh,
                              in_specs=(x_spec, w_spec),
-                             out_specs=P(None, w_spec[1]),
+                             out_specs=out_spec,
                              check_vma=False))
 
 
 def allgather_matmul(x: jax.Array, w: jax.Array, mesh: Mesh, axis: str,
                      w_sharded_axis: Optional[str] = None,
-                     reverse: bool = False) -> jax.Array:
+                     reverse: bool = False, bidirectional: bool = False,
+                     batch_axis: Optional[str] = None) -> jax.Array:
     """Y = all_gather(X over `axis`) @ W without a standalone all-gather.
 
-    x: (m, k) sharded on m over `axis`; w: (k, n), optionally sharded on n
-    over `w_sharded_axis` (the column-parallel case). Returns (m, n) with m
+    x: (m, k) or batched (b, m, k) sharded on m over `axis` (and optionally
+    on b over `batch_axis`); w: (k, n), optionally sharded on n over
+    `w_sharded_axis` (the column-parallel case). Returns (..., m, n) with m
     fully gathered, n keeping w's sharding.
+
+    ``bidirectional=True`` splits each rank's rows across both ICI ring
+    directions (two half-rings of concurrent ppermutes) so each link
+    carries half the bytes; it needs an even per-rank row count and
+    ignores ``reverse`` (both directions are in flight).
     """
+    if x.ndim not in (2, 3):
+        raise ValueError(f"allgather_matmul wants 2-D or 3-D x, got "
+                         f"shape {x.shape}")
+    n = mesh.shape[axis]
+    m = x.shape[-2]
+    if bidirectional and (m // n) % 2:
+        raise ValueError(
+            f"bidirectional ring needs an even per-rank row count, got "
+            f"m={m} over {n} ranks (m_local={m // n})")
     w_spec = P(None, w_sharded_axis)
-    return _build_allgather_matmul(mesh, axis, w_spec, bool(reverse))(x, w)
+    return _build_allgather_matmul(mesh, axis, w_spec, bool(reverse),
+                                   bool(bidirectional), batch_axis,
+                                   x.ndim)(x, w)
 
 
 @functools.lru_cache(maxsize=64)
-def _build_matmul_rs(mesh: Mesh, axis: str):
+def _build_matmul_rs(mesh: Mesh, axis: str, bidir: bool,
+                     batch_axis: Optional[str], ndim: int):
     n = mesh.shape[axis]
 
     def local(x, w):
-        # x: (m, k_local), w: (k_local, n_cols): full partial product would be
-        # x @ w (m, n_cols); ring-reduce-scatter it over the m dimension while
-        # computing each m-block just in time.
-        m = x.shape[0]
+        # x: (..., m, k_local), w: (k_local, n_cols): full partial product
+        # would be x @ w (..., m, n_cols); ring-reduce-scatter it over the m
+        # dimension while computing each m-block just in time.
+        m = x.shape[-2]
         if m % n:
             raise ValueError(f"m={m} not divisible by ring size {n}")
         mb = m // n
         my = lax.axis_index(axis)
-        perm = [(j, (j + 1) % n) for j in range(n)]
 
-        def block(idx):
-            rows = lax.dynamic_slice(x, (idx * mb, 0), (mb, x.shape[1]))
+        def block(idx, off, nrows):
+            rows = lax.dynamic_slice_in_dim(x, idx * mb + off, nrows,
+                                            axis=-2)
             return jnp.dot(rows, w, preferred_element_type=jnp.float32)
 
-        # The chunk destined for rank d starts at rank (d+1)%n and rides the
-        # ring n-1 hops, each visited rank adding its local partial block.
-        # After t hops, rank r therefore holds the chunk destined for
-        # d = (r-1-t) % n; after n-1 hops that is d = r — its own.
-        def step(t, acc):
-            acc = lax.ppermute(acc, axis, perm) + block((my - 1 - t) % n)
-            return acc
+        out_dtype = jnp.promote_types(x.dtype, w.dtype)
 
-        acc = block((my - 1) % n)
-        acc = lax.fori_loop(1, n, step, acc)
-        return acc.astype(jnp.promote_types(x.dtype, w.dtype))
+        if not bidir:
+            perm = [(j, (j + 1) % n) for j in range(n)]
 
+            # The chunk destined for rank d starts at rank (d+1)%n and
+            # rides the ring n-1 hops, each visited rank adding its local
+            # partial block. After t hops, rank r therefore holds the chunk
+            # destined for d = (r-1-t) % n; after n-1 hops that is d = r —
+            # its own.
+            def step(t, acc):
+                return (lax.ppermute(acc, axis, perm)
+                        + block((my - 1 - t) % n, 0, mb))
+
+            acc = block((my - 1) % n, 0, mb)
+            acc = lax.fori_loop(1, n, step, acc)
+            return acc.astype(out_dtype)
+
+        # Bidirectional ring: split each destination's mb rows in half.
+        # The top half rides the +1 ring exactly as above; the bottom half
+        # rides the -1 ring — its chunk for dest d starts at rank (d-1)%n,
+        # and after t backward hops rank r holds the chunk destined for
+        # d = (r+1+t) % n, landing at d = r after n-1 hops. One fori_loop
+        # carries both accumulators so XLA can keep both ppermutes (both
+        # ICI directions) in flight at once.
+        mbh = mb // 2
+        perm_f = [(j, (j + 1) % n) for j in range(n)]
+        perm_b = [(j, (j - 1) % n) for j in range(n)]
+
+        def step(t, carry):
+            af, ab = carry
+            af = (lax.ppermute(af, axis, perm_f)
+                  + block((my - 1 - t) % n, 0, mbh))
+            ab = (lax.ppermute(ab, axis, perm_b)
+                  + block((my + 1 + t) % n, mbh, mb - mbh))
+            return af, ab
+
+        af = block((my - 1) % n, 0, mbh)
+        ab = block((my + 1) % n, mbh, mb - mbh)
+        af, ab = lax.fori_loop(1, n, step, (af, ab))
+        return jnp.concatenate([af, ab], axis=-2).astype(out_dtype)
+
+    if batch_axis is not None or ndim == 3:
+        in_specs = (P(batch_axis, None, axis), P(axis, None))
+        out_spec = P(batch_axis, axis, None)
+    else:
+        in_specs = (P(None, axis), P(axis, None))
+        out_spec = P(axis, None)
     return jax.jit(shard_map(local, mesh=mesh,
-                             in_specs=(P(None, axis), P(axis, None)),
-                             out_specs=P(axis, None)))
+                             in_specs=in_specs,
+                             out_specs=out_spec))
 
 
 def matmul_reduce_scatter(x: jax.Array, w: jax.Array, mesh: Mesh,
-                          axis: str) -> jax.Array:
+                          axis: str, bidirectional: bool = False,
+                          batch_axis: Optional[str] = None) -> jax.Array:
     """Y = reduce_scatter(X @ W over `axis`), contraction sharded.
 
-    x: (m, k) sharded on k over `axis`; w: (k, n) sharded on k likewise.
-    Returns (m, n) sharded on m over `axis` — each rank holds the fully
-    reduced m-block it owns. Partial sums ride the ring and each hop's
-    matmul block is produced just-in-time, overlapping ICI with the MXU.
+    x: (m, k) or batched (b, m, k) sharded on k over `axis` (and
+    optionally on b over `batch_axis`); w: (k, n) sharded on k likewise.
+    Returns (..., m, n) sharded on m over `axis` — each rank holds the
+    fully reduced m-block it owns. Partial sums ride the ring and each
+    hop's matmul block is produced just-in-time, overlapping ICI with the
+    MXU.
+
+    ``bidirectional=True`` halves each destination chunk across the two
+    ICI ring directions (concurrent forward/backward ppermutes); it needs
+    an even per-rank row count (``m // ring_size`` even).
     """
-    return _build_matmul_rs(mesh, axis)(x, w)
+    if x.ndim not in (2, 3):
+        raise ValueError(f"matmul_reduce_scatter wants 2-D or 3-D x, got "
+                         f"shape {x.shape}")
+    n = mesh.shape[axis]
+    m = x.shape[-2]
+    if bidirectional and (m // n) % 2:
+        raise ValueError(
+            f"bidirectional ring needs an even per-rank row count, got "
+            f"m={m} over {n} ranks (m_local={m // n})")
+    return _build_matmul_rs(mesh, axis, bool(bidirectional), batch_axis,
+                            x.ndim)(x, w)

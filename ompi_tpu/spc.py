@@ -50,6 +50,8 @@ COUNTERS = [
     ("coll_wire_bytes", "modeled per-rank wire bytes for device collectives"),
     ("cache_miss_count", "device executable-cache misses (audit alias)"),
     ("trace_dropped_events", "trace events lost to ring-buffer overflow"),
+    ("grad_bucket_count", "bucket exchanges in the last grad-sync plan"),
+    ("grad_bucket_bytes", "total gradient bytes in the last grad-sync plan"),
 ]
 
 
@@ -69,18 +71,25 @@ class Counters:
             self._peer_msgs[(direction, peer)] += 1
 
     def get(self, name: str) -> float:
-        # trace_dropped_events lives in the tracer (one ring set per
-        # process, not per Context) — read through so every pvar path
-        # (pvar_read, pvar_read_all, handles) sees the same value
+        # trace_dropped_events lives in the tracer and the grad_bucket_*
+        # pair in the overlap scheduler (one state set per process, not
+        # per Context) — read through so every pvar path (pvar_read,
+        # pvar_read_all, handles) sees the same value
         if name == "trace_dropped_events":
             from . import trace
             return trace.dropped_events()
+        if name in ("grad_bucket_count", "grad_bucket_bytes"):
+            from .parallel import overlap
+            return overlap.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
         out = dict(self._v)
         from . import trace
+        from .parallel import overlap
         out["trace_dropped_events"] = trace.dropped_events()
+        out["grad_bucket_count"] = overlap.pvar_value("grad_bucket_count")
+        out["grad_bucket_bytes"] = overlap.pvar_value("grad_bucket_bytes")
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

@@ -15,6 +15,12 @@ One event schema shared by every instrumented layer:
   * ``parallel/pipeline``     — one measured run span plus synthetic
     per-tick spans (the host cannot see inside the jitted shard_map
     program, so ticks are an even subdivision, marked ``synthetic``).
+  * ``parallel/overlap``      — one DECISION instant per grad-sync
+    bucket (arm native | quant, bucket index/bytes/leaf count;
+    ``explain_last("grad_sync")``) and per collective-matmul call site
+    (``explain_last("collmm")``, arm native | bidir); plus a measured
+    ``grad_sync:run`` span with synthetic per-bucket spans when the
+    sync executes outside an enclosing jit trace.
 
 Cost contract: every instrumented call site is gated on the module-level
 ``trace.enabled`` flag — ONE attribute read on the disabled path, no

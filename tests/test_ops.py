@@ -215,6 +215,123 @@ class TestCollectiveMatmul:
         np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
                                    rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("bidir", [False, True])
+    def test_allgather_matmul_bidirectional(self, bidir):
+        mesh = make_mesh({"tp": 4, "dp": -1})
+        x = jax.random.normal(jax.random.key(5), (24, 16), jnp.float32)
+        w = jax.random.normal(jax.random.key(6), (16, 20), jnp.float32)
+        out = allgather_matmul(x, w, mesh, "tp", bidirectional=bidir)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("bidir", [False, True])
+    def test_matmul_reduce_scatter_bidirectional(self, bidir):
+        mesh = make_mesh({"tp": 4, "dp": -1})
+        x = jax.random.normal(jax.random.key(7), (24, 32), jnp.float32)
+        w = jax.random.normal(jax.random.key(8), (32, 20), jnp.float32)
+        out = matmul_reduce_scatter(x, w, mesh, "tp", bidirectional=bidir)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("bidir", [False, True])
+    def test_batched_3d(self, bidir):
+        """The fused transformer path feeds (b, m, k) activations."""
+        mesh = make_mesh({"tp": 4, "dp": -1})
+        x = jax.random.normal(jax.random.key(9), (2, 16, 12), jnp.float32)
+        w = jax.random.normal(jax.random.key(10), (12, 8), jnp.float32)
+        out = allgather_matmul(x, w, mesh, "tp", bidirectional=bidir)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w),
+                                   rtol=1e-5, atol=1e-5)
+        x2 = jax.random.normal(jax.random.key(11), (2, 16, 16),
+                               jnp.float32)
+        w2 = jax.random.normal(jax.random.key(12), (16, 8), jnp.float32)
+        out2 = matmul_reduce_scatter(x2, w2, mesh, "tp",
+                                     bidirectional=bidir)
+        np.testing.assert_allclose(np.asarray(out2), np.asarray(x2 @ w2),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_bidirectional_odd_halves_raises(self):
+        mesh = make_mesh({"tp": 4, "dp": -1})
+        x = jnp.ones((12, 8), jnp.float32)    # m_local = 3: odd
+        w = jnp.ones((8, 8), jnp.float32)
+        with pytest.raises(ValueError, match="even per-rank row count"):
+            allgather_matmul(x, w, mesh, "tp", bidirectional=True)
+        x2 = jnp.ones((12, 16), jnp.float32)
+        w2 = jnp.ones((16, 8), jnp.float32)
+        with pytest.raises(ValueError, match="even per-rank row count"):
+            matmul_reduce_scatter(x2, w2, mesh, "tp", bidirectional=True)
+
+
+class TestCollectiveMatmulBackward:
+    """jax.grad through the ring schedules under jit — the contract the
+    tp_overlap='fused' train step rests on (forward-only coverage would
+    let a broken ppermute transpose ship)."""
+
+    def _ag_grads(self, mesh, axis, bidir, m=16, k=12, n=10):
+        x = jax.random.normal(jax.random.key(21), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.key(22), (k, n), jnp.float32)
+
+        def loss(fn):
+            def f(x, w):
+                out = fn(x, w)
+                # non-uniform cotangent so dx/dw see structure
+                wt = jnp.arange(out.size, dtype=out.dtype).reshape(
+                    out.shape)
+                return jnp.sum(out * wt) / out.size
+            return jax.jit(jax.grad(f, argnums=(0, 1)))(x, w)
+
+        got = loss(lambda x, w: allgather_matmul(
+            x, w, mesh, axis, bidirectional=bidir))
+        want = loss(lambda x, w: x @ w)
+        return got, want
+
+    def _rs_grads(self, mesh, axis, bidir, m=16, k=24, n=10):
+        x = jax.random.normal(jax.random.key(23), (m, k), jnp.float32)
+        w = jax.random.normal(jax.random.key(24), (k, n), jnp.float32)
+
+        def loss(fn):
+            def f(x, w):
+                out = fn(x, w)
+                wt = jnp.arange(out.size, dtype=out.dtype).reshape(
+                    out.shape)
+                return jnp.sum(out * wt) / out.size
+            return jax.jit(jax.grad(f, argnums=(0, 1)))(x, w)
+
+        got = loss(lambda x, w: matmul_reduce_scatter(
+            x, w, mesh, axis, bidirectional=bidir))
+        want = loss(lambda x, w: x @ w)
+        return got, want
+
+    @pytest.mark.parametrize("ring", [2, 4, 8])
+    def test_allgather_matmul_grads(self, ring):
+        mesh = make_mesh({"tp": ring, "dp": -1})
+        got, want = self._ag_grads(mesh, "tp", bidir=False)
+        for g, w, name in zip(got, want, ("dx", "dw")):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+                err_msg=f"{name} mismatch (ring={ring})")
+
+    @pytest.mark.parametrize("ring", [2, 4, 8])
+    def test_matmul_reduce_scatter_grads(self, ring):
+        mesh = make_mesh({"tp": ring, "dp": -1})
+        got, want = self._rs_grads(mesh, "tp", bidir=False)
+        for g, w, name in zip(got, want, ("dx", "dw")):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+                err_msg=f"{name} mismatch (ring={ring})")
+
+    @pytest.mark.parametrize("ring", [2, 4])
+    def test_bidirectional_grads(self, ring):
+        mesh = make_mesh({"tp": ring, "dp": -1})
+        for fn, label in ((self._ag_grads, "allgather_matmul"),
+                          (self._rs_grads, "matmul_reduce_scatter")):
+            got, want = fn(mesh, "tp", bidir=True)
+            for g, w, name in zip(got, want, ("dx", "dw")):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+                    err_msg=f"{label} {name} mismatch "
+                            f"(bidir, ring={ring})")
+
 
 class TestRingPallas:
     def test_ring_attention_pallas_block(self):
