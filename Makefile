@@ -5,7 +5,7 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 quant-tests trace-tests overlap-tests
+.PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests
 
 tier1:
 	set -o pipefail; rm -f /tmp/_t1.log; \
@@ -30,6 +30,14 @@ quant-tests:
 trace-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_observability.py \
 	  -q -k "trace or wire or handle" -p no:cacheprovider -p no:randomly
+
+# the fleet flight-recorder tier: cross-rank merge, straggler doctor,
+# mpisync, Prometheus exposition — then the end-to-end probe (an 8-rank
+# fleet with an injected straggler the doctor must attribute)
+doctor-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_doctor.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --doctor
 
 # the comm/compute overlap tier: bucketed grad sync + collective-matmul
 # rings, INCLUDING the multi-device tests marked slow (excluded from

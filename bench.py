@@ -1298,6 +1298,119 @@ def run_trace_probe(platform: str) -> None:
             "(rules-file drift — re-run coll_tune --device)")
 
 
+def run_doctor_probe(platform: str) -> None:
+    """--doctor: drive an 8-rank fleet with ONE rank given an injected
+    delay, gather every ring in-band (clock-synced), run the comm_doctor
+    analyzer against the repo rules file and write DOCTOR_<platform>.json
+    (entry-skew p50/p99 per collective, pipeline bubble fraction,
+    arm-drift count).  Exits nonzero when the doctor fails to attribute
+    the injected straggler — the end-to-end acceptance for the fleet
+    flight-recorder tier."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import runtime, trace
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+    from ompi_tpu.parallel.pipeline import (pipeline, shard_stage_params,
+                                            stack_stage_params)
+    from ompi_tpu.tools.comm_doctor import build_report
+    from ompi_tpu.trace import merge
+
+    ndev = len(jax.devices())
+    ranks, straggler, delay_s = 8, 5, 0.010
+    trace.clear()
+    trace.enable()
+
+    # device collectives through the coll/xla decision layer: the audit
+    # events feed the doctor's arm-vs-rules drift check (allreduce/bcast
+    # expect native on this fabric, alltoall at this size expects staged)
+    def seed(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": ndev}), "x")
+        rng = np.random.default_rng(0)
+        host = rng.standard_normal((max(ndev, 2), 65536)).astype(np.float32)
+        x = jax.device_put(jnp.asarray(host), c.device_comm.sharding())
+        jax.block_until_ready(c.coll.allreduce(c, x))
+        jax.block_until_ready(c.coll.bcast(c, x))
+        ha = rng.standard_normal((ndev, ndev, 8)).astype(np.float32)
+        xa = jax.device_put(jnp.asarray(ha), c.device_comm.sharding())
+        jax.block_until_ready(c.coll.alltoall(c, xa))
+        return True
+
+    runtime.run_ranks(1, seed, timeout=600)
+
+    # a real pipeline run: its measured span carries the geometry the
+    # bubble-fraction analysis reads ((P-1)/ticks)
+    mesh = make_mesh({"pp": 4}, devices=jax.devices()[:4])
+    d = 8
+    layers = [{"w": jnp.eye(d) * 0.5, "b": jnp.zeros((d,))}
+              for _ in range(4)]
+
+    def stage_fn(stage_params, x):
+        def body(h, p):
+            return jnp.tanh(h @ p["w"] + p["b"]), None
+        out, _ = jax.lax.scan(body, x, stage_params)
+        return out
+
+    sharded = shard_stage_params(stack_stage_params(layers, 4), mesh, "pp")
+    pipeline(stage_fn, sharded, jnp.ones((4, 2, d)), mesh, "pp")
+
+    # the fleet: host allreduces on every rank, the straggler dragging
+    # its feet each step; each rank also marks its grad-sync step entry
+    # (the device grad_sync audit is single-controller, so the per-rank
+    # arrivals the skew analysis needs are marked at the step boundary)
+    def fleet(ctx):
+        c = ctx.comm_world
+        g = np.ones(4096, np.float32)
+        for _ in range(16):
+            if ctx.rank == straggler:
+                time.sleep(delay_s)
+            if trace.enabled:
+                trace.instant("enter:grad_sync", "coll-enter",
+                              rank=ctx.rank,
+                              args={"op": "grad_sync", "synthetic": True})
+            c.coll.allreduce(c, g)
+        return merge.gather(c, rounds=8)
+
+    res = runtime.run_ranks(ranks, fleet, timeout=600)
+    tl = next(t for t in res if t is not None)
+    trace.disable()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    rules = os.path.join(here, "DEVICE_RULES.txt")
+    text, data = build_report(
+        tl, rules=rules if os.path.exists(rules) else None, z_thresh=2.5)
+    merged_path = os.path.join(here, f"DOCTOR_TRACE_{platform}.json")
+    tl.save_chrome(merged_path)
+
+    sk = data["entry_skew"]
+    drift = data.get("decision_drift") or {}
+    doc = {
+        "metric": "comm_doctor",
+        "value": 1.0 if sk["flagged"] == [straggler] else 0.0,
+        "unit": "doctor attributed the injected straggler",
+        "platform": platform, "ndev": ndev, "ranks": ranks,
+        "injected_straggler": straggler,
+        "injected_delay_us": delay_s * 1e6,
+        "straggler_flagged": sk["flagged"],
+        "entry_skew_us": {op: {"p50": row["p50"], "p99": row["p99"]}
+                          for op, row in sk["per_coll"].items()},
+        "bubble_fraction": data["pipeline"].get("bubble_fraction_mean"),
+        "arm_drift_count": drift.get("drift_count"),
+        "decisions_checked": drift.get("checked"),
+        "dropped_events": data["ring_health"]["dropped_by_rank"],
+        "merged_chrome_trace": merged_path,
+    }
+    with open(os.path.join(here, f"DOCTOR_{platform}.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+    print(text, flush=True)
+    print(json.dumps(doc), flush=True)
+    if sk["flagged"] != [straggler]:
+        raise SystemExit(
+            f"doctor probe: injected straggler rank {straggler} not "
+            f"attributed (flagged {sk['flagged']})")
+
+
 def main() -> None:
     t_start = time.time()
     try:
@@ -1317,6 +1430,9 @@ def main() -> None:
 
         if "--trace" in sys.argv[1:]:
             run_trace_probe(platform)
+            return
+        if "--doctor" in sys.argv[1:]:
+            run_doctor_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

@@ -73,7 +73,17 @@ class CollTable:
                     spc.inc("collectives")
                     if name == "barrier":
                         spc.inc("barriers")
-                from .. import monitoring
+                from .. import monitoring, trace
+                if trace.enabled:
+                    # per-rank arrival marker: dispatch time is the entry
+                    # timestamp the fleet skew analysis keys on — every
+                    # rank records its OWN arrival, unlike the decision
+                    # audit which the driving rank emits once
+                    trace.instant(
+                        f"enter:{name}", "coll-enter", rank=comm.ctx.rank,
+                        args={"op": name, "comm": comm.cid,
+                              "nbytes": int(getattr(a[0], "nbytes", 0)
+                                            or 0) if a else 0})
                 if getattr(comm.ctx, "_monitor", None) is not None \
                         or monitoring._hooks:
                     # coll interposition (≙ coll/monitoring component);

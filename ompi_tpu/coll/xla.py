@@ -18,6 +18,7 @@ MCA priority over coll/tuned for device buffers.
 from __future__ import annotations
 
 import os
+from typing import Optional
 
 import numpy as np
 
@@ -104,8 +105,15 @@ _var.register("coll", "xla", "collmm_mode", "", type=str, level=3,
 _MODES = ("native", "staged", "quant", "bidir")
 
 
-def _load_device_rules():
-    path = _var.get("coll_xla_dynamic_rules", "")
+def _load_device_rules(path: Optional[str] = None):
+    """Parse a device decision rules file into (coll, min_ndev,
+    min_bytes, mode) rows.  With no argument the configured
+    ``coll_xla_dynamic_rules`` path is read (the dispatch-time caller);
+    an explicit path serves offline consumers — the trace analyzer's
+    decision-drift check re-evaluates audited arms against any rules
+    file, e.g. the repo's DEVICE_RULES.txt."""
+    if path is None:
+        path = _var.get("coll_xla_dynamic_rules", "")
     rules = []
     if path and not os.path.exists(path):
         # misconfiguration must be distinguishable from no configuration

@@ -87,6 +87,24 @@ class VarRegistry:
         self._lock = threading.RLock()
         self._file_values: Optional[Dict[str, str]] = None
         self._cli_values: Dict[str, str] = {}
+        self._watchers: Dict[str, List[Callable[[Any], None]]] = {}
+
+    # -- change notification ------------------------------------------------
+
+    def watch(self, name: str, fn: Callable[[Any], None]) -> None:
+        """Call ``fn(new_value)`` whenever ``name``'s resolved value
+        CHANGES (set_override, set_cli/clear_cli, reset_cache).  This is
+        how modules that cache a variable into a plain attribute for a
+        zero-cost hot path (``trace.enabled``) stay coherent with MPI_T
+        cvar writes without putting a registry lookup on that path."""
+        with self._lock:
+            self._watchers.setdefault(name, []).append(fn)
+
+    def _notify(self, name: str, old: Any, new: Any) -> None:
+        if old == new:
+            return
+        for fn in self._watchers.get(name, []):
+            fn(new)
 
     # -- registration -------------------------------------------------------
 
@@ -157,6 +175,7 @@ class VarRegistry:
             ) from None
 
     def _resolve(self, var: Variable) -> None:
+        old = var._value
         var._value, var._source = var.default, VarSource.DEFAULT
         fv = self._load_files()
         if var.name in fv:
@@ -173,6 +192,7 @@ class VarRegistry:
             raise ValueError(
                 f"variable {var.name}: value {var._value!r} not in {var.choices!r}"
             )
+        self._notify(var.name, old, var._value)
 
     # -- mutation -----------------------------------------------------------
 
@@ -198,7 +218,9 @@ class VarRegistry:
                 raise KeyError(f"unknown variable: {name}")
             if var.scope is VarScope.CONSTANT:
                 raise PermissionError(f"variable {name} is constant")
+            old = var._value
             var._value, var._source = value, VarSource.OVERRIDE
+            self._notify(name, old, value)
 
     # -- introspection (MPI_T cvar analog; reference ompi/mpi/tool/) --------
 
@@ -232,3 +254,7 @@ def register(framework: str, component: str, name: str, default: Any, **kw: Any)
 
 def get(name: str, default: Any = None) -> Any:
     return registry.get(name, default)
+
+
+def watch(name: str, fn: Callable[[Any], None]) -> None:
+    return registry.watch(name, fn)

@@ -141,6 +141,35 @@ class Monitor:
             json.dump(self.as_dict(), fh, indent=1)
         return path
 
+    def prometheus_rows(self, rank: int, comm: str = "world",
+                        prefix: str = "ompi_tpu") -> List[str]:
+        """The per-peer matrices + collective-op counts as Prometheus
+        text-format samples (spc.export_prometheus appends these to the
+        counter families so one scrape carries the whole story):
+        ``<prefix>_monitoring_{bytes,msgs}{rank,comm,class,peer}`` and
+        ``<prefix>_monitoring_coll_ops_total{rank,comm,coll}``."""
+        out: List[str] = []
+        peers = self.peers
+        for metric, idx, help_ in (
+                ("monitoring_bytes", 1, "per-peer traffic bytes by class"),
+                ("monitoring_msgs", 0, "per-peer message count by class")):
+            out.append(f"# HELP {prefix}_{metric} {help_}")
+            out.append(f"# TYPE {prefix}_{metric} counter")
+            for cls in CLASSES:
+                for p, cell in sorted(peers.get(cls, {}).items()):
+                    out.append(
+                        f'{prefix}_{metric}{{rank="{rank}",comm="{comm}",'
+                        f'class="{cls}",peer="{p}"}} {int(cell[idx])}')
+        if self.coll_ops:
+            out.append(f"# HELP {prefix}_monitoring_coll_ops_total "
+                       "collective operations recorded per name")
+            out.append(f"# TYPE {prefix}_monitoring_coll_ops_total counter")
+            for name, n in sorted(self.coll_ops.items()):
+                out.append(
+                    f'{prefix}_monitoring_coll_ops_total{{rank="{rank}",'
+                    f'comm="{comm}",coll="{name}"}} {int(n)}')
+        return out
+
 
 def install(ctx) -> Monitor:
     """Interpose on the context's pml (and make coll/osc report): the

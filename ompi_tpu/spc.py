@@ -99,6 +99,20 @@ class Counters:
             out[d][p] = (self._peer_msgs[(d, p)], b)
         return out
 
+    def export_prometheus(self, rank: int = 0, comm: str = "world",
+                          prefix: str = "ompi_tpu") -> str:
+        """This rank's pvars as Prometheus text exposition (counter
+        families labeled by rank); module-level
+        :func:`export_prometheus` adds the monitoring matrices."""
+        lines: List[str] = []
+        snap = self.snapshot()
+        for name, help_ in COUNTERS:
+            lines.append(f"# HELP {prefix}_{name} {_prom_escape(help_)}")
+            lines.append(f"# TYPE {prefix}_{name} counter")
+            lines.append(f'{prefix}_{name}{{rank="{rank}",'
+                         f'comm="{comm}"}} {snap.get(name, 0):.10g}')
+        return "\n".join(lines) + "\n"
+
     def dump(self, rank: int) -> str:
         lines = [f"SPC counters (rank {rank}):"]
         for name, help_ in COUNTERS:
@@ -112,3 +126,41 @@ class Counters:
         text = "\n".join(lines)
         print(text, flush=True)
         return text
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+def _prom_escape(s: str) -> str:
+    """HELP-text escaping per the Prometheus text format (backslash and
+    newline; label values additionally escape double quotes)."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def export_prometheus(ctx, comm=None, prefix: str = "ompi_tpu") -> str:
+    """One rank's full metrics surface in the Prometheus text exposition
+    format — every SPC/MPI_T pvar as a ``<prefix>_<name>{rank,comm}``
+    counter family plus, when monitoring is installed, the per-peer
+    traffic matrices and collective-op counts with class/peer/coll
+    labels (monitoring.Monitor.prometheus_rows).  The output parses
+    under the text-format grammar, so the same numbers the doctor and
+    ``tpu_info`` read scrape straight into a standard metrics stack:
+
+        # expose via any HTTP handler / textfile collector
+        open(f"metrics.{ctx.rank}.prom", "w").write(
+            spc.export_prometheus(ctx))
+
+    ``ctx`` is a Context (anything with ``.spc``; ``.rank`` and
+    ``._monitor`` are honored when present).  ``comm`` optionally names
+    the communicator label on every sample (default ``world``).
+    """
+    rank = int(getattr(ctx, "rank", 0))
+    label = comm if isinstance(comm, str) else (
+        getattr(comm, "name", None) or "world")
+    counters = getattr(ctx, "spc", ctx)
+    text = counters.export_prometheus(rank=rank, comm=label, prefix=prefix)
+    mon = getattr(ctx, "_monitor", None)
+    if mon is not None:
+        rows = mon.prometheus_rows(rank, comm=label, prefix=prefix)
+        if rows:
+            text += "\n".join(rows) + "\n"
+    return text

@@ -1,0 +1,171 @@
+"""comm_doctor — fleet communication health from merged traces.
+
+Post-mortem mode (the default): point it at N per-rank Chrome dumps
+written by ``trace.save_chrome`` (or one multi-rank dump), optionally
+with a saved mpisync offsets table, and it merges them into one
+offset-aligned timeline, runs the analyzer (trace/analyze.py) and
+renders a human report — flagged stragglers, per-collective entry-skew
+distributions, worst (span, arm) latencies, pipeline bubble fraction,
+and arm-vs-DEVICE_RULES disagreements.  ``--json`` emits the full
+structured report for CI; ``--merged-out`` additionally writes the one
+global Chrome trace (pid = rank) for perfetto.
+
+Live mode (``--live`` under tpurun): every rank gathers its ring over
+comm_world with an in-band clock sync; rank 0 analyzes and reports.
+
+    python -m ompi_tpu.tools.comm_doctor TRACE.0.json TRACE.1.json \\
+        --rules DEVICE_RULES.txt --z 2.5 --json
+    tpurun -np 8 -m ompi_tpu.tools.comm_doctor --live
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..trace import analyze as _an
+from ..trace import merge as _merge
+
+
+def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
+                 z_thresh: float = 2.5) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for one merged timeline."""
+    data = _an.analyze(tl, rules=rules, z_thresh=z_thresh)
+    lines: List[str] = []
+    w = lines.append
+    w(f"comm_doctor: {len(tl.ranks)} rank(s), {len(tl.events)} events")
+    conf = data["alignment"]["confidence_us"]
+    if conf:
+        worst = max(conf.values())
+        w(f"  clock alignment: ±{worst:.1f} us worst-rank confidence "
+          "(mpisync best-RTT/2)")
+
+    health = data["ring_health"]
+    if not health["skew_trustworthy"]:
+        w("  !! RING OVERFLOW on rank(s) "
+          f"{health['overflowed_ranks']} "
+          f"(dropped {health['dropped_by_rank']}) — oldest events were "
+          "overwritten mid-capture; skew numbers below are UNTRUSTWORTHY")
+
+    skew = data["entry_skew"]
+    if skew["flagged"]:
+        w(f"  STRAGGLER(S): rank {skew['flagged']} "
+          f"(z >= {skew['z_thresh']}, above clock-sync confidence)")
+    elif skew["per_coll"]:
+        w(f"  no stragglers flagged (z threshold {skew['z_thresh']})")
+    if skew["per_coll"]:
+        w("  entry skew per collective (max-min arrival, us):")
+        w(f"    {'coll':24s} {'n':>5s} {'p50':>10s} {'p99':>10s} "
+          f"{'max':>10s}  last-in")
+        for op, row in sorted(skew["per_coll"].items()):
+            w(f"    {op:24s} {row['count']:5d} {row['p50']:10.1f} "
+              f"{row['p99']:10.1f} {row['max']:10.1f}  "
+              f"rank {row['worst_rank']} "
+              f"({row['worst_rank_last_count']}x)")
+        late = skew["rank_lateness_us"]
+        if late:
+            w("  mean lateness vs fleet (us): " + ", ".join(
+                f"r{r}={v:+.1f}" for r, v in late.items()))
+
+    lat = data["latency"]
+    if lat:
+        w("  worst links — span latency p99 (us), slowest first:")
+        worst = sorted(lat.items(), key=lambda kv: -kv[1]["p99"])[:8]
+        for key, row in worst:
+            bw = row.get("busbw_GBps")
+            w(f"    {key:40s} n={row['count']:<5d} p50={row['p50']:>9.1f} "
+              f"p99={row['p99']:>9.1f}"
+              + (f"  busbw p50={bw['p50']} GB/s" if bw else ""))
+
+    pipe = data["pipeline"]
+    if pipe.get("runs"):
+        w(f"  pipeline bubble fraction: {pipe['bubble_fraction_mean']} "
+          f"over {len(pipe['runs'])} run(s) "
+          + ", ".join(f"[P={r['stages']} M={r['microbatches']} "
+                      f"-> {r['bubble_fraction']}]"
+                      for r in pipe["runs"][:4]))
+
+    drift = data.get("decision_drift")
+    if drift is not None:
+        if drift["drift_count"]:
+            w(f"  ARM DRIFT: {drift['drift_count']} decision(s) disagree "
+              f"with the rules file (checked {drift['checked']}):")
+            for d in drift["drift"][:8]:
+                w(f"    {d['op']} rank {d['rank']} {d['nbytes']}B: "
+                  f"rules say {d['expected']}, executed {d['actual']} "
+                  f"({d['reason']})")
+        else:
+            w(f"  arm-vs-rules: {drift['checked']} decision(s) checked, "
+              "no drift")
+    return "\n".join(lines), data
+
+
+def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="comm_doctor",
+        description="Merge per-rank traces and diagnose fleet "
+                    "communication health.")
+    ap.add_argument("dumps", nargs="*",
+                    help="per-rank Chrome trace JSON files "
+                         "(trace.save_chrome output)")
+    ap.add_argument("--offsets", default=None,
+                    help="JSON {rank: offset_seconds} clock-offset table "
+                         "(mpisync) applied before merging")
+    ap.add_argument("--rules", default=None,
+                    help="DEVICE_RULES file for the decision-drift check")
+    ap.add_argument("--z", type=float, default=2.5,
+                    help="straggler z-score flag threshold (default 2.5)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the structured report (CI mode)")
+    ap.add_argument("--merged-out", default=None,
+                    help="also write the merged global Chrome trace here")
+    ap.add_argument("--live", action="store_true",
+                    help="gather over comm_world instead of reading "
+                         "dumps (run under tpurun)")
+    ap.add_argument("--rounds", type=int, default=8,
+                    help="clock-sync ping-pong rounds in --live mode")
+    return ap.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ns = _parse_args(argv)
+    if ns.live:
+        from .. import runtime
+
+        ctx = runtime.init()
+        tl = _merge.gather(ctx.comm_world, rounds=ns.rounds)
+        try:
+            if tl is None:            # non-root ranks
+                return 0
+            return _report(tl, ns)
+        finally:
+            runtime.finalize()
+    if not ns.dumps:
+        print("comm_doctor: no trace dumps given (and not --live); "
+              "nothing to diagnose")
+        return 2
+    offsets, best_rtt = (_merge.load_offsets_ex(ns.offsets)
+                         if ns.offsets else (None, None))
+    per_rank = _merge.load_chrome(ns.dumps)
+    tl = _merge.merge(per_rank, offsets=offsets, best_rtt=best_rtt)
+    return _report(tl, ns)
+
+
+def _report(tl: "_merge.FleetTimeline", ns: argparse.Namespace) -> int:
+    if ns.merged_out:
+        tl.save_chrome(ns.merged_out)
+    text, data = build_report(tl, rules=ns.rules, z_thresh=ns.z)
+    if ns.as_json:
+        if ns.merged_out:
+            data["merged_chrome_trace"] = ns.merged_out
+        print(json.dumps(data, indent=1))
+    else:
+        print(text)
+        if ns.merged_out:
+            print(f"  merged Chrome trace: {ns.merged_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

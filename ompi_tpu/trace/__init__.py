@@ -32,6 +32,13 @@ Exporters: ``save_chrome(path)`` writes Chrome-trace JSON (object form,
 perfetto-loadable; pid = rank, tid = one lane per category so nested
 spans from different layers never collide), ``stats()``/``format_stats()``
 aggregate counts and span time per (category, name).
+
+Fleet view: ``trace.merge`` assembles every rank's ring into one
+clock-aligned ``FleetTimeline`` (in-band ``gather(comm)`` or
+post-mortem ``load_chrome``), ``trace.analyze`` computes entry-skew /
+straggler / bubble / decision-drift reports over it, and
+``tools/comm_doctor.py`` is the CLI that renders them
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -68,13 +75,14 @@ _t0: float = time.perf_counter()           # trace epoch (ts origin)
 class _Ring:
     """Fixed-capacity overwrite-oldest event buffer (one per rank)."""
 
-    __slots__ = ("buf", "cap", "idx", "n")
+    __slots__ = ("buf", "cap", "idx", "n", "dropped")
 
     def __init__(self, cap: int) -> None:
         self.cap = max(1, int(cap))
         self.buf: List[Optional[dict]] = [None] * self.cap
         self.idx = 0
         self.n = 0
+        self.dropped = 0          # events THIS rank lost to overflow
 
     def append(self, ev: dict) -> bool:
         """Store ``ev``; True when an old event was overwritten."""
@@ -83,6 +91,8 @@ class _Ring:
         self.idx = (self.idx + 1) % self.cap
         if not overwrote:
             self.n += 1
+        else:
+            self.dropped += 1
         return overwrote
 
     def events(self) -> List[dict]:
@@ -93,22 +103,43 @@ class _Ring:
 
 # -- recording ---------------------------------------------------------------
 
+def _set_capacity(cap: int) -> None:
+    global _capacity
+    cap = max(1, int(cap))
+    with _lock:
+        if cap != _capacity:
+            _capacity = cap
+            _rings.clear()
+
+
 def enable(capacity: Optional[int] = None) -> None:
-    """Switch tracing on; ``capacity`` resizes the per-rank rings
-    (resizing drops already-recorded events)."""
-    global enabled, _capacity
-    if capacity is not None:
-        cap = max(1, int(capacity))
-        with _lock:
-            if cap != _capacity:
-                _capacity = cap
-                _rings.clear()
+    """Switch tracing on.  ``capacity`` resizes the per-rank rings; with
+    no argument the current ``trace_buffer_events`` variable is re-read
+    (so an env/CLI/cvar write between calls takes effect).  Resizing
+    drops already-recorded events."""
+    global enabled
+    _set_capacity(capacity if capacity is not None
+                  else _var.get("trace_buffer_events", 65536))
     enabled = True
 
 
 def disable() -> None:
     global enabled
     enabled = False
+
+
+# A cvar write to trace_enabled/trace_buffer_events must take effect even
+# though the hot-path gate is a snapshotted module attribute: the registry
+# notifies on CHANGE only, so the disabled path stays one attribute read
+# and enable()/disable() calls (which bypass the vars) are not clobbered
+# by unrelated reset_cache() passes.
+def _on_enabled_var(v: Any) -> None:
+    global enabled
+    enabled = bool(v)
+
+
+_var.watch("trace_enabled", _on_enabled_var)
+_var.watch("trace_buffer_events", _set_capacity)
 
 
 def clear() -> None:
@@ -131,8 +162,9 @@ def _emit(ev: dict) -> None:
 
 
 def instant(name: str, cat: str = "event", rank: int = 0,
-            args: Optional[dict] = None) -> None:
-    _emit({"name": name, "cat": cat, "ph": "i", "t": time.perf_counter(),
+            args: Optional[dict] = None, t: Optional[float] = None) -> None:
+    _emit({"name": name, "cat": cat, "ph": "i",
+           "t": time.perf_counter() if t is None else t,
            "rank": int(rank), "args": args or {}})
 
 
@@ -165,7 +197,7 @@ class span:
 
 
 def decision(op: str, arm: str, reason: str, nbytes: int, rank: int = 0,
-             **details: Any) -> None:
+             t: Optional[float] = None, **details: Any) -> None:
     """Record one collective decision-audit event and remember it for
     ``explain_last(op)``."""
     rec = {"op": op, "arm": arm, "reason": reason, "nbytes": int(nbytes),
@@ -174,7 +206,8 @@ def decision(op: str, arm: str, reason: str, nbytes: int, rank: int = 0,
     with _lock:
         _last[op] = rec
     _emit({"name": f"decide:{op}", "cat": "decision", "ph": "i",
-           "t": time.perf_counter(), "rank": int(rank), "args": rec})
+           "t": time.perf_counter() if t is None else t,
+           "rank": int(rank), "args": rec})
 
 
 def explain_last(op: str) -> Optional[Dict[str, Any]]:
@@ -201,10 +234,22 @@ def events(rank: Optional[int] = None) -> List[dict]:
     return out
 
 
-def dropped_events() -> int:
-    """Events lost to ring overflow since the last clear() (process-wide;
-    exported as the ``trace_dropped_events`` pvar)."""
-    return _dropped
+def dropped_events(rank: Optional[int] = None) -> int:
+    """Events lost to ring overflow since the last clear().  With no
+    ``rank``: process-wide total (the ``trace_dropped_events`` pvar);
+    with a rank: that rank's ring alone — the per-rank split the fleet
+    doctor needs to tell WHOSE skew numbers an overflow poisoned."""
+    if rank is None:
+        return _dropped
+    with _lock:
+        ring = _rings.get(int(rank))
+        return ring.dropped if ring is not None else 0
+
+
+def dropped_by_rank() -> Dict[int, int]:
+    """Per-rank dropped-event counts (ranks with a ring only)."""
+    with _lock:
+        return {r: ring.dropped for r, ring in sorted(_rings.items())}
 
 
 # -- exporters ---------------------------------------------------------------
@@ -224,16 +269,17 @@ def _jsonable(d: Optional[dict]) -> dict:
     return out
 
 
-def save_chrome(path: str, rank: Optional[int] = None) -> str:
-    """Write the buffered events as Chrome-trace JSON (object form with a
-    ``traceEvents`` list — loadable in perfetto / chrome://tracing).
+def chrome_doc(evs: List[dict], t0: float) -> dict:
+    """Build a Chrome-trace document (object form with a ``traceEvents``
+    list — loadable in perfetto / chrome://tracing) from event dicts.
 
     pid = rank; tid = one lane per event category, so spans from
     different layers (a compile span inside a quant span) never overlap
-    within a (pid, tid) lane.  Timestamps are µs since the trace epoch,
+    within a (pid, tid) lane.  Timestamps are µs since ``t0``,
     floor-rounded so span ends never cross the next span's start.
-    """
-    evs = events(rank)
+    Shared by :func:`save_chrome` (this process's rings, trace epoch
+    origin) and ``trace.merge`` (offset-aligned fleet timeline, earliest
+    event origin)."""
     tids: Dict[str, int] = {}
     pids = set()
     rows: List[dict] = []
@@ -242,14 +288,14 @@ def save_chrome(path: str, rank: Optional[int] = None) -> str:
         if tid is None:
             tid = tids[e["cat"]] = len(tids) + 1
         pids.add(e["rank"])
-        ts = int((e["t"] - _t0) * 1e6)
+        ts = int((e["t"] - t0) * 1e6)
         row = {"name": e["name"], "cat": e["cat"], "ph": e["ph"],
                "ts": ts, "pid": e["rank"], "tid": tid,
                "args": _jsonable(e["args"])}
         if e["ph"] == "X":
             # floor both endpoints: ts+dur <= the true end, so ordered
             # spans stay non-overlapping after µs rounding
-            row["dur"] = max(0, int((e["t"] + e["dur"] - _t0) * 1e6) - ts)
+            row["dur"] = max(0, int((e["t"] + e["dur"] - t0) * 1e6) - ts)
         elif e["ph"] == "i":
             row["s"] = "t"
         rows.append(row)
@@ -260,9 +306,14 @@ def save_chrome(path: str, rank: Optional[int] = None) -> str:
         for cat, tid in sorted(tids.items(), key=lambda kv: kv[1]):
             meta.append({"name": "thread_name", "ph": "M", "pid": pid,
                          "tid": tid, "args": {"name": cat}})
+    return {"traceEvents": meta + rows, "displayTimeUnit": "ms"}
+
+
+def save_chrome(path: str, rank: Optional[int] = None) -> str:
+    """Write the buffered events as Chrome-trace JSON (see
+    :func:`chrome_doc` for the lane/rounding contract)."""
     with open(path, "w") as fh:
-        json.dump({"traceEvents": meta + rows,
-                   "displayTimeUnit": "ms"}, fh)
+        json.dump(chrome_doc(events(rank), _t0), fh)
     return path
 
 
@@ -281,7 +332,9 @@ def stats(rank: Optional[int] = None) -> Dict[str, Any]:
             arm = e["args"].get("arm", "?")
             arms[arm] = arms.get(arm, 0) + 1
     return {"events": dict(sorted(agg.items())), "decision_arms": arms,
-            "dropped_events": _dropped}
+            "dropped_events": _dropped,
+            "dropped_by_rank": ({int(rank): dropped_events(rank)}
+                                if rank is not None else dropped_by_rank())}
 
 
 def format_stats(rank: Optional[int] = None) -> str:
@@ -294,4 +347,8 @@ def format_stats(rank: Optional[int] = None) -> str:
         lines.append("decision arms: " + ", ".join(
             f"{a}={n}" for a, n in sorted(s["decision_arms"].items())))
     lines.append(f"dropped events: {s['dropped_events']}")
+    per = {r: n for r, n in s["dropped_by_rank"].items() if n}
+    if per:
+        lines.append("dropped by rank: " + ", ".join(
+            f"{r}={n}" for r, n in sorted(per.items())))
     return "\n".join(lines)
