@@ -5,9 +5,13 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests
+.PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests health-tests
 
-tier1:
+# the health-plane gate runs FIRST: its suite is seconds-cheap and its
+# end-to-end probe (an 8-rank fleet with an injected one-rank stall the
+# watchdog must attribute within 2x its timeout) guards the tier the
+# rest of the run leans on when something hangs
+tier1: health-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -38,6 +42,14 @@ doctor-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_doctor.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --doctor
+
+# the live-health tier: watchdog + desync sentinel + HTTP endpoint
+# suite, then the end-to-end stall-attribution probe (exits nonzero
+# unless the sentinel names the stalled rank and dumps land)
+health-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_health.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --watchdog
 
 # the comm/compute overlap tier: bucketed grad sync + collective-matmul
 # rings, INCLUDING the multi-device tests marked slow (excluded from

@@ -34,6 +34,7 @@ virtual 8-device CPU mesh so a number ALWAYS lands.
 from __future__ import annotations
 
 import dataclasses
+import glob
 import json
 import os
 import subprocess
@@ -1411,6 +1412,97 @@ def run_doctor_probe(platform: str) -> None:
             f"attributed (flagged {sk['flagged']})")
 
 
+def run_watchdog_probe(platform: str) -> None:
+    """--watchdog: end-to-end acceptance for the live health plane.  An
+    8-rank fleet runs host allreduces with ONE rank injected a stall of
+    3x the watchdog timeout; the probe passes only when (a) the watchdog
+    trips on the waiting ranks within 2x the timeout, (b) the desync
+    sentinel names the stalled rank as BEHIND, and (c) the flight
+    recorder lands in the dump dir.  Writes WATCHDOG_<platform>.json;
+    exits nonzero on any missed attribution."""
+    from ompi_tpu import health, runtime
+    from ompi_tpu.core import var
+
+    ranks, straggler, timeout_s = 8, 5, 0.25
+    here = os.path.dirname(os.path.abspath(__file__))
+    dump_dir = os.path.join(here, f"WATCHDOG_DUMP_{platform}")
+    for stale in glob.glob(os.path.join(dump_dir, "rank*.json")):
+        os.remove(stale)
+    names = ("health_enabled", "health_watchdog_timeout",
+             "health_watchdog_action", "health_dump_dir",
+             "health_watchdog_poll")
+    var.registry.set_cli("health_enabled", "true")
+    var.registry.set_cli("health_watchdog_timeout", str(timeout_s))
+    var.registry.set_cli("health_watchdog_action", "dump")
+    var.registry.set_cli("health_dump_dir", dump_dir)
+    var.registry.set_cli("health_watchdog_poll", str(timeout_s / 8))
+    var.registry.reset_cache()
+    health.reset()
+    try:
+        def fleet(ctx):
+            c = ctx.comm_world
+            g = np.ones(4096, np.float32)
+            for step in range(4):
+                if ctx.rank == straggler and step == 2:
+                    time.sleep(3 * timeout_s)     # the injected stall
+                c.coll.allreduce(c, g)
+            return health.last_report(ctx.rank)
+
+        reports = runtime.run_ranks(ranks, fleet, timeout=600)
+    finally:
+        for n in names:
+            var.registry.clear_cli(n)
+        var.registry.reset_cache()
+
+    tripped = [r for r in reports if r and r.get("tripped")]
+    behind_votes = {}
+    worst_age_us = 0.0
+    for rep in tripped:
+        worst_age_us = max(worst_age_us, max(
+            e["age_us"] for e in rep["tripped"]))
+        for row in (rep.get("verdict") or {}).get("behind", ()):
+            behind_votes[row["rank"]] = behind_votes.get(row["rank"], 0) + 1
+    dumps = sorted(os.path.basename(p) for p in glob.glob(
+        os.path.join(dump_dir, "rank*.health.json")))
+    attributed = (behind_votes
+                  and max(behind_votes, key=lambda k: behind_votes[k])
+                  == straggler)
+    detected_fast = bool(tripped) and worst_age_us <= 2 * timeout_s * 1e6
+    doc = {
+        "metric": "health_watchdog",
+        "value": 1.0 if (attributed and detected_fast and dumps) else 0.0,
+        "unit": "watchdog tripped in time and named the stalled rank",
+        "platform": platform, "ranks": ranks,
+        "injected_straggler": straggler,
+        "injected_stall_s": 3 * timeout_s,
+        "watchdog_timeout_s": timeout_s,
+        "ranks_tripped": sorted(r["rank"] for r in tripped),
+        "behind_votes": behind_votes,
+        "worst_trip_age_us": worst_age_us,
+        "detection_budget_us": 2 * timeout_s * 1e6,
+        "trips": health.pvar_value("health_watchdog_trips"),
+        "dump_files": dumps,
+        "dump_dir": dump_dir,
+    }
+    with open(os.path.join(here, f"WATCHDOG_{platform}.json"), "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc), flush=True)
+    if not tripped:
+        raise SystemExit("watchdog probe: injected stall never tripped "
+                         "the watchdog")
+    if not detected_fast:
+        raise SystemExit(
+            f"watchdog probe: detection took {worst_age_us / 1e6:.3f}s "
+            f"(> 2x timeout {2 * timeout_s:g}s)")
+    if not attributed:
+        raise SystemExit(
+            f"watchdog probe: stalled rank {straggler} not named "
+            f"(behind votes {behind_votes})")
+    if not dumps:
+        raise SystemExit(
+            f"watchdog probe: no flight-recorder dumps under {dump_dir}")
+
+
 def main() -> None:
     t_start = time.time()
     try:
@@ -1433,6 +1525,9 @@ def main() -> None:
             return
         if "--doctor" in sys.argv[1:]:
             run_doctor_probe(platform)
+            return
+        if "--watchdog" in sys.argv[1:]:
+            run_watchdog_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

@@ -73,7 +73,7 @@ class CollTable:
                     spc.inc("collectives")
                     if name == "barrier":
                         spc.inc("barriers")
-                from .. import monitoring, trace
+                from .. import health, monitoring, trace
                 if trace.enabled:
                     # per-rank arrival marker: dispatch time is the entry
                     # timestamp the fleet skew analysis keys on — every
@@ -90,6 +90,15 @@ class CollTable:
                     # PMPI-analog hooks fire even without an installed
                     # Monitor, matching the osc events' gating
                     monitoring.coll_event(comm, name, a[0] if a else None)
+                if health.enabled:
+                    # flight recorder: hold a (cid, seq, signature) entry
+                    # while in flight so the watchdog/desync sentinel can
+                    # attribute a hang (ompi_tpu/health/registry.py)
+                    htok = health.coll_begin(comm, name, a, kw)
+                    try:
+                        return fn(comm, *a, **kw)
+                    finally:
+                        health.op_end(htok)
                 return fn(comm, *a, **kw)
 
             return counted

@@ -360,6 +360,12 @@ class XlaModule(CollModule):
         if spc is not None:
             spc.inc(f"coll_arm_{arm}_count")
             spc.inc("coll_wire_bytes", wire)
+        from .. import health
+        if health.enabled:
+            # fold the decided arm into the in-flight entry's signature —
+            # the last field of the flight-recorder hash (op, dtype,
+            # count, reduction, arm)
+            health.note_arm(arm)
         if trace.enabled:
             bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
             ctx = getattr(self._comm, "ctx", None)

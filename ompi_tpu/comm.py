@@ -22,7 +22,15 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+from .core import var as _var
 from .p2p.request import ANY_SOURCE, ANY_TAG, Request
+
+_var.register("comm", "", "default_timeout", 60.0, type=float, level=3,
+              help="Seconds an internal comm-construction handshake "
+                   "(intercomm create/split leader exchange) waits for "
+                   "the remote side before raising TimeoutError. Raise "
+                   "it on slow control planes; the health watchdog "
+                   "observes these waits independently.")
 
 # reserved internal tags (user tags must be ≥ 0). Other reserved bands:
 # coll/nbc -200..-999, part -3000.., io -400000..; the intercomm handshake
@@ -387,11 +395,13 @@ class Communicator:
                 [np.array([prop, rows.shape[0]], np.int64),
                  rows.reshape(-1)])
             sreq = self.isend(payload, 0, wire_tag)
-            st = self.probe(0, wire_tag, timeout=60)
+            tmo = float(_var.get("comm_default_timeout", 60.0))
+            st = self.probe(0, wire_tag, timeout=tmo)
             if st is None:
-                raise RuntimeError(
-                    f"intercomm split on {self.name}: no reply from the "
-                    f"remote leader within 60s")
+                raise TimeoutError(
+                    f"intercomm split on {self.name} (cid {self.cid}): no "
+                    f"reply from the remote leader (remote rank 0) within "
+                    f"{tmo:g}s (comm_default_timeout)")
             other = np.zeros(st["count"] // 8, np.int64)
             self.recv(other, 0, wire_tag)
             sreq.wait()
@@ -458,11 +468,14 @@ class Communicator:
             payload = np.concatenate(
                 [np.array([my_prop, self.size], np.int64), group_arr])
             sreq = bridge_comm.isend(payload, remote_leader, wire_tag)
-            st = bridge_comm.probe(remote_leader, wire_tag, timeout=60)
+            tmo = float(_var.get("comm_default_timeout", 60.0))
+            st = bridge_comm.probe(remote_leader, wire_tag, timeout=tmo)
             if st is None:
-                raise RuntimeError(
-                    f"intercomm create on {self.name}: no reply from remote "
-                    f"leader (bridge rank {remote_leader}) within 60s")
+                raise TimeoutError(
+                    f"intercomm create on {self.name} (cid {self.cid}): no "
+                    f"reply from the remote leader (bridge rank "
+                    f"{remote_leader}) within {tmo:g}s "
+                    f"(comm_default_timeout)")
             other = np.zeros(st["count"] // 8, np.int64)
             bridge_comm.recv(other, remote_leader, wire_tag)
             sreq.wait()

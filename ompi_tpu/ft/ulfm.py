@@ -43,6 +43,22 @@ class RevokedError(RuntimeError):
         super().__init__(f"communicator {comm_name} has been revoked")
 
 
+class WatchdogTimeoutError(RuntimeError):
+    """Raised out of a blocked wait's progress loop when the health
+    watchdog trips with ``health_watchdog_action=raise`` — the in-flight
+    op exceeded its timeout envelope (ompi_tpu/health).  Lives in the
+    ft error family: like ProcFailedError it interrupts a wait that
+    would otherwise never return, and the trip also publishes a
+    control-plane event the way the failure detector announces deaths."""
+
+    def __init__(self, msg: str, *, cid: int = -1, seq: int = -1,
+                 op: str = "") -> None:
+        super().__init__(msg)
+        self.cid = int(cid)
+        self.seq = int(seq)
+        self.op = str(op)
+
+
 def enable(ctx) -> "FailureDetector":
     """Start the failure detector for this rank (idempotent)."""
     from .detector import FailureDetector

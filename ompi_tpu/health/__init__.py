@@ -1,0 +1,225 @@
+"""ompi_tpu.health — the live health plane.
+
+PR 2 (trace) and PR 4 (fleet merge + doctor) explain a run after it
+ends; this subsystem diagnoses a run *while it is stuck*:
+
+  * **in-flight op registry** (``registry``) — every collective and p2p
+    wait holds a ``(cid, seq, signature)`` entry while in flight (the
+    NCCL-flight-recorder / TORCH_NCCL-watchdog shape);
+  * **watchdog** (``watchdog``) — low-priority progress callback + a
+    fallback daemon thread; over-budget entries (var-controlled timeout
+    with per-size latency-envelope floors) dump the full flight
+    recorder to ``health_dump_dir`` and escalate per
+    ``health_watchdog_action = dump | raise | abort``;
+  * **desync sentinel** (``sentinel``) — on trip, ranks compare
+    registry heads out-of-band over the control plane and the report
+    names which rank is behind (seq mismatch) or called a different
+    collective (signature mismatch);
+  * **HTTP endpoint** (``httpd``) — opt-in ``/metrics`` (Prometheus)
+    and ``/health`` (JSON) on ``health_http_port``.
+
+Cost contract (same as ``trace``): every hot call site is gated on the
+module-level ``health.enabled`` flag — ONE attribute read on the
+disabled path, no registration, no thread.  The watchdog thread and
+HTTP server exist only while a Context is installed with the plane
+enabled.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from ..core import var as _var
+from . import registry, sentinel, watchdog
+
+_var.register("health", "", "enabled", False, type=bool, level=3,
+              help="Switch the live health plane on: in-flight op "
+                   "registry, watchdog + desync sentinel, and (with "
+                   "health_http_port) the HTTP endpoint. Off = one "
+                   "attribute read per instrumented call site, no "
+                   "thread.")
+_var.register("health", "", "watchdog_timeout", 300.0, type=float, level=3,
+              help="Seconds an in-flight collective / p2p wait may age "
+                   "before the watchdog trips (dump + sentinel + "
+                   "escalation). Large buffers get a per-size floor on "
+                   "top — see health_floor_latency_us/health_floor_mbps.")
+_var.register("health", "", "watchdog_poll", 0.0, type=float, level=4,
+              help="Watchdog scan period in seconds; 0 = auto "
+                   "(min(1s, timeout/4)).")
+_var.register("health", "", "floor_latency_us", 1000.0, type=float, level=4,
+              help="Per-op base of the per-size timeout floor "
+                   "(microbenchmark latency envelope): effective budget "
+                   "= max(watchdog_timeout, floor_latency + "
+                   "nbytes/floor_bandwidth).")
+_var.register("health", "", "floor_mbps", 10.0, type=float, level=4,
+              help="Worst-case goodput (MB/s) of the per-size timeout "
+                   "floor — a 1 GiB collective is allowed "
+                   "nbytes/floor_mbps seconds even when "
+                   "health_watchdog_timeout is small.")
+_var.register("health", "", "watchdog_action", "dump", type=str, level=3,
+              help="Escalation on a watchdog trip: 'dump' (flight "
+                   "recorder only), 'raise' (WatchdogTimeoutError out "
+                   "of the blocked wait, through the ft/ULFM error "
+                   "family), 'abort' (MPI_Abort semantics).")
+_var.register("health", "", "dump_dir", "health_dumps", type=str, level=3,
+              help="Directory the watchdog writes rank<r>.health.json + "
+                   "rank<r>.trace.json flight-recorder dumps into "
+                   "(empty = no dump files).")
+_var.register("health", "", "http_port", 0, type=int, level=3,
+              help="Serve /metrics (Prometheus) and /health (JSON) on "
+                   "this port when the plane is installed; 0 = off. "
+                   "Threaded multi-rank jobs offset by rank.")
+
+# THE gate.  Call sites do `if health.enabled:` and nothing else on the
+# disabled path — keep this a plain module attribute, not a function
+# (the trace.enabled contract).
+enabled: bool = bool(_var.get("health_enabled", False))
+
+
+def enable() -> None:
+    global enabled
+    enabled = True
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+
+
+def _on_enabled_var(v: Any) -> None:
+    # notify-on-CHANGE only: enable()/disable() calls are not clobbered
+    # by unrelated reset_cache() passes (same discipline as trace)
+    global enabled
+    enabled = bool(v)
+
+
+_var.watch("health_enabled", _on_enabled_var)
+
+
+# -- instrumentation entry points (hot paths; call only when `enabled`) ------
+
+def coll_begin(comm, name: str, args: tuple, kw: dict) -> int:
+    """Register one in-flight collective from the coll dispatch wrapper.
+    Extracts (dtype, count, reduction) from the call; the execution arm
+    is folded in later by coll/xla via :func:`note_arm`."""
+    buf = args[0] if args else None
+    red = kw.get("op")
+    if red is None:
+        from ..op import Op
+        red = next((x for x in args[1:] if isinstance(x, Op)), None)
+    return registry.begin(
+        rank=comm.ctx.rank, cid=comm.cid, op=name, kind="coll",
+        comm_name=comm.name,
+        dtype=str(getattr(buf, "dtype", "")) if buf is not None else "",
+        count=int(getattr(buf, "size", 0) or 0),
+        nbytes=int(getattr(buf, "nbytes", 0) or 0),
+        reduction=getattr(red, "name", "") if red is not None else "",
+        peers=tuple(comm.group.world_ranks))
+
+
+def _wait_rank(owner) -> int:
+    """Attribution for a p2p wait: the posting engine's rank when known,
+    else this thread's innermost registered entry (a wait inside an
+    instrumented collective), else -1 — NEVER a guessed rank 0, which
+    would hand one rank's stuck waits to another rank's watchdog."""
+    rank = getattr(owner, "rank", None)
+    if rank is None:
+        rank = registry.current_rank()
+    return -1 if rank is None else int(rank)
+
+
+def wait_begin(req) -> int:
+    """Register one blocking p2p wait (p2p/request.py).  These do not
+    consume the collective sequence number (seq -1) but still show in
+    the in-flight table and are watchdog-tripped like collectives."""
+    ref = getattr(req, "_posted_ref", None)
+    st = req.status
+    return registry.begin(
+        rank=_wait_rank(getattr(req, "_ctx", None)),
+        cid=int(ref[1]) if ref else -1, op="p2p_wait", kind="p2p",
+        nbytes=int(getattr(st, "count", 0) or 0),
+        peer=int(getattr(st, "source", -1)))
+
+
+def waitset_begin(requests, op: str) -> int:
+    """Register a wait_all/wait_any over a request set as one entry."""
+    owner = next((r._ctx for r in requests
+                  if getattr(r, "_ctx", None) is not None), None)
+    return registry.begin(
+        rank=_wait_rank(owner), cid=-1, op=op, kind="p2p",
+        count=len(requests))
+
+
+op_end = registry.end
+note_arm = registry.note_arm
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def install(ctx) -> None:
+    """Attach the health plane to a Context: watchdog progress callback,
+    daemon thread, and (when health_http_port > 0) the HTTP endpoint.
+    Idempotent; called from Context.__init__ when the plane is enabled."""
+    watchdog.install(ctx)
+    port = int(_var.get("health_http_port", 0))
+    if port > 0 and getattr(ctx, "_health_http", None) is None:
+        # threaded multi-rank jobs share one host: offset by rank so
+        # every rank's endpoint is scrapeable
+        from . import httpd
+        try:
+            ctx._health_http = httpd.serve(ctx, port + ctx.rank)
+        except OSError as exc:
+            from ..core.output import output
+            output.verbose(1, "health",
+                           f"http endpoint on port {port + ctx.rank} "
+                           f"unavailable: {exc}")
+
+
+def uninstall(ctx) -> None:
+    watchdog.uninstall(ctx)
+    srv = getattr(ctx, "_health_http", None)
+    if srv is not None:
+        from . import httpd
+        httpd.stop(srv)
+        ctx._health_http = None
+
+
+def serve_http(ctx, port: int = 0):
+    """Explicitly start the endpoint (tests use port 0 → ephemeral);
+    returns the server — read ``srv.server_address[1]`` for the port."""
+    from . import httpd
+    return httpd.serve(ctx, port)
+
+
+def stop_http(srv) -> None:
+    from . import httpd
+    httpd.stop(srv)
+
+
+# -- pvar read-through (spc.Counters.get / snapshot) -------------------------
+
+PVARS = ("health_watchdog_trips", "health_inflight_count",
+         "health_inflight_max_age_us", "health_desync_detected")
+
+
+def pvar_value(name: str) -> float:
+    if name == "health_watchdog_trips":
+        return float(watchdog.trips())
+    if name == "health_inflight_count":
+        return float(registry.inflight_count())
+    if name == "health_inflight_max_age_us":
+        return float(registry.max_age_us())
+    if name == "health_desync_detected":
+        return float(watchdog.desyncs())
+    raise KeyError(name)
+
+
+def last_report(rank: int):
+    """The most recent watchdog trip report for a rank (None if never)."""
+    return watchdog.last_report(rank)
+
+
+def reset() -> None:
+    """Tests: clear registry state, trip counters and reports."""
+    registry.clear()
+    watchdog.reset()

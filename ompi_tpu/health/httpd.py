@@ -1,0 +1,77 @@
+"""Live HTTP endpoint — /metrics (Prometheus) and /health (JSON).
+
+Stdlib ``http.server`` only, opt-in via ``health_http_port`` (0 = off;
+tests pass port 0 explicitly to bind an OS-assigned ephemeral port and
+read it back from the returned server).  ``/metrics`` is
+``spc.export_prometheus(ctx)`` — the counter families plus the
+watchdog pvars (they are SPC read-through counters, so the same label
+grammar applies) and the monitoring matrices when installed.
+``/health`` is the live JSON view: in-flight table, watchdog state,
+ft failed-set.  The server runs on a daemon thread and serializes
+requests through ``ThreadingHTTPServer``'s per-request threads — all
+read-only snapshots, no engine interaction.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from . import registry, watchdog
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _health_doc(ctx) -> dict:
+    return {
+        "rank": int(getattr(ctx, "rank", 0)),
+        "size": int(getattr(ctx, "size", 1)),
+        "inflight": registry.inflight(getattr(ctx, "rank", None)),
+        "watchdog": watchdog.state(),
+        "last_report": watchdog.last_report(getattr(ctx, "rank", 0)),
+        "ft_failed": sorted(int(r) for r in getattr(ctx, "failed", ())),
+    }
+
+
+def serve(ctx, port: int = 0,
+          host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Start the endpoint; returns the live server (``.server_address[1]``
+    is the bound port — pass ``port=0`` for an ephemeral one)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self) -> None:        # noqa: N802 — stdlib contract
+            if self.path.split("?")[0] == "/metrics":
+                from .. import spc
+                body = spc.export_prometheus(ctx).encode()
+                ctype = PROM_CONTENT_TYPE
+            elif self.path.split("?")[0] == "/health":
+                body = (json.dumps(_health_doc(ctx), indent=1,
+                                   default=repr) + "\n").encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404, "use /metrics or /health")
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a) -> None:   # quiet: no stderr access log
+            pass
+
+    srv = ThreadingHTTPServer((host, int(port)), Handler)
+    srv.daemon_threads = True
+    t = threading.Thread(target=srv.serve_forever,
+                         name=f"ompi-tpu-health-http-{getattr(ctx, 'rank', 0)}",
+                         daemon=True)
+    t.start()
+    return srv
+
+
+def stop(srv: Optional[ThreadingHTTPServer]) -> None:
+    if srv is not None:
+        srv.shutdown()
+        srv.server_close()

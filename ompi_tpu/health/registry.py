@@ -1,0 +1,207 @@
+"""In-flight operation registry — the flight-recorder's live half.
+
+Every collective (host or device, via the coll dispatch wrapper) and
+every blocking p2p wait registers an entry on the way in and clears it
+on completion.  An entry is the NCCL-flight-recorder / TORCH_NCCL-
+watchdog triple:
+
+    (cid, seq, signature)
+
+``seq`` is a per-(rank, communicator) monotonic collective sequence
+number — two ranks at different seqs for the same cid are out of step
+(straggler/hang); ``signature`` hashes (op name, dtype, count,
+reduction, arm) — two ranks at the SAME seq with different signatures
+called different collectives (a desync bug, the failure mode a timeout
+alone cannot name).  The hash is ``blake2s`` over the canonical field
+string, deterministic across processes (``hash()`` is salted per
+process and useless for cross-rank comparison).
+
+The registry is process-wide (threaded ranks share it, keyed by rank —
+the same stance as the trace rings); ``heads()`` is the per-cid summary
+the desync sentinel ships over the control plane.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_lock = threading.Lock()
+_entries: Dict[int, "Entry"] = {}                 # token -> live entry
+_seq: Dict[Tuple[int, int], int] = {}             # (rank, cid) -> last seq
+_heads: Dict[Tuple[int, int], Dict[str, Any]] = {}
+_tokens = itertools.count(1)
+_tls = threading.local()                          # per-thread entry stack
+
+
+def signature_of(op: str, dtype: str, count: int, reduction: str,
+                 arm: str) -> str:
+    blob = f"{op}|{dtype}|{count}|{reduction}|{arm}"
+    return hashlib.blake2s(blob.encode(), digest_size=6).hexdigest()
+
+
+class Entry:
+    __slots__ = ("token", "rank", "cid", "comm_name", "seq", "kind", "op",
+                 "dtype", "count", "nbytes", "reduction", "arm", "peer",
+                 "peers", "signature", "t0", "tripped", "parent")
+
+    def __init__(self, token: int, rank: int, cid: int, comm_name: str,
+                 seq: int, kind: str, op: str, dtype: str, count: int,
+                 nbytes: int, reduction: str, peer: int,
+                 peers: Tuple[int, ...], parent: int = 0) -> None:
+        self.token = token
+        self.rank = rank
+        self.cid = cid
+        self.comm_name = comm_name
+        self.seq = seq
+        self.kind = kind                 # "coll" | "p2p"
+        self.op = op
+        self.dtype = dtype
+        self.count = count
+        self.nbytes = nbytes
+        self.reduction = reduction
+        self.arm = ""                    # annotated by coll/xla once decided
+        self.peer = peer
+        self.peers = peers
+        self.signature = signature_of(op, dtype, count, reduction, "")
+        self.t0 = time.monotonic()
+        self.tripped = False
+        self.parent = parent      # enclosing entry's token (0 = top level)
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        return (time.monotonic() if now is None else now) - self.t0
+
+    def as_dict(self, now: Optional[float] = None) -> Dict[str, Any]:
+        return {
+            "rank": self.rank, "cid": self.cid, "comm": self.comm_name,
+            "seq": self.seq, "kind": self.kind, "op": self.op,
+            "dtype": self.dtype, "count": self.count, "nbytes": self.nbytes,
+            "reduction": self.reduction, "arm": self.arm, "peer": self.peer,
+            "peers": list(self.peers),
+            "signature": self.signature, "age_us": self.age_s(now) * 1e6,
+            "tripped": self.tripped,
+        }
+
+
+def begin(rank: int, cid: int, *, op: str, kind: str = "coll",
+          comm_name: str = "", dtype: str = "", count: int = 0,
+          nbytes: int = 0, reduction: str = "", peer: int = -1,
+          peers: Tuple[int, ...] = ()) -> int:
+    """Register one in-flight operation; returns the token for ``end``.
+    Collectives consume the per-(rank, cid) sequence number; p2p waits
+    ride along with seq -1 (they are not part of the collective order)."""
+    stack = getattr(_tls, "stack", None)
+    parent = stack[-1] if stack else 0   # a p2p wait INSIDE a collective
+    with _lock:
+        token = next(_tokens)
+        if kind == "coll":
+            seq = _seq.get((rank, cid), 0) + 1
+            _seq[(rank, cid)] = seq
+        else:
+            seq = -1
+        e = Entry(token, rank, cid, comm_name, seq, kind, op, dtype,
+                  int(count), int(nbytes), reduction, peer, tuple(peers),
+                  parent=parent)
+        _entries[token] = e
+        if kind == "coll":
+            _heads[(rank, cid)] = {"seq": seq, "sig": e.signature,
+                                   "op": op, "inflight": True}
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(token)
+    return token
+
+
+def note_arm(arm: str) -> None:
+    """Annotate the calling thread's innermost in-flight entry with the
+    decided execution arm (coll/xla) and fold it into the signature —
+    the last field of the flight-recorder hash."""
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    with _lock:
+        e = _entries.get(stack[-1])
+        if e is None:
+            return
+        e.arm = str(arm)
+        e.signature = signature_of(e.op, e.dtype, e.count, e.reduction,
+                                   e.arm)
+        if e.kind == "coll":
+            head = _heads.get((e.rank, e.cid))
+            if head is not None and head["seq"] == e.seq:
+                head["sig"] = e.signature
+
+
+def end(token: int) -> None:
+    with _lock:
+        e = _entries.pop(token, None)
+        if e is not None and e.kind == "coll":
+            head = _heads.get((e.rank, e.cid))
+            if head is not None and head["seq"] == e.seq:
+                head["inflight"] = False
+    stack = getattr(_tls, "stack", None)
+    if stack and token in stack:
+        stack.remove(token)
+
+
+def inflight(rank: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Snapshot of live entries (oldest first), optionally one rank's."""
+    now = time.monotonic()
+    with _lock:
+        es = [e for e in _entries.values()
+              if rank is None or e.rank == rank]
+    es.sort(key=lambda e: e.t0)
+    return [e.as_dict(now) for e in es]
+
+
+def live_entries(rank: int) -> List[Entry]:
+    """The mutable Entry objects for one rank (watchdog scan)."""
+    with _lock:
+        return sorted((e for e in _entries.values() if e.rank == rank),
+                      key=lambda e: e.t0)
+
+
+def heads(rank: int) -> Dict[str, Dict[str, Any]]:
+    """Per-communicator (cid, seq, signature) heads for one rank — what
+    the desync sentinel publishes over the control plane.  Keys are
+    str(cid) so the mapping survives a JSON round trip unchanged."""
+    with _lock:
+        return {str(cid): dict(h) for (r, cid), h in _heads.items()
+                if r == rank}
+
+
+def current_rank() -> Optional[int]:
+    """The rank of this thread's innermost in-flight entry (a wait inside
+    an instrumented collective inherits its attribution), or None."""
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return None
+    with _lock:
+        e = _entries.get(stack[-1])
+    return e.rank if e is not None else None
+
+
+def inflight_count() -> int:
+    with _lock:
+        return len(_entries)
+
+
+def max_age_us() -> float:
+    now = time.monotonic()
+    with _lock:
+        if not _entries:
+            return 0.0
+        return max((now - e.t0) for e in _entries.values()) * 1e6
+
+
+def clear() -> None:
+    """Drop every entry, sequence counter and head (tests)."""
+    with _lock:
+        _entries.clear()
+        _seq.clear()
+        _heads.clear()
+    if getattr(_tls, "stack", None):
+        _tls.stack = []

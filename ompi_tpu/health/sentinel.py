@@ -1,0 +1,111 @@
+"""Desync sentinel — cross-rank (cid, seq, signature) head exchange.
+
+When the watchdog trips, knowing *that* an operation is stuck is half
+the diagnosis; the report must name WHICH rank is behind (seq mismatch
+→ straggler or hang) or called a *different* collective at the same
+point in the order (same seq, signature mismatch → desync bug, the
+failure a timeout alone cannot distinguish from a slow peer).
+
+The exchange rides the control plane (``control/bootstrap.py`` —
+LocalBootstrap's shared KV for threaded ranks, the TCP coordinator
+under tpurun), NOT the possibly-wedged data plane: a rank blocked in a
+broken collective cannot answer a p2p message, but its watchdog daemon
+thread keeps publishing its registry head out-of-band every poll tick.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+from . import registry
+
+HEAD_KEY = "health:heads"
+PEER_TIMEOUT = 2.0        # per-peer head fetch bound on a trip
+
+
+def publish(ctx) -> None:
+    """Publish this rank's registry heads to the control plane (cheap:
+    a no-op unless the heads changed since the last publish)."""
+    blob = json.dumps(registry.heads(ctx.rank), sort_keys=True)
+    if getattr(ctx, "_health_head_blob", None) == blob:
+        return
+    ctx._health_head_blob = blob
+    try:
+        ctx.bootstrap.put(HEAD_KEY, blob)
+    except Exception:
+        pass                  # a dead control plane must not kill the dump
+
+
+def verdict(ctx, entry: Dict[str, Any]) -> Dict[str, Any]:
+    """Compare this rank's tripped entry against every peer's published
+    head for the same communicator.  Returns the attribution report:
+
+      * ``behind``  — peers whose seq on this cid trails ours (straggler
+        or hang; includes peers that never entered the comm at all);
+      * ``desync``  — peers at the SAME seq with a different signature
+        (they called a different collective / dtype / count / reduction);
+      * ``ahead``   — peers past us (then WE are the straggler);
+      * ``missing`` — peers whose head never arrived (health plane off
+        there, or the control plane itself is down).
+    """
+    cid = int(entry["cid"])
+    my_seq = int(entry["seq"])
+    my_sig = entry["signature"]
+    out: Dict[str, Any] = {
+        "cid": cid, "comm": entry.get("comm", ""), "seq": my_seq,
+        "signature": my_sig, "op": entry.get("op", ""),
+        "rank": int(entry["rank"]),
+        "behind": [], "desync": [], "ahead": [], "missing": [],
+    }
+    for peer in entry.get("peers", ()):
+        peer = int(peer)
+        if peer == ctx.rank:
+            continue
+        try:
+            heads = json.loads(
+                ctx.bootstrap.get(peer, HEAD_KEY, timeout=PEER_TIMEOUT))
+        except Exception:
+            out["missing"].append(peer)
+            continue
+        head = heads.get(str(cid))
+        if head is None:
+            out["behind"].append({"rank": peer, "seq": 0,
+                                  "op": None, "sig": None})
+            continue
+        pseq, psig = int(head["seq"]), head["sig"]
+        if pseq < my_seq:
+            out["behind"].append({"rank": peer, "seq": pseq,
+                                  "op": head.get("op"), "sig": psig})
+        elif pseq > my_seq:
+            out["ahead"].append({"rank": peer, "seq": pseq,
+                                 "op": head.get("op"), "sig": psig})
+        elif psig != my_sig:
+            out["desync"].append({"rank": peer, "seq": pseq,
+                                  "op": head.get("op"), "sig": psig})
+    return out
+
+
+def format_verdict(v: Dict[str, Any]) -> str:
+    """One-paragraph human rendering of a verdict dict."""
+    lines = [f"desync sentinel (rank {v['rank']}, comm {v['comm'] or v['cid']}"
+             f", seq {v['seq']}, op {v['op']}):"]
+    for row in v["desync"]:
+        lines.append(
+            f"  DESYNC: rank {row['rank']} called {row['op']!r} at seq "
+            f"{row['seq']} (sig {row['sig']}) where we called "
+            f"{v['op']!r} (sig {v['signature']})")
+    for row in v["behind"]:
+        lines.append(
+            f"  BEHIND: rank {row['rank']} is at seq {row['seq']} "
+            f"(< {v['seq']}) — straggler or hang")
+    for row in v["ahead"]:
+        lines.append(
+            f"  ahead: rank {row['rank']} is at seq {row['seq']} "
+            f"(> {v['seq']}) — WE are the straggler")
+    if v["missing"]:
+        lines.append(f"  no head published by rank(s) {v['missing']}")
+    if len(lines) == 1:
+        lines.append("  every peer is at the same (seq, signature) — "
+                     "no attribution (uniform stall?)")
+    return "\n".join(lines)

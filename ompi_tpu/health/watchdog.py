@@ -1,0 +1,300 @@
+"""Collective watchdog — trip detection, flight-recorder dump, escalation.
+
+Two detection paths, because a wedged rank may or may not still be
+polling:
+
+  * a **low-priority progress callback** per installed Context — a rank
+    blocked in a host-side wait spins in its progress engine, so the
+    callback sees the stuck entry from *inside* the blocked wait and can
+    raise there (``health_watchdog_action=raise``);
+  * a **fallback daemon thread** for fully blocked processes (a device
+    collective stuck inside PJRT never polls progress) — it scans every
+    installed Context each poll tick, publishes the registry heads to
+    the control plane for the desync sentinel, and trips entries it
+    finds over budget.  It cannot raise into the blocked thread; a
+    `raise` escalation from this path is parked and thrown by the
+    progress callback on the next poll (if one ever comes).
+
+The timeout is var-controlled with per-size floors: a 1 GiB allreduce
+legitimately takes longer than ``health_watchdog_timeout`` tuned for
+small ops, so the effective budget is
+``max(health_watchdog_timeout, floor_latency + nbytes/floor_bandwidth)``
+— the microbenchmark-derived latency-envelope stance (per-size floors
+instead of one global magic number).
+
+On trip: dump the full flight recorder (Chrome trace, trace-ring stats,
+last decision audits, in-flight table, sentinel verdict) to
+``health_dump_dir`` as ``rank<r>.health.json`` + ``rank<r>.trace.json``
+(what ``comm_doctor --health-dump`` loads), then escalate per
+``health_watchdog_action = dump | raise | abort``; ``raise`` goes
+through the ft/ULFM error family (``ft.ulfm.WatchdogTimeoutError``) and
+publishes a control-plane event like the failure detector does.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..core import var as _var
+from ..core.output import output
+from . import registry, sentinel
+
+_wlock = threading.Lock()
+_installed: Dict[int, Any] = {}          # id(ctx) -> ctx
+_thread: Optional[threading.Thread] = None
+_trips = 0                               # health_watchdog_trips pvar
+_desyncs = 0                             # health_desync_detected pvar
+_last_report: Dict[int, Dict[str, Any]] = {}     # rank -> last trip report
+_pending: Dict[int, Exception] = {}      # rank -> deferred raise (daemon path)
+
+
+def effective_timeout(nbytes: int) -> float:
+    """The per-entry budget: the global timeout, floored by the per-size
+    latency envelope (base latency + bytes over a worst-case goodput)."""
+    base = float(_var.get("health_watchdog_timeout", 300.0))
+    lat = float(_var.get("health_floor_latency_us", 1000.0)) * 1e-6
+    bw = max(float(_var.get("health_floor_mbps", 10.0)), 1e-9) * 1e6
+    return max(base, lat + float(nbytes) / bw)
+
+
+def poll_interval() -> float:
+    p = float(_var.get("health_watchdog_poll", 0.0))
+    if p > 0:
+        return p
+    return max(0.01, min(1.0,
+                         float(_var.get("health_watchdog_timeout",
+                                        300.0)) / 4.0))
+
+
+def trips() -> int:
+    return _trips
+
+
+def desyncs() -> int:
+    return _desyncs
+
+
+def last_report(rank: int) -> Optional[Dict[str, Any]]:
+    with _wlock:
+        rep = _last_report.get(int(rank))
+    return dict(rep) if rep is not None else None
+
+
+# -- install / uninstall -----------------------------------------------------
+
+def install(ctx) -> None:
+    """Register the progress callback on this Context's engine and make
+    sure the fallback daemon thread is running.  Idempotent."""
+    global _thread
+    with _wlock:
+        if id(ctx) in _installed:
+            return
+        _installed[id(ctx)] = ctx
+
+    def _cb() -> int:
+        exc = _pending.pop(ctx.rank, None)
+        if exc is not None:
+            raise exc
+        now = time.monotonic()
+        if now - getattr(ctx, "_health_last_check", 0.0) \
+                < poll_interval() / 2:
+            return 0
+        ctx._health_last_check = now
+        _check(ctx, allow_raise=True)
+        return 0
+
+    ctx._health_cb = _cb
+    ctx.engine.register(_cb, low_priority=True)
+    with _wlock:
+        if _thread is None or not _thread.is_alive():
+            _thread = threading.Thread(target=_daemon,
+                                       name="ompi-tpu-health", daemon=True)
+            _thread.start()
+
+
+def uninstall(ctx) -> None:
+    cb = getattr(ctx, "_health_cb", None)
+    if cb is not None:
+        ctx.engine.unregister(cb)
+        ctx._health_cb = None
+    with _wlock:
+        _installed.pop(id(ctx), None)
+    _pending.pop(ctx.rank, None)
+    # the daemon notices the empty table and exits on its next tick
+
+
+def installed_count() -> int:
+    with _wlock:
+        return len(_installed)
+
+
+def _daemon() -> None:
+    while True:
+        with _wlock:
+            ctxs = list(_installed.values())
+        if not ctxs:
+            return
+        for ctx in ctxs:
+            try:
+                sentinel.publish(ctx)
+                _check(ctx, allow_raise=False)
+            except Exception as exc:   # the daemon must outlive bad ctxs
+                output.verbose(5, "health", f"watchdog daemon: {exc!r}")
+        time.sleep(poll_interval())
+
+
+# -- detection + escalation --------------------------------------------------
+
+def _check(ctx, allow_raise: bool) -> None:
+    now = time.monotonic()
+    live = registry.live_entries(ctx.rank)
+    over = [e for e in live
+            if not e.tripped and e.age_s(now) > effective_timeout(e.nbytes)]
+    if not over:
+        return
+    # derivative-trip suppression: a p2p wait INSIDE a stuck collective
+    # goes over budget together with (or just after) the collective
+    # itself — tripping it too would double-count and clobber the
+    # collective's verdict.  Entries carry their enclosing entry's token
+    # (registry TLS nesting), so drop anything whose ancestor is itself
+    # over budget or already tripped; the outermost stuck op is the
+    # diagnosis.
+    by_token = {e.token: e for e in live}
+    hot = {e.token for e in over} | {e.token for e in live if e.tripped}
+
+    def derivative(e):
+        p = e.parent
+        while p:
+            if p in hot:
+                return True
+            anc = by_token.get(p)
+            p = anc.parent if anc is not None else 0
+        return False
+
+    over = [e for e in over if not derivative(e)]
+    if over:
+        _trip(ctx, over, allow_raise)
+
+
+def _trip(ctx, entries: List[registry.Entry], allow_raise: bool) -> None:
+    global _trips, _desyncs
+    with _wlock:
+        # the daemon and the progress callback scan concurrently — claim
+        # the entries under the lock so one trip is counted ONCE
+        entries = [e for e in entries if not e.tripped]
+        if not entries:
+            return
+        for e in entries:
+            e.tripped = True
+        _trips += len(entries)
+    # publish our own head before reading the peers' so a simultaneous
+    # trip on another rank sees our current position too
+    sentinel.publish(ctx)
+    oldest = entries[0].as_dict()
+    v = None
+    if oldest["kind"] == "coll":
+        v = sentinel.verdict(ctx, oldest)
+        if v["desync"]:
+            with _wlock:
+                _desyncs += len(v["desync"])
+    report = {
+        "rank": ctx.rank,
+        "action": str(_var.get("health_watchdog_action", "dump")),
+        "timeout_s": float(_var.get("health_watchdog_timeout", 300.0)),
+        "tripped": [e.as_dict() for e in entries],
+        "inflight": registry.inflight(ctx.rank),
+        "verdict": v,
+        "ft_failed": sorted(int(r) for r in getattr(ctx, "failed", ())),
+        "watchdog": state(),
+    }
+    with _wlock:
+        _last_report[ctx.rank] = report
+    _dump(ctx, report)
+    text = (f"watchdog trip on rank {ctx.rank}: {oldest['op']!r} "
+            f"(cid {oldest['cid']}, seq {oldest['seq']}) in flight "
+            f"{oldest['age_us'] / 1e6:.3f}s")
+    if v is not None:
+        text += "\n" + sentinel.format_verdict(v)
+    output.verbose(1, "health", text)
+    _escalate(ctx, report, allow_raise)
+
+
+def _escalate(ctx, report: Dict[str, Any], allow_raise: bool) -> None:
+    action = str(_var.get("health_watchdog_action", "dump")).lower()
+    if action == "dump":
+        return
+    e = report["tripped"][0]
+    msg = (f"health watchdog: {e['op']!r} on comm {e['comm'] or e['cid']} "
+           f"(cid {e['cid']}, seq {e['seq']}) exceeded "
+           f"{effective_timeout(e['nbytes']):g}s on rank {ctx.rank}")
+    try:
+        ctx.bootstrap.publish_event({
+            "kind": "watchdog_timeout", "rank": ctx.rank, "cid": e["cid"],
+            "seq": e["seq"], "op": e["op"], "action": action})
+    except Exception:
+        pass
+    if action == "raise":
+        from ..ft.ulfm import WatchdogTimeoutError
+        exc = WatchdogTimeoutError(msg, cid=e["cid"], seq=e["seq"],
+                                   op=e["op"])
+        if allow_raise:
+            raise exc
+        _pending[ctx.rank] = exc     # thrown by the progress cb if polled
+    elif action == "abort":
+        ctx.abort(1, msg)
+
+
+def _dump(ctx, report: Dict[str, Any]) -> Optional[str]:
+    """Write the full flight recorder for this rank to health_dump_dir."""
+    dump_dir = str(_var.get("health_dump_dir", "health_dumps"))
+    if not dump_dir:
+        return None
+    from .. import trace
+    try:
+        os.makedirs(dump_dir, exist_ok=True)
+        doc = dict(report)
+        doc["trace_stats"] = trace.stats(ctx.rank)
+        doc["last_decisions"] = trace.last_decisions()
+        tpath = os.path.join(dump_dir, f"rank{ctx.rank}.trace.json")
+        try:
+            trace.save_chrome(tpath, rank=ctx.rank)
+            doc["chrome_trace"] = tpath
+        except Exception:
+            doc["chrome_trace"] = None
+        hpath = os.path.join(dump_dir, f"rank{ctx.rank}.health.json")
+        with open(hpath, "w") as fh:
+            json.dump(doc, fh, indent=1, default=repr)
+        return hpath
+    except OSError as exc:
+        output.verbose(1, "health", f"watchdog dump failed: {exc}")
+        return None
+
+
+def state() -> Dict[str, Any]:
+    """The watchdog's own status (served on /health and in dumps)."""
+    with _wlock:
+        n = len(_installed)
+        alive = _thread is not None and _thread.is_alive()
+    return {
+        "installed_contexts": n,
+        "daemon_alive": alive,
+        "trips": _trips,
+        "desyncs": _desyncs,
+        "timeout_s": float(_var.get("health_watchdog_timeout", 300.0)),
+        "poll_s": poll_interval(),
+        "action": str(_var.get("health_watchdog_action", "dump")),
+    }
+
+
+def reset() -> None:
+    """Tests: zero counters/reports (leaves installed contexts alone)."""
+    global _trips, _desyncs
+    with _wlock:
+        _trips = 0
+        _desyncs = 0
+        _last_report.clear()
+        _pending.clear()

@@ -281,6 +281,7 @@ class P2P:
                 devchan.offer(self.bootstrap.job_id, cid, self.rank, dst,
                               seq, arr)
                 req = Request()
+                req._ctx = self      # owner attribution (health registry)
                 req.status.source = self.rank
                 req.status.tag = tag
                 req.status.count = info.nbytes
@@ -316,6 +317,7 @@ class P2P:
             else:
                 data = Convertor(arr, dt, cnt).pack() if cnt else b""
         req = Request()
+        req._ctx = self              # owner attribution (health registry)
         nbytes = raw.nbytes if raw is not None else len(data)
         req.status.source = self.rank
         req.status.tag = tag
@@ -401,6 +403,7 @@ class P2P:
         else:
             arr, dt, cnt = _buffer_args(buf, datatype, count)
         req = Request()
+        req._ctx = self              # owner attribution (health registry)
         self.spc.inc("recvs")
 
         def deliver(data: bytes) -> None:

@@ -5,7 +5,7 @@ from __future__ import annotations
 from typing import Any, Callable, List, Optional
 
 from ..core.progress import get_engine
-from .. import peruse
+from .. import health, peruse
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -72,9 +72,17 @@ class Request:
         return self.done
 
     def wait(self, timeout: Optional[float] = None) -> Status:
-        get_engine().wait_until(
-            lambda: self.done or self.pending_error is not None,
-            timeout=timeout)
+        # flight recorder: a blocked p2p wait is watchdog-visible too
+        # (health.enabled is ONE attribute read on the disabled path)
+        htok = health.wait_begin(self) if health.enabled \
+            and not self.done else 0
+        try:
+            get_engine().wait_until(
+                lambda: self.done or self.pending_error is not None,
+                timeout=timeout)
+        finally:
+            if htok:
+                health.op_end(htok)
         if not self.done and self.pending_error is not None:
             # request remains active; the caller acks the failure and may
             # wait again (ULFM PROC_FAILED_PENDING discipline)
@@ -100,8 +108,14 @@ def _settled(r: Request) -> bool:
 
 
 def wait_all(requests: List[Request], timeout: Optional[float] = None) -> List[Status]:
-    get_engine().wait_until(lambda: all(_settled(r) for r in requests),
-                            timeout=timeout)
+    htok = health.waitset_begin(requests, "p2p_wait_all") \
+        if health.enabled and requests else 0
+    try:
+        get_engine().wait_until(lambda: all(_settled(r) for r in requests),
+                                timeout=timeout)
+    finally:
+        if htok:
+            health.op_end(htok)
     out = []
     for r in requests:
         if not r.done and r.pending_error is not None:
@@ -118,8 +132,14 @@ def wait_all(requests: List[Request], timeout: Optional[float] = None) -> List[S
 
 
 def wait_any(requests: List[Request], timeout: Optional[float] = None) -> int:
-    get_engine().wait_until(lambda: any(_settled(r) for r in requests),
-                            timeout=timeout)
+    htok = health.waitset_begin(requests, "p2p_wait_any") \
+        if health.enabled and requests else 0
+    try:
+        get_engine().wait_until(lambda: any(_settled(r) for r in requests),
+                                timeout=timeout)
+    finally:
+        if htok:
+            health.op_end(htok)
     for i, r in enumerate(requests):
         if r.done:
             if r.error is not None:

@@ -118,6 +118,13 @@ class Context:
         from .core import var as _var
         if _var.get("memchecker_enabled", False):
             memchecker.install(self)    # --mca memchecker_enabled 1
+        from . import health
+        if health.enabled:
+            # live health plane: watchdog progress callback + daemon
+            # thread + optional HTTP endpoint (one attribute read when
+            # the plane is off — no import cost either, health is
+            # already loaded via p2p.request)
+            health.install(self)
         from . import hook
         hook.fire("init_bottom", self)   # ≙ mca/hook mpi_init hooks
         _ctx_opened()                    # interlib: a runtime is now live
@@ -179,6 +186,8 @@ class Context:
             return
         self.finalized = True
         _ctx_closed()
+        from . import health
+        health.uninstall(self)   # no-op when the plane was never installed
         if self._prog_thread is not None:
             # pump loop exits on the finalized flag; rejoin so the rest of
             # finalize (drain, fence) runs back under the FUNNELED contract

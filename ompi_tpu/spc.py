@@ -52,6 +52,13 @@ COUNTERS = [
     ("trace_dropped_events", "trace events lost to ring-buffer overflow"),
     ("grad_bucket_count", "bucket exchanges in the last grad-sync plan"),
     ("grad_bucket_bytes", "total gradient bytes in the last grad-sync plan"),
+    # live health plane (fed by ompi_tpu/health; process-wide like trace)
+    ("health_watchdog_trips",
+     "watchdog trips (in-flight op exceeded its timeout envelope)"),
+    ("health_inflight_count", "operations currently held in flight"),
+    ("health_inflight_max_age_us", "age of the oldest in-flight operation"),
+    ("health_desync_detected",
+     "peers the desync sentinel caught calling a different collective"),
 ]
 
 
@@ -81,15 +88,21 @@ class Counters:
         if name in ("grad_bucket_count", "grad_bucket_bytes"):
             from .parallel import overlap
             return overlap.pvar_value(name)
+        if name.startswith("health_"):
+            from . import health
+            if name in health.PVARS:
+                return health.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
         out = dict(self._v)
-        from . import trace
+        from . import health, trace
         from .parallel import overlap
         out["trace_dropped_events"] = trace.dropped_events()
         out["grad_bucket_count"] = overlap.pvar_value("grad_bucket_count")
         out["grad_bucket_bytes"] = overlap.pvar_value("grad_bucket_bytes")
+        for name in health.PVARS:
+            out[name] = health.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

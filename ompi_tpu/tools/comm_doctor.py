@@ -21,7 +21,9 @@ comm_world with an in-band clock sync; rank 0 analyzes and reports.
 from __future__ import annotations
 
 import argparse
+import glob
 import json
+import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..trace import analyze as _an
@@ -101,6 +103,69 @@ def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
     return "\n".join(lines), data
 
 
+def load_health_dump(dump_dir: str) -> List[Dict[str, Any]]:
+    """The per-rank ``rank<r>.health.json`` reports a watchdog trip wrote
+    into ``health_dump_dir``, sorted by rank."""
+    reports = []
+    for path in sorted(glob.glob(os.path.join(dump_dir,
+                                              "rank*.health.json"))):
+        with open(path) as fh:
+            reports.append(json.load(fh))
+    return reports
+
+
+def build_health_report(
+        reports: List[Dict[str, Any]]) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for a health_dump_dir's reports:
+    per-rank watchdog state, the in-flight op table at trip time, and
+    the desync-sentinel verdicts (which rank is behind / desynced)."""
+    lines: List[str] = []
+    w = lines.append
+    w(f"health dump: {len(reports)} rank report(s)")
+    behind: Dict[int, int] = {}
+    desync: Dict[int, int] = {}
+    for rep in reports:
+        r = rep.get("rank")
+        wd = rep.get("watchdog", {})
+        w(f"  rank {r}: action={rep.get('action')} "
+          f"timeout={rep.get('timeout_s')}s trips={wd.get('trips')} "
+          f"ft_failed={rep.get('ft_failed')}")
+        flight = rep.get("inflight") or rep.get("tripped") or []
+        if flight:
+            w(f"    {'cid':>4s} {'seq':>5s} {'op':20s} {'age_s':>8s} "
+              f"{'signature':12s} tripped")
+            for e in flight:
+                w(f"    {e['cid']:4d} {e['seq']:5d} {e['op']:20s} "
+                  f"{e['age_us'] / 1e6:8.3f} {e['signature']:12s} "
+                  f"{'*' if e.get('tripped') else ''}")
+        v = rep.get("verdict")
+        if v:
+            from ..health import sentinel
+            for ln in sentinel.format_verdict(v).splitlines():
+                w("    " + ln)
+            for row in v.get("behind", ()):
+                behind[row["rank"]] = behind.get(row["rank"], 0) + 1
+            for row in v.get("desync", ()):
+                desync[row["rank"]] = desync.get(row["rank"], 0) + 1
+    if desync:
+        worst = max(desync, key=lambda k: desync[k])
+        w(f"  VERDICT: rank {worst} called a DIFFERENT collective than "
+          f"{desync[worst]} peer(s) at the same sequence point — desync "
+          "bug, not a straggler")
+    elif behind:
+        worst = max(behind, key=lambda k: behind[k])
+        w(f"  VERDICT: rank {worst} is BEHIND {behind[worst]} peer(s) — "
+          "straggler or hang on that rank")
+    elif reports:
+        w("  VERDICT: no cross-rank attribution in the dumps "
+          "(uniform stall, or sentinel heads unavailable)")
+    return "\n".join(lines), {
+        "reports": reports,
+        "behind_votes": behind,
+        "desync_votes": desync,
+    }
+
+
 def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         prog="comm_doctor",
@@ -120,6 +185,12 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                     help="emit the structured report (CI mode)")
     ap.add_argument("--merged-out", default=None,
                     help="also write the merged global Chrome trace here")
+    ap.add_argument("--health-dump", default=None, metavar="DIR",
+                    help="load a health_dump_dir written by the watchdog "
+                         "(rank*.health.json + rank*.trace.json): renders "
+                         "the in-flight table and desync verdict, and "
+                         "merges the trace halves through the normal "
+                         "pipeline")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -141,6 +212,19 @@ def main(argv: Optional[List[str]] = None) -> int:
             return _report(tl, ns)
         finally:
             runtime.finalize()
+    if ns.health_dump:
+        reports = load_health_dump(ns.health_dump)
+        if not reports:
+            print(f"comm_doctor: no rank*.health.json under "
+                  f"{ns.health_dump}")
+            return 2
+        htext, hdata = build_health_report(reports)
+        # the dump's trace halves go through the normal merge pipeline so
+        # the stall shows up in context (skew, latency, decisions)
+        traces = ns.dumps or sorted(glob.glob(
+            os.path.join(ns.health_dump, "rank*.trace.json")))
+        tl = _merge.merge(_merge.load_chrome(traces)) if traces else None
+        return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
@@ -152,10 +236,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     return _report(tl, ns)
 
 
-def _report(tl: "_merge.FleetTimeline", ns: argparse.Namespace) -> int:
-    if ns.merged_out:
+def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
+            health: Optional[Tuple[str, Dict[str, Any]]] = None) -> int:
+    if tl is not None and ns.merged_out:
         tl.save_chrome(ns.merged_out)
-    text, data = build_report(tl, rules=ns.rules, z_thresh=ns.z)
+    text, data = (build_report(tl, rules=ns.rules, z_thresh=ns.z)
+                  if tl is not None else ("", {}))
+    if health is not None:
+        text = (health[0] + "\n" + text) if text else health[0]
+        data["health"] = health[1]
     if ns.as_json:
         if ns.merged_out:
             data["merged_chrome_trace"] = ns.merged_out

@@ -220,6 +220,14 @@ def explain_last(op: str) -> Optional[Dict[str, Any]]:
     return dict(rec) if rec is not None else None
 
 
+def last_decisions() -> Dict[str, Dict[str, Any]]:
+    """Every op's most recent decision-audit record (the explain_last
+    table in one read) — what the health watchdog folds into its
+    flight-recorder dump."""
+    with _lock:
+        return {op: dict(rec) for op, rec in _last.items()}
+
+
 # -- accessors ---------------------------------------------------------------
 
 def events(rank: Optional[int] = None) -> List[dict]:

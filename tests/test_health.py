@@ -1,0 +1,398 @@
+"""Live health plane: in-flight registry, collective watchdog, desync
+sentinel, and the HTTP /metrics//health endpoint (ompi_tpu/health).
+
+The multi-rank tests run the threaded harness (runtime.run_ranks) with
+deliberately small watchdog timeouts; each uses its own dump dir under
+tmp_path and restores every health var on the way out (the autouse
+_fresh_var_cache fixture resets the cache; the module-level fixture here
+additionally clears the CLI layer and zeroes the plane's counters, which
+are process-wide like the trace rings).
+"""
+
+import json
+import re
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.health
+
+from ompi_tpu import health, runtime  # noqa: E402
+from ompi_tpu.core import var
+from ompi_tpu.ft.ulfm import WatchdogTimeoutError
+from ompi_tpu.health import registry, sentinel, watchdog
+
+_HEALTH_VARS = (
+    "health_enabled", "health_watchdog_timeout", "health_watchdog_poll",
+    "health_floor_latency_us", "health_floor_mbps",
+    "health_watchdog_action", "health_dump_dir", "health_http_port",
+    "comm_default_timeout",
+)
+
+
+@pytest.fixture
+def plane():
+    """set(name=value, ...) applies health vars through the CLI layer;
+    everything is cleared (and the plane's process-wide counters zeroed)
+    on teardown regardless of how the test exits."""
+    health.reset()
+
+    def set_vars(**kw):
+        for k, v in kw.items():
+            var.registry.set_cli(k, str(v))
+        var.registry.reset_cache()
+
+    yield set_vars
+    for name in _HEALTH_VARS:
+        var.registry.clear_cli(name)
+    var.registry.reset_cache()
+    health.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry: sequence numbers, signatures, nesting
+# ---------------------------------------------------------------------------
+
+def test_registry_seq_and_signature(plane):
+    t1 = registry.begin(rank=0, cid=7, op="allreduce", comm_name="world",
+                        dtype="float32", count=8, nbytes=32,
+                        reduction="sum", peers=(0, 1))
+    t2 = registry.begin(rank=0, cid=7, op="bcast", comm_name="world",
+                        peers=(0, 1))
+    t3 = registry.begin(rank=1, cid=7, op="allreduce", peers=(0, 1))
+    live = registry.inflight(0)
+    assert [e["seq"] for e in live] == [1, 2]      # per-(rank, cid) monotonic
+    assert registry.inflight(1)[0]["seq"] == 1     # other rank independent
+    # deterministic, field-sensitive signature (blake2s, not salted hash())
+    sig = registry.signature_of("allreduce", "float32", 8, "sum", "")
+    assert live[0]["signature"] == sig
+    assert registry.signature_of("allgather", "float32", 8, "sum", "") != sig
+    assert registry.signature_of("allreduce", "float32", 9, "sum", "") != sig
+    for t in (t3, t2, t1):
+        registry.end(t)
+    assert registry.inflight_count() == 0
+    # heads survive completion (the sentinel compares positions, not
+    # liveness) and are keyed str(cid) for the JSON round trip
+    heads = registry.heads(0)
+    assert heads["7"]["seq"] == 2 and heads["7"]["inflight"] is False
+
+
+def test_registry_note_arm_folds_into_signature(plane):
+    tok = registry.begin(rank=0, cid=1, op="allreduce", dtype="float32",
+                         count=4, reduction="sum")
+    before = registry.inflight(0)[0]["signature"]
+    registry.note_arm("quant")
+    after = registry.inflight(0)[0]["signature"]
+    assert after != before
+    assert after == registry.signature_of("allreduce", "float32", 4,
+                                          "sum", "quant")
+    assert registry.heads(0)["1"]["sig"] == after
+    registry.end(tok)
+
+
+def test_registry_parent_nesting(plane):
+    outer = registry.begin(rank=0, cid=1, op="allreduce")
+    inner = registry.begin(rank=0, cid=-1, op="p2p_wait", kind="p2p")
+    entries = {e.op: e for e in registry.live_entries(0)}
+    assert entries["p2p_wait"].parent == entries["allreduce"].token
+    assert entries["allreduce"].parent == 0
+    assert entries["p2p_wait"].seq == -1           # no coll seq consumed
+    registry.end(inner)
+    registry.end(outer)
+
+
+def test_effective_timeout_per_size_floor(plane):
+    plane(health_watchdog_timeout="2.0", health_floor_latency_us="1000",
+          health_floor_mbps="10")
+    assert watchdog.effective_timeout(0) == pytest.approx(2.0)
+    # 1 GiB at 10 MB/s floor ≈ 107s — the envelope wins over the base
+    big = watchdog.effective_timeout(1 << 30)
+    assert big > 100.0
+
+
+# ---------------------------------------------------------------------------
+# watchdog end-to-end: stall attribution, desync, escalation actions
+# ---------------------------------------------------------------------------
+
+def test_watchdog_names_stalled_rank(plane, tmp_path):
+    dump = tmp_path / "dumps"
+    plane(health_enabled="true", health_watchdog_timeout="0.2",
+          health_watchdog_action="dump", health_dump_dir=str(dump))
+
+    def fn(ctx):
+        c = ctx.comm_world
+        buf = np.ones(8, np.float32)
+        c.coll.allreduce(c, buf)
+        if ctx.rank == 2:
+            time.sleep(0.6)
+        c.coll.allreduce(c, buf)
+        return health.last_report(ctx.rank)
+
+    reports = runtime.run_ranks(4, fn, timeout=60)
+    assert reports[2] is None                      # the sleeper never trips
+    for r in (0, 1, 3):
+        rep = reports[r]
+        assert rep is not None and rep["tripped"][0]["op"] == "allreduce"
+        assert [row["rank"] for row in rep["verdict"]["behind"]] == [2]
+        assert not rep["verdict"]["desync"]
+    assert health.pvar_value("health_watchdog_trips") == 3
+    # nested p2p waits inside the stuck allreduce must NOT double-count
+    assert sorted(p.name for p in dump.glob("rank*.health.json")) == [
+        "rank0.health.json", "rank1.health.json", "rank3.health.json"]
+    doc = json.loads((dump / "rank0.health.json").read_text())
+    assert doc["rank"] == 0 and doc["verdict"]["behind"][0]["rank"] == 2
+    assert "trace_stats" in doc and "last_decisions" in doc
+
+
+def test_comm_doctor_reads_health_dump(plane, tmp_path, capsys):
+    dump = tmp_path / "dumps"
+    plane(health_enabled="true", health_watchdog_timeout="0.2",
+          health_watchdog_action="dump", health_dump_dir=str(dump))
+
+    def fn(ctx):
+        c = ctx.comm_world
+        buf = np.ones(8, np.float32)
+        c.coll.allreduce(c, buf)
+        if ctx.rank == 2:
+            time.sleep(0.6)
+        c.coll.allreduce(c, buf)
+        return True
+
+    runtime.run_ranks(4, fn, timeout=60)
+    from ompi_tpu.tools import comm_doctor
+    assert comm_doctor.main(["--health-dump", str(dump), "--json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["health"]["behind_votes"] == {"2": 3}
+    assert len(data["health"]["reports"]) == 3
+    # human mode renders the verdict line naming the stalled rank
+    assert comm_doctor.main(["--health-dump", str(dump)]) == 0
+    text = capsys.readouterr().out
+    assert "VERDICT: rank 2 is BEHIND 3 peer(s)" in text
+    assert "BEHIND: rank 2" in text
+
+
+def test_desync_sentinel_names_mismatched_collective(plane):
+    plane(health_enabled="true", health_watchdog_timeout="0.3",
+          health_watchdog_action="raise", health_dump_dir="")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        buf = np.ones(8, np.float32)
+        c.coll.allreduce(c, buf)                   # seq 1: uniform warmup
+        try:
+            if ctx.rank == 0:
+                c.coll.allgather(c, buf)           # seq 2: the desync bug
+            else:
+                c.coll.allreduce(c, buf)
+        except WatchdogTimeoutError as exc:
+            return (exc.op, exc.seq, health.last_report(ctx.rank))
+        return None
+
+    res = runtime.run_ranks(4, fn, timeout=60)
+    assert all(r is not None for r in res), "every rank must trip"
+    op0, seq0, rep0 = res[0]
+    assert (op0, seq0) == ("allgather", 2)
+    assert sorted(d["rank"] for d in rep0["verdict"]["desync"]) == [1, 2, 3]
+    for r in (1, 2, 3):
+        op, seq, rep = res[r]
+        assert (op, seq) == ("allreduce", 2)
+        rows = rep["verdict"]["desync"]
+        assert [d["rank"] for d in rows] == [0]
+        assert rows[0]["op"] == "allgather"        # names WHAT rank 0 called
+    assert health.pvar_value("health_desync_detected") >= 4
+    text = sentinel.format_verdict(res[1][2]["verdict"])
+    assert "DESYNC: rank 0 called 'allgather' at seq 2" in text
+
+
+class _FakeBootstrap:
+    def __init__(self):
+        self.events = []
+
+    def publish_event(self, ev):
+        self.events.append(ev)
+
+    def put(self, key, value):
+        pass
+
+
+class _FakeCtx:
+    rank = 3
+    failed = ()
+
+    def __init__(self):
+        self.bootstrap = _FakeBootstrap()
+        self.aborts = []
+
+    def abort(self, code, msg):
+        self.aborts.append((code, msg))
+
+
+def _fake_report():
+    return {"tripped": [{"op": "allreduce", "cid": 5, "seq": 9,
+                         "comm": "world", "nbytes": 64}]}
+
+
+def test_escalation_action_variants(plane):
+    ctx = _FakeCtx()
+    plane(health_watchdog_action="dump")
+    watchdog._escalate(ctx, _fake_report(), allow_raise=True)
+    assert not ctx.bootstrap.events and not ctx.aborts
+
+    plane(health_watchdog_action="raise")
+    with pytest.raises(WatchdogTimeoutError) as ei:
+        watchdog._escalate(ctx, _fake_report(), allow_raise=True)
+    assert (ei.value.cid, ei.value.seq, ei.value.op) == (5, 9, "allreduce")
+    assert ctx.bootstrap.events[-1]["kind"] == "watchdog_timeout"
+    # the daemon thread cannot raise into the blocked wait: it parks the
+    # exception for the progress callback to throw on the next poll
+    watchdog._escalate(ctx, _fake_report(), allow_raise=False)
+    assert isinstance(watchdog._pending.pop(3), WatchdogTimeoutError)
+
+    plane(health_watchdog_action="abort")
+    watchdog._escalate(ctx, _fake_report(), allow_raise=True)
+    assert ctx.aborts and ctx.aborts[0][0] == 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint: /metrics grammar, /health JSON, 404
+# ---------------------------------------------------------------------------
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+_PROM_SAMPLE = re.compile(
+    rf"^{_PROM_NAME}(?:\{{{_PROM_LABEL}(?:,{_PROM_LABEL})*\}})?"
+    r" [-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|Inf)$")
+_PROM_HELP = re.compile(rf"^# HELP {_PROM_NAME} \S.*$")
+_PROM_TYPE = re.compile(
+    rf"^# TYPE ({_PROM_NAME}) (counter|gauge|histogram|summary|untyped)$")
+
+
+def _assert_prometheus_grammar(text):
+    assert text.endswith("\n")
+    typed = set()
+    samples = 0
+    for line in text.rstrip("\n").split("\n"):
+        m = _PROM_TYPE.match(line)
+        if m:
+            typed.add(m.group(1))
+            continue
+        if _PROM_HELP.match(line):
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+        samples += 1
+        assert line.split("{")[0] in typed, f"sample before TYPE: {line!r}"
+    assert samples > 0
+    return samples
+
+
+def test_http_endpoint_metrics_and_health(plane):
+    plane(health_enabled="true")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        c.coll.allreduce(c, np.ones(8, np.float32))
+        if ctx.rank != 0:
+            return None
+        srv = health.serve_http(ctx, port=0)       # ephemeral port
+        port = srv.server_address[1]
+        try:
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10)
+            body = metrics.read().decode()
+            ctype = metrics.headers["Content-Type"]
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/health", timeout=10
+            ).read().decode())
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+                code = 200
+            except urllib.error.HTTPError as e:
+                code = e.code
+        finally:
+            health.stop_http(srv)
+        return body, ctype, doc, code
+
+    body, ctype, doc, code = runtime.run_ranks(2, fn, timeout=60)[0]
+    assert code == 404
+    assert ctype.startswith("text/plain")
+    _assert_prometheus_grammar(body)
+    for name in health.PVARS:
+        assert f"ompi_tpu_{name}" in body           # watchdog pvars exposed
+    assert 'rank="0"' in body
+    assert doc["rank"] == 0 and doc["size"] == 2
+    assert doc["watchdog"]["daemon_alive"] is True  # plane installed
+    assert isinstance(doc["inflight"], list)
+    assert doc["ft_failed"] == []
+
+
+# ---------------------------------------------------------------------------
+# disabled path, pvar plumbing, comm_default_timeout
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_costs_one_attribute_read(plane):
+    assert type(health.enabled) is bool and health.enabled is False
+    assert "enabled" in vars(health)               # attribute, not property
+
+    def fn(ctx):
+        c = ctx.comm_world
+        c.coll.allreduce(c, np.ones(8, np.float32))
+        return (registry.inflight_count(), watchdog.installed_count())
+
+    inflight, installed = runtime.run_ranks(2, fn, timeout=60)[0]
+    assert inflight == 0                            # nothing registered
+    assert installed == 0                           # no watchdog, no thread
+
+
+def test_health_pvars_reach_mpit_and_prometheus(plane):
+    from ompi_tpu import mpit, spc
+
+    def fn(ctx):
+        return (mpit.pvar_read(ctx, "health_watchdog_trips"),
+                mpit.pvar_read_all(ctx),
+                spc.export_prometheus(ctx))
+
+    trips, snap, prom = runtime.run_ranks(1, fn, timeout=60)[0]
+    assert trips == 0.0
+    for name in health.PVARS:
+        assert name in snap                         # snapshot read-through
+        assert f"# TYPE ompi_tpu_{name} counter" in prom
+    _assert_prometheus_grammar(prom)
+
+
+def test_comm_default_timeout_names_peer(plane):
+    plane(comm_default_timeout="0.3")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        local = c.split(color=ctx.rank, key=0, name=f"half{ctx.rank}")
+        if ctx.rank == 0:
+            # rank 1 never calls create_intercomm: the leader handshake
+            # must expire with a TimeoutError naming comm, peer and var
+            with pytest.raises(TimeoutError) as ei:
+                local.create_intercomm(0, c, remote_leader=1, tag=3)
+            return str(ei.value)
+        time.sleep(0.6)
+        return None
+
+    msg = runtime.run_ranks(2, fn, timeout=60)[0]
+    assert "comm_default_timeout" in msg and "0.3" in msg
+    assert "bridge rank 1" in msg
+
+
+def test_watchdog_uninstall_on_finalize(plane):
+    plane(health_enabled="true", health_watchdog_timeout="30")
+
+    def fn(ctx):
+        return watchdog.installed_count()
+
+    # each rank sees at least itself installed (ranks start/finish at
+    # their own pace, so observing the sibling is not guaranteed)
+    assert all(c >= 1 for c in runtime.run_ranks(2, fn, timeout=60))
+    deadline = time.monotonic() + 5
+    while watchdog.installed_count() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert watchdog.installed_count() == 0          # finalize uninstalled
