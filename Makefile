@@ -5,13 +5,16 @@
 
 SHELL := /bin/bash
 
-.PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests health-tests
+.PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
+	health-tests perf-tests bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
 # watchdog must attribute within 2x its timeout) guards the tier the
-# rest of the run leans on when something hangs
-tier1: health-tests
+# rest of the run leans on when something hangs; the perf-plane gate
+# rides along — its suite is also seconds-cheap and its probe banks the
+# trajectory artifact bench-compare diffs against
+tier1: health-tests perf-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -50,6 +53,23 @@ health-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_health.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --watchdog
+
+# the continuous-performance tier: cost model + goodput ledger + sentry
+# suite, then the end-to-end probe (measures the goodput split through
+# the unsynced-floor methodology, banks BENCH_r06.json and the
+# PERF_LEDGER, exits nonzero on unmeasured columns)
+perf-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_perf.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --goodput
+
+# regression gate over the banked trajectory artifact: non-zero exit
+# names every phase whose busbw/goodput/MFU column lost >10% (run it
+# with OLD= NEW= to diff two arbitrary banked artifacts)
+OLD ?= BENCH_r06.json
+NEW ?= BENCH_r06.json
+bench-compare:
+	python bench.py --compare $(OLD) $(NEW)
 
 # the comm/compute overlap tier: bucketed grad sync + collective-matmul
 # rings, INCLUDING the multi-device tests marked slow (excluded from

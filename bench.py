@@ -1503,7 +1503,254 @@ def run_watchdog_probe(platform: str) -> None:
             f"watchdog probe: no flight-recorder dumps under {dump_dir}")
 
 
+# -- continuous performance plane: trajectory artifact + probes ---------------
+
+# higher-is-better columns --compare judges; everything else in a phase
+# row (latencies, byte counts) is context, not a pass/fail axis
+_COMPARE_COLUMNS = ("busbw_GBps", "goodput_pct", "mfu_pct")
+
+
+def _merge_r06(here: str, platform: str, ndev: int, phases: dict) -> str:
+    """Read-modify-write BENCH_r06.json: per-phase columns merge so the
+    goodput probe and the default run each bank their slice without
+    clobbering the other's."""
+    path = os.path.join(here, "BENCH_r06.json")
+    doc = _load_json(path)
+    if not isinstance(doc, dict) or \
+            doc.get("schema") != "bench-trajectory-v1":
+        doc = {"schema": "bench-trajectory-v1", "phases": {}}
+    doc["platform"] = platform
+    doc["ndev"] = ndev
+    merged = doc.setdefault("phases", {})
+    for name, cols in phases.items():
+        row = merged.setdefault(name, {})
+        row.update({k: v for k, v in cols.items() if v is not None})
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+    return path
+
+
+def _bank_r06(here: str, sweep: dict) -> None:
+    """Bank the default run's headline busbw columns as trajectory
+    phases (one per collective x size, plus the grad-sync arms)."""
+    phases = {}
+    for r in sweep.get("results", []):
+        if "skipped" in r or "error" in r:
+            continue
+        coll = str(r.get("collective", ""))
+        if coll.startswith("grad_sync"):
+            for arm in ("bucketed", "perleaf"):
+                phases[f"gradsync_{arm}"] = {
+                    "busbw_GBps": r.get(f"busbw_GBps_{arm}"),
+                    "overlap_efficiency":
+                        r.get(f"overlap_efficiency_{arm}"),
+                }
+            continue
+        bw = r.get("device_GBps_chained", r.get("device_GBps"))
+        if bw:
+            phases[f"{coll}_{r.get('bytes_per_rank', 0)}B"] = {
+                "busbw_GBps": bw}
+    if phases:
+        _merge_r06(here, sweep.get("platform", "?"),
+                   int(sweep.get("ndev", 0) or 0), phases)
+
+
+def run_compare(old_path: str, new_path: str) -> None:
+    """--compare OLD.json NEW.json: diff two bench-trajectory artifacts
+    (BENCH_r06.json schema) on the higher-is-better columns and exit
+    non-zero naming every phase that lost more than 10%.  Pure file
+    arithmetic — runs without initializing jax, so a CI gate can
+    compare banked artifacts on any box."""
+    old, new = _load_json(old_path), _load_json(new_path)
+    if old is None or new is None:
+        raise SystemExit("bench compare: unreadable artifact "
+                         f"({old_path if old is None else new_path})")
+    regressions, checked = [], 0
+    for phase, orow in sorted((old.get("phases") or {}).items()):
+        nrow = (new.get("phases") or {}).get(phase)
+        if not isinstance(orow, dict) or not isinstance(nrow, dict):
+            continue
+        for col in _COMPARE_COLUMNS:
+            ov, nv = orow.get(col), nrow.get(col)
+            if not isinstance(ov, (int, float)) \
+                    or not isinstance(nv, (int, float)) or ov <= 0:
+                continue
+            checked += 1
+            if nv < 0.9 * ov:
+                regressions.append(
+                    f"{phase}: {col} {ov:g} -> {nv:g} "
+                    f"({(nv / ov - 1) * 100:+.1f}%)")
+    print(json.dumps({
+        "metric": "bench_compare",
+        "value": float(len(regressions)),
+        "unit": "phases regressed >10%",
+        "old": old_path, "new": new_path,
+        "columns_checked": checked,
+        "regressions": regressions,
+    }))
+    if regressions:
+        raise SystemExit("bench compare: regression in "
+                         + "; ".join(regressions))
+    if not checked:
+        raise SystemExit("bench compare: no comparable columns between "
+                         f"{old_path} and {new_path}")
+
+
+def run_goodput_probe(platform: str) -> None:
+    """--goodput: end-to-end acceptance for the continuous performance
+    plane.  With perf + trace live, trains the grad-sync step config on
+    the dp mesh through three arms and converts the arm deltas into a
+    measured goodput split (run_gradsync's floor methodology): exposed
+    comm = t_bucketed - floor, total comm = t_perleaf - floor.  The
+    bucketed arm's overlap spans feed the learned cost model through
+    the trace span sink, so the run also persists
+    PERF_LEDGER_<platform>.json.  Banks goodput/MFU/overlap-efficiency
+    columns into BENCH_r06.json; exits non-zero when any banked column
+    is missing/non-finite or the model learned nothing."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import perf, trace
+    from ompi_tpu.core import var
+    from ompi_tpu.models.transformer import (Config, init_params,
+                                             train_flops_per_token)
+    from ompi_tpu.parallel import make_mesh
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 2:
+        raise SystemExit("goodput probe: needs >= 2 devices for a dp "
+                         "axis")
+    mesh = make_mesh({"dp": ndev})
+    bucket_bytes = (256 << 10) if platform == "cpu" else None
+    base = dict(vocab=2048, d_model=256, n_layers=4, n_heads=4,
+                head_dim=64, d_ff=1024, seq=256, dtype=jnp.float32,
+                attn="dense", grad_bucket_bytes=bucket_bytes)
+    batch = ndev
+    reps = 5 if platform == "cpu" else 10
+
+    params = init_params(jax.random.key(0), Config(**base))
+    leaves = jax.tree.leaves(params)
+    total_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
+    del params, leaves
+
+    var.registry.set_cli("perf_enabled", "true")
+    var.registry.reset_cache()
+    perf.reset()
+    perf.enable()
+    trace.enable()
+    try:
+        times = {}
+        for arm in ("unsynced", "perleaf", "bucketed"):
+            cfg = Config(**base, grad_sync=arm)
+            # identical seed per arm: same token stream, comparable work
+            dt, _tps, _n, final = _measure_steps(
+                cfg, batch, np.random.default_rng(0), reps=reps,
+                mesh=mesh)
+            times[arm] = dt
+            print(f"goodput {arm:9s} step {dt * 1e3:8.2f} ms  "
+                  f"loss {final:.4f}", flush=True)
+
+        # eager bucketed passes: inside the jitted step the sync inlines
+        # into the compiled program (vg sees a Tracer and records no
+        # spans) — eager vg calls are what hand the span sink its
+        # arm-attributed grad_sync:bucket samples for the cost model
+        from ompi_tpu.models.transformer import loss_fn
+        from ompi_tpu.parallel import overlap
+        cfg_b = Config(**base, grad_sync="bucketed")
+        eparams = init_params(jax.random.key(0), cfg_b)
+        evg = overlap.make_grad_sync(
+            "bucketed", mesh,
+            lambda p, t: loss_fn(p, t, cfg_b, None),
+            bucket_bytes=bucket_bytes)
+        etok = jnp.asarray(np.random.default_rng(0).integers(
+            0, base["vocab"], size=(batch, base["seq"] + 1)), jnp.int32)
+        for _ in range(3):
+            jax.block_until_ready(evg(eparams, etok))
+        del eparams, evg, etok
+
+        floor = times["unsynced"]
+        exposed = max(times["bucketed"] - floor, 0.0)
+        total = max(times["perleaf"] - floor, 0.0)
+        fpt = train_flops_per_token(Config(**base))
+        tokens = batch * (base["seq"] - 1)
+        peak, peak_src = _peak_tflops(jax.devices()[0])
+        for _ in range(reps):
+            perf.record_step(times["bucketed"], comm_total_s=total,
+                             comm_exposed_s=exposed, tokens=tokens,
+                             flops_per_token=fpt, peak_tflops=peak)
+        snap = perf.ledger.snapshot()
+        buckets = perf.model.bucket_count()
+
+        def busbw(arm):
+            t_sync = times[arm] - floor
+            if t_sync <= 0:
+                return None
+            return round(2 * (ndev - 1) / ndev * total_bytes
+                         / t_sync / 1e9, 3)
+
+        ledger_path = perf.default_ledger_path(platform, root=here)
+        perf.save_ledger(ledger_path, platform=platform)
+        cols = {
+            "goodput": {
+                "goodput_pct": snap["goodput_pct"],
+                "mfu_pct": snap["mfu_pct"],
+                "overlap_efficiency": snap["overlap_efficiency"],
+            },
+            "gradsync_bucketed": {
+                "busbw_GBps": busbw("bucketed"),
+                "overlap_efficiency": snap["overlap_efficiency"],
+            },
+            "gradsync_perleaf": {"busbw_GBps": busbw("perleaf")},
+        }
+        r06_path = _merge_r06(here, platform, ndev, cols)
+        doc = {
+            "metric": "perf_goodput",
+            "value": snap["goodput_pct"],
+            "unit": "% of step wall spent in compute",
+            "platform": platform, "ndev": ndev,
+            "step_ms": {a: round(t * 1e3, 2) for a, t in times.items()},
+            "comm_exposed_ms": round(exposed * 1e3, 3),
+            "comm_total_ms": round(total * 1e3, 3),
+            "goodput_pct": snap["goodput_pct"],
+            "mfu_pct": snap["mfu_pct"],
+            "overlap_efficiency": snap["overlap_efficiency"],
+            "peak_tflops": peak, "peak_source": peak_src,
+            "model_buckets": buckets,
+            "ledger": os.path.basename(ledger_path),
+            "banked": os.path.basename(r06_path),
+        }
+        with open(os.path.join(here, f"GOODPUT_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps(doc), flush=True)
+
+        gp = cols["goodput"]
+        bad = [k for k, v in gp.items()
+               if not isinstance(v, (int, float)) or not np.isfinite(v)]
+        if bad:
+            raise SystemExit("goodput probe: unmeasured/non-finite "
+                             f"columns {bad} (banked {gp})")
+        if buckets < 1:
+            raise SystemExit("goodput probe: cost model learned no "
+                             "buckets (overlap spans never reached the "
+                             "span sink)")
+    finally:
+        var.registry.clear_cli("perf_enabled")
+        var.registry.reset_cache()
+        perf.disable()
+        trace.disable()
+
+
 def main() -> None:
+    argv = sys.argv[1:]
+    if "--compare" in argv:
+        i = argv.index("--compare")
+        if len(argv) < i + 3:
+            raise SystemExit("usage: bench.py --compare OLD.json "
+                             "NEW.json")
+        run_compare(argv[i + 1], argv[i + 2])
+        return
     t_start = time.time()
     try:
         platform = pick_platform()
@@ -1528,6 +1775,9 @@ def main() -> None:
             return
         if "--watchdog" in sys.argv[1:]:
             run_watchdog_probe(platform)
+            return
+        if "--goodput" in sys.argv[1:]:
+            run_goodput_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges
@@ -1605,6 +1855,7 @@ def main() -> None:
         with open(os.path.join(here, fname), "w") as f:
             json.dump(sweep, f, indent=1)
         update_baseline_md(sweep)
+        _bank_r06(here, sweep)
 
         measured = [r for r in sweep["results"] if "skipped" not in r
                     and not str(r.get("collective", ""))

@@ -73,7 +73,7 @@ class CollTable:
                     spc.inc("collectives")
                     if name == "barrier":
                         spc.inc("barriers")
-                from .. import health, monitoring, trace
+                from .. import health, monitoring, perf, trace
                 if trace.enabled:
                     # per-rank arrival marker: dispatch time is the entry
                     # timestamp the fleet skew analysis keys on — every
@@ -96,9 +96,18 @@ class CollTable:
                     # attribute a hang (ompi_tpu/health/registry.py)
                     htok = health.coll_begin(comm, name, a, kw)
                     try:
+                        if perf.enabled:
+                            # cost-model sample: dispatch timed; the arm
+                            # is annotated post-decision by coll/xla's
+                            # audit (perf.note_arm) — un-annotated
+                            # dispatches are dropped, and a raising
+                            # collective contributes nothing
+                            return perf.timed_coll(fn, comm, name, a, kw)
                         return fn(comm, *a, **kw)
                     finally:
                         health.op_end(htok)
+                if perf.enabled:
+                    return perf.timed_coll(fn, comm, name, a, kw)
                 return fn(comm, *a, **kw)
 
             return counted

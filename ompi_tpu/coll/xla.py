@@ -100,6 +100,14 @@ _var.register("coll", "xla", "collmm_mode", "", type=str, level=3,
                    "(native = unidirectional ring | bidir = two "
                    "half-rings on both ICI directions; empty = auto "
                    "via DEVICE_RULES collmm rows).")
+_var.register("coll", "xla", "rules", "", type=str, level=3,
+              help="Arm-selection source: empty/'static' = platform "
+                   "default + DEVICE_RULES rows; 'learned' = consult "
+                   "the perf cost-model ledger first (best modeled "
+                   "busbw at the observed size, reason "
+                   "'learned:<a>=..GBps-vs-<b>=..GBps'), falling "
+                   "through to the static chain on a model miss. "
+                   "Force vars and blanket switches still outrank.")
 
 # every mode any decision point can name (rules-file vocabulary)
 _MODES = ("native", "staged", "quant", "bidir")
@@ -207,6 +215,26 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
         if coll in _QUANT_COLLS:
             chain.append(f"blanket:COLL_QUANT={qvar} skipped "
                          "(op/dtype/layout ineligible)")
+    quant_off = qvar in ("0", "off", "false", "no")
+    floor = int(_var.get("coll_quant_min_bytes", 1 << 20))
+    source = str(_var.get("coll_xla_rules", "") or "").strip().lower()
+    if source == "learned":
+        # cost-model source (ompi_tpu/perf): best modeled busbw at this
+        # size wins.  Quant stays subject to the same eligibility gates
+        # as a quant rules row; a model miss falls through to the static
+        # chain below so a cold ledger never strands a collective.
+        from .. import perf
+        cand = tuple(m for m in allowed
+                     if m != "quant"
+                     or (q_ok and not quant_off and nbytes >= floor))
+        learned = perf.best_arm(coll, nbytes, cand)
+        if learned is not None:
+            return learned[0], learned[1], chain
+        chain.append(f"learned: no modeled data for {coll}@{nbytes}B "
+                     "(falling through to static chain)")
+    elif source and source != "static":
+        raise ValueError(f"coll_xla_rules is {source!r} "
+                         "(want 'learned', 'static' or empty)")
     if platform == "cpu":
         # sweep-derived (BENCH_SWEEP_cpu_8dev.json): dense alltoall
         # staged wins 1KB-16MB/rank on the CPU fabric; all else native
@@ -217,8 +245,6 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
     if pick not in allowed:
         pick = "native"
     reason = f"default:platform={platform}"
-    quant_off = qvar in ("0", "off", "false", "no")
-    floor = int(_var.get("coll_quant_min_bytes", 1 << 20))
     for c, mn, mb, mode in rules:
         if c != coll or ndev < mn or nbytes < mb:
             continue
@@ -360,12 +386,17 @@ class XlaModule(CollModule):
         if spc is not None:
             spc.inc(f"coll_arm_{arm}_count")
             spc.inc("coll_wire_bytes", wire)
-        from .. import health
+        from .. import health, perf
         if health.enabled:
             # fold the decided arm into the in-flight entry's signature —
             # the last field of the flight-recorder hash (op, dtype,
             # count, reduction, arm)
             health.note_arm(arm)
+        if perf.enabled:
+            # annotate the in-flight timing entry (coll/framework's
+            # dispatch wrapper) with the executed arm + audited per-rank
+            # wire bytes; only annotated samples fold into the model
+            perf.note_arm(arm, nbytes=wire, ndev=self.dc.n)
         if trace.enabled:
             bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
             ctx = getattr(self._comm, "ctx", None)

@@ -17,6 +17,7 @@ collectives" recipe.
 from __future__ import annotations
 
 import functools
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, Optional, Tuple
 
@@ -549,9 +550,30 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
         # for the shifted targets — GSPMD reshards activations onto sp at
         # the ring-attention boundary)
         data_spec = P("dp" if "dp" in mesh.axis_names else None, None)
-        step = jax.jit(step, in_shardings=(None, None,
-                                           NamedSharding(mesh, data_spec)),
-                       donate_argnums=(0, 1))
+        jstep = jax.jit(step, in_shardings=(None, None,
+                                            NamedSharding(mesh, data_spec)),
+                        donate_argnums=(0, 1))
     else:
-        step = jax.jit(step, donate_argnums=(0, 1))
-    return init_opt, step
+        jstep = jax.jit(step, donate_argnums=(0, 1))
+
+    fpt = train_flops_per_token(cfg)
+
+    def timed_step(params, opt_state, tokens):
+        from .. import perf
+        if not perf.enabled or isinstance(tokens, jax.core.Tracer):
+            return jstep(params, opt_state, tokens)
+        # goodput/MFU ledger: blocked wall per step. Only wall + token
+        # FLOPs are measurable from one blocked call — the comm split
+        # (exposed vs total) comes from the bench goodput probe's
+        # unsynced-floor methodology, never fabricated here.
+        t0 = time.perf_counter()
+        out = jstep(params, opt_state, tokens)
+        jax.block_until_ready(out)
+        perf.record_step(time.perf_counter() - t0,
+                         tokens=tokens.shape[0] * max(tokens.shape[1] - 1,
+                                                      1),
+                         flops_per_token=fpt,
+                         peak_tflops=perf.peak_tflops())
+        return out
+
+    return init_opt, timed_step

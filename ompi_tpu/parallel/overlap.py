@@ -301,8 +301,17 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
             # the sync inlines into the caller's program
             return inner(params, batch)
         t0 = time.perf_counter()
-        loss, grads = inner(params, batch)
-        jax.block_until_ready(grads)
+        try:
+            loss, grads = inner(params, batch)
+            jax.block_until_ready(grads)
+        except BaseException:
+            # a raising sync (revoked comm, watchdog timeout) still
+            # closes its span, tagged error — never open-ended, never a
+            # latency sample for the perf cost model
+            trace.record_span(
+                "grad_sync:run", "overlap", t0, time.perf_counter(),
+                args={"mode": mode, "ndev": n, "status": "error"})
+            raise
         t1 = time.perf_counter()
         trace.record_span(
             "grad_sync:run", "overlap", t0, t1,
@@ -322,7 +331,8 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
                     "grad_sync:bucket", "overlap-buckets",
                     t0 + i * per, t0 + (i + 1) * per,
                     args={"bucket": i, "synthetic": True, "arm": arm,
-                          "nbytes": b.nbytes, "leaves": len(b.indices)})
+                          "nbytes": b.nbytes, "ndev": n,
+                          "leaves": len(b.indices)})
         return loss, grads
 
     return vg

@@ -59,6 +59,14 @@ COUNTERS = [
     ("health_inflight_max_age_us", "age of the oldest in-flight operation"),
     ("health_desync_detected",
      "peers the desync sentinel caught calling a different collective"),
+    # continuous performance plane (fed by ompi_tpu/perf; process-wide)
+    ("perf_regressions",
+     "sentry trips: sustained busbw/goodput shortfall vs the ledger"),
+    ("perf_goodput_pct",
+     "EWMA step goodput (compute share of wall time, percent)"),
+    ("perf_mfu_pct", "EWMA model-FLOPs utilization, percent"),
+    ("perf_ledger_buckets",
+     "(coll, arm, size-bucket) cells held by the learned cost model"),
 ]
 
 
@@ -92,17 +100,23 @@ class Counters:
             from . import health
             if name in health.PVARS:
                 return health.pvar_value(name)
+        if name.startswith("perf_"):
+            from . import perf
+            if name in perf.PVARS:
+                return perf.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
         out = dict(self._v)
-        from . import health, trace
+        from . import health, perf, trace
         from .parallel import overlap
         out["trace_dropped_events"] = trace.dropped_events()
         out["grad_bucket_count"] = overlap.pvar_value("grad_bucket_count")
         out["grad_bucket_bytes"] = overlap.pvar_value("grad_bucket_bytes")
         for name in health.PVARS:
             out[name] = health.pvar_value(name)
+        for name in perf.PVARS:
+            out[name] = perf.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

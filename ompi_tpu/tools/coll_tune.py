@@ -380,15 +380,22 @@ def run_device_sweep(iters: int, sizes=None):
 
 
 def emit_device_rules(winners: dict, path: str,
-                      platform: str = "unknown") -> None:
+                      platform: str = "unknown",
+                      provenance: str = None) -> None:
     """Winners → a coll/xla dynamic-rules file: one line per mode change
     walking sizes ascending (rules apply at >= min_bytes, later lines win,
     matching _load_device_rules/_mode semantics). The header records the
     fabric that produced the numbers — a cpu-derived ruleset applied on a
-    real TPU would override the correct native-always platform default."""
+    real TPU would override the correct native-always platform default.
+    ``provenance`` (a ``# learned from PERF_LEDGER ...`` line) is kept in
+    the header so a ledger-derived file stays distinguishable from a
+    sweep-measured one across re-emits (rules_provenance round-trips it)."""
     lines = [f"# device decision rules measured by coll_tune --device "
              f"on platform={platform}",
              "# <coll> <min_ndev> <min_bytes> <native|staged|quant>"]
+    if provenance:
+        lines.insert(1, provenance if provenance.startswith("#")
+                     else f"# {provenance}")
     for coll, by_size in winners.items():
         prev = None
         for nbytes in sorted(by_size):
@@ -402,6 +409,45 @@ def emit_device_rules(winners: dict, path: str,
                 prev = mode
     with open(path, "w") as fh:
         fh.write("\n".join(lines) + "\n")
+
+
+_PROVENANCE_TAG = "# learned from PERF_LEDGER"
+
+
+def rules_provenance(path: str):
+    """The ``# learned from PERF_LEDGER <path>`` header line of a rules
+    file, or None for a sweep-measured file. The loader side
+    (coll/xla._load_device_rules) skips every comment, so a
+    ledger-derived file parses identically — this accessor is how the
+    provenance ROUND-TRIPS: read it here, hand it back to
+    emit_device_rules, and the re-emitted file carries the same line."""
+    with open(path) as fh:
+        for line in fh:
+            if line.strip().startswith(_PROVENANCE_TAG):
+                return line.strip()
+    return None
+
+
+def emit_learned_rules(ledger_path: str, out_path: str,
+                       min_count: int = 1) -> dict:
+    """--from-ledger: render the perf cost model's measured crossovers
+    (best modeled busbw per (coll, log2-size-bucket)) into
+    DEVICE_RULES-compatible rows, provenance-tagged, so static-rules
+    deployments inherit learned crossovers without opting into
+    coll_xla_rules="learned". Returns the winners dict that was emitted."""
+    from ..perf.model import CostModel, load_ledger_doc
+
+    m = CostModel()
+    ledger = load_ledger_doc(ledger_path)
+    m.load_json(ledger.get("buckets", {}))
+    winners: dict = {}
+    for coll, rows in m.crossovers(min_count=min_count).items():
+        for bucket_bytes, arm in rows:
+            winners.setdefault(coll, {})[bucket_bytes] = arm
+    emit_device_rules(winners, out_path,
+                      platform=str(ledger.get("platform") or "unknown"),
+                      provenance=f"{_PROVENANCE_TAG} {ledger_path}")
+    return winners
 
 
 def explain_rules(rules_path: str, winners: dict, quiet: bool = False):
@@ -476,7 +522,13 @@ def main(argv=None) -> int:
     ap.add_argument("--device", action="store_true",
                     help="Sweep the DEVICE path (native ICI vs staged "
                          "host) and emit coll/xla decision rules.")
-    ap.add_argument("--device-rules-out", default="DEVICE_RULES.txt")
+    ap.add_argument("--device-rules-out", default=None)
+    ap.add_argument("--from-ledger", default=None, metavar="LEDGER.json",
+                    help="Render a PERF_LEDGER (ompi_tpu/perf cost "
+                         "model) into DEVICE_RULES-compatible rows with "
+                         "a provenance comment; no sweep is run. "
+                         "Writes --device-rules-out (default "
+                         "DEVICE_RULES_learned.txt).")
     ap.add_argument("--platform", default=None,
                     help="Force a jax platform (e.g. cpu). Uses "
                          "jax.config, NOT the JAX_PLATFORMS env var — "
@@ -488,6 +540,17 @@ def main(argv=None) -> int:
     if args.platform and not args.device:
         ap.error("--platform only applies to --device (the host sweep "
                  "never initializes jax)")
+
+    if args.from_ledger:
+        out = args.device_rules_out or "DEVICE_RULES_learned.txt"
+        winners = emit_learned_rules(args.from_ledger, out)
+        n_rules = sum(len(v) for v in winners.values())
+        print(f"wrote {out}: {n_rules} learned crossover(s) over "
+              f"{len(winners)} collective(s) from {args.from_ledger}")
+        if not winners:
+            print("ledger holds no modeled cells — emitted a header-only "
+                  "rules file")
+        return 0
 
     if args.device:
         if args.platform == "cpu" and "host_platform_device_count" \
@@ -505,6 +568,7 @@ def main(argv=None) -> int:
 
         rows, winners = run_device_sweep(args.iters)
         platform = jax.devices()[0].platform
+        args.device_rules_out = args.device_rules_out or "DEVICE_RULES.txt"
         emit_device_rules(winners, args.device_rules_out,
                           platform=platform)
         out = {"ndev": len(jax.devices()), "iters": args.iters,

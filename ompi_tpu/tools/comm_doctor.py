@@ -166,6 +166,59 @@ def build_health_report(
     }
 
 
+def build_perf_report(
+        ledger_path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the continuous performance
+    plane: the cost-model table (per coll/arm/size-bucket busbw + sample
+    counts), the current goodput/MFU snapshot, and any active
+    perf_regression verdicts. ``ledger_path`` loads a banked
+    PERF_LEDGER first (the CLI usually runs in a fresh process, where
+    the ledger file IS the state); live in-process state composes on
+    top when present."""
+    from .. import perf
+
+    if ledger_path:
+        perf.load_ledger(ledger_path)
+    rep = perf.report()
+    lines: List[str] = []
+    w = lines.append
+    src = f" (ledger: {ledger_path})" if ledger_path else ""
+    w(f"perf plane: {len(rep['model'])} modeled cell(s), "
+      f"{rep['baseline_keys']} sentry baseline(s){src}")
+    if rep["model"]:
+        w(f"  {'coll':22s} {'arm':7s} {'bucket':>10s} {'n':>5s} "
+          f"{'busbw p50':>10s} {'p95':>8s} {'ewma':>8s} {'lat p50':>9s}")
+        for row in rep["model"]:
+            w(f"  {row['coll']:22s} {row['arm']:7s} "
+              f"{row['bucket_bytes']:>9d}B {row['count']:5d} "
+              f"{row['busbw_GBps_p50']:>10.3f} {row['busbw_GBps_p95']:>8.3f} "
+              f"{row['busbw_GBps_ewma']:>8.3f} {row['lat_us_p50']:>8.1f}u")
+    gp = rep["goodput"]
+    if gp["steps"]:
+        w(f"  goodput: {gp['goodput_pct']}% of wall is compute "
+          f"(MFU {gp['mfu_pct']}%, overlap eff "
+          f"{gp['overlap_efficiency']}) over {gp['steps']} step(s)")
+    else:
+        w("  goodput: no steps recorded")
+    if rep["verdicts"]:
+        w(f"  PERF REGRESSION: {rep['regressions']} sentry trip(s):")
+        for v in rep["verdicts"][-8:]:
+            what = (f"{v['coll']} {v['arm']} @{v['bucket_bytes']}B "
+                    f"busbw {v['busbw_GBps']} GB/s"
+                    if "coll" in v else
+                    f"goodput {v.get('goodput_pct')}%")
+            w(f"    {what} vs baseline p50 {v['baseline_p50']} "
+              f"(z={v['z']}, {v['sustained']} consecutive)")
+    elif rep["baseline_keys"]:
+        w("  no perf regressions vs the loaded baseline")
+    return "\n".join(lines), rep
+
+
+def _default_ledger() -> Optional[str]:
+    hits = sorted(glob.glob("PERF_LEDGER_*.json"))
+    return hits[0] if hits else None
+
+
 def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         prog="comm_doctor",
@@ -191,6 +244,15 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "the in-flight table and desync verdict, and "
                          "merges the trace halves through the normal "
                          "pipeline")
+    ap.add_argument("--perf", action="store_true",
+                    help="render the continuous-performance-plane "
+                         "section: cost-model table, goodput/MFU, "
+                         "active perf_regression verdicts (loads "
+                         "--ledger, or the first PERF_LEDGER_*.json "
+                         "in the working directory)")
+    ap.add_argument("--ledger", default=None, metavar="PERF_LEDGER.json",
+                    help="PERF_LEDGER file for --perf (default: "
+                         "autodetect PERF_LEDGER_*.json)")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -226,6 +288,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         tl = _merge.merge(_merge.load_chrome(traces)) if traces else None
         return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
+        if ns.perf:
+            return _report(None, ns)     # perf section standalone
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
         return 2
@@ -245,6 +309,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
     if health is not None:
         text = (health[0] + "\n" + text) if text else health[0]
         data["health"] = health[1]
+    if getattr(ns, "perf", False):
+        ptext, pdata = build_perf_report(ns.ledger or _default_ledger())
+        text = (text + "\n" + ptext) if text else ptext
+        data["perf"] = pdata
     if ns.as_json:
         if ns.merged_out:
             data["merged_chrome_trace"] = ns.merged_out

@@ -168,17 +168,39 @@ def instant(name: str, cat: str = "event", rank: int = 0,
            "rank": int(rank), "args": args or {}})
 
 
+# One downstream consumer may register for span completions (the perf
+# cost model ingests grad_sync bucket spans this way).  A sink failure
+# must never take down the traced operation itself.
+_span_sink = None
+
+
+def set_span_sink(fn) -> None:
+    """Register ``fn(name, cat, t_begin, t_end, args)`` to observe every
+    recorded span (None unregisters)."""
+    global _span_sink
+    _span_sink = fn
+
+
 def record_span(name: str, cat: str, t_begin: float, t_end: float,
                 rank: int = 0, args: Optional[dict] = None) -> None:
     """Record an already-timed complete span (perf_counter() endpoints)."""
     _emit({"name": name, "cat": cat, "ph": "X", "t": t_begin,
            "dur": max(0.0, t_end - t_begin), "rank": int(rank),
            "args": args or {}})
+    if _span_sink is not None:
+        try:
+            _span_sink(name, cat, t_begin, t_end, args)
+        except Exception:
+            pass
 
 
 class span:
     """Context manager recording one complete span on exit.  Construct it
-    only behind a ``trace.enabled`` check — building ``args`` is the cost."""
+    only behind a ``trace.enabled`` check — building ``args`` is the cost.
+    A body that raises still closes the span, tagged ``status=error`` —
+    downstream consumers (the perf cost model) must never mistake a
+    stalled-then-raised collective (e.g. WatchdogTimeoutError) for a
+    latency sample."""
 
     __slots__ = ("name", "cat", "rank", "args", "_begin")
 
@@ -191,8 +213,12 @@ class span:
         return self
 
     def __exit__(self, *exc: Any) -> bool:
+        args = self.args
+        if exc and exc[0] is not None:
+            args = dict(args or {})
+            args["status"] = "error"
         record_span(self.name, self.cat, self._begin, time.perf_counter(),
-                    self.rank, self.args)
+                    self.rank, args)
         return False
 
 

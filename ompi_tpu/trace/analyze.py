@@ -38,7 +38,8 @@ from .merge import FleetTimeline
 # reasons that legitimately override a matching rules row — seeing one of
 # these with a non-rule arm is policy, not drift (coll/xla.decide_mode's
 # precedence chain; docs/observability.md reason grammar)
-_VETO_PREFIXES = ("force:", "blanket:", "floor:", "off:", "ineligible:")
+_VETO_PREFIXES = ("force:", "blanket:", "floor:", "off:", "ineligible:",
+                  "learned:")
 
 
 def _percentiles(xs: Sequence[float]) -> Dict[str, float]:
