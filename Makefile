@@ -6,15 +6,17 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
-	health-tests perf-tests bench-compare
+	health-tests perf-tests traffic-tests bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
 # watchdog must attribute within 2x its timeout) guards the tier the
 # rest of the run leans on when something hangs; the perf-plane gate
 # rides along — its suite is also seconds-cheap and its probe banks the
-# trajectory artifact bench-compare diffs against
-tier1: health-tests perf-tests
+# trajectory artifact bench-compare diffs against; the traffic-plane
+# gate closes the loop — its probe injects a skewed ppermute an 8-dev
+# fleet's matrix must attribute to the exact hot edge, conservation held
+tier1: health-tests perf-tests traffic-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -62,6 +64,16 @@ perf-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_perf.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --goodput
+
+# the topology-traffic tier: per-edge attribution + ICI/DCN plane
+# ledger + hot-link sentry suite, then the end-to-end probe (uniform
+# ring background plus a skewed push_row lane the sentry must trip on
+# EXACTLY once, naming (src, dst); banks TRAFFIC_<platform>.json; exits
+# nonzero on any conservation residue)
+traffic-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_traffic.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --traffic
 
 # regression gate over the banked trajectory artifact: non-zero exit
 # names every phase whose busbw/goodput/MFU column lost >10% (run it

@@ -1742,6 +1742,124 @@ def run_goodput_probe(platform: str) -> None:
         trace.disable()
 
 
+def run_traffic_probe(platform: str) -> None:
+    """--traffic: end-to-end acceptance for the topology traffic plane.
+    On an 8-device ring, runs a uniform collective background (allreduce
+    + allgather, forced native so every byte rides mesh edges) and then
+    injects a skewed ppermute pattern — 32 push_row hops onto the one
+    (2 -> 5) link.  The plane must attribute the injected hot edge
+    (exactly ONE traffic_hotlink sentry trip naming (2, 5)) and the
+    conservation invariant must hold across the whole probe: per-edge
+    bytes sum to the coll_wire_bytes pvar with
+    traffic_unattributed_bytes == 0.  Banks TRAFFIC_<platform>.json
+    with the per-plane rollups; exits non-zero on any miss."""
+    import jax
+
+    from ompi_tpu import runtime, trace, traffic
+    from ompi_tpu.core import var
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"traffic probe: needs 8 devices, have {ndev}")
+
+    var.registry.set_cli("traffic_enabled", "true")
+    # pin the native arm: staged bytes would land in the 'host' plane
+    # and the probe's invariant is edge-sum == coll_wire_bytes exactly
+    var.registry.set_cli("coll_xla_mode", "native")
+    var.registry.reset_cache()
+    traffic.reset()
+    traffic.enable()
+    trace.enable()
+    try:
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": 8}), "x")
+            d = c.device_comm
+            x = d.from_ranks([np.ones(4096, np.float32)] * 8)
+            for _ in range(4):           # uniform ring background
+                c.coll.allreduce(c, x)
+                c.coll.allgather(c, x)
+            # the injected skew: hammer the one (2 -> 5) link
+            hot = d.from_ranks([np.ones(16384, np.float32)] * 8)
+            for _ in range(32):
+                hot = d.push_row(hot, 2, 5)
+            jax.block_until_ready(hot)
+            snap = ctx.spc.snapshot()
+            return {k: int(snap[k]) for k in
+                    ("coll_wire_bytes", "traffic_attributed_bytes",
+                     "traffic_unattributed_bytes",
+                     "traffic_hotlink_trips", "traffic_edge_count")}
+
+        res = runtime.run_ranks(1, fn)[0]
+        rep = traffic.report()
+        verdicts = [v for v in rep["verdicts"]
+                    if v.get("kind") == "hotlink"]
+        hot_events = [e for e in trace.events()
+                      if e.get("name") == "traffic_hotlink"]
+        edge_sum = sum(e["bytes"] for e in rep["edges"])
+        host_b = int(rep["planes"].get("host", 0))
+        doc = {
+            "metric": "traffic_hotlink_attribution",
+            "value": res["traffic_hotlink_trips"],
+            "unit": "hot-link sentry trips (must be exactly 1)",
+            "platform": platform, "ndev": ndev,
+            "hot_edge": ({"src": verdicts[0]["src"],
+                          "dst": verdicts[0]["dst"],
+                          "bytes": verdicts[0]["bytes"],
+                          "ratio": verdicts[0]["ratio"]}
+                         if verdicts else None),
+            "conservation": {
+                "coll_wire_bytes": res["coll_wire_bytes"],
+                "attributed_bytes": res["traffic_attributed_bytes"],
+                "edge_bytes_sum": edge_sum,
+                "host_plane_bytes": host_b,
+                "unattributed_bytes": res["traffic_unattributed_bytes"],
+            },
+            "planes": rep["planes"],
+            "per_coll": rep["per_coll"],
+            "edge_count": res["traffic_edge_count"],
+            "hotlink_trace_events": len(hot_events),
+            "traffic": rep,
+        }
+        with open(os.path.join(here, f"TRAFFIC_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k != "traffic"}), flush=True)
+
+        if res["traffic_hotlink_trips"] != 1 or len(verdicts) != 1:
+            raise SystemExit(
+                "traffic probe: expected exactly one hotlink trip, got "
+                f"{res['traffic_hotlink_trips']} "
+                f"({len(verdicts)} verdict(s))")
+        if (verdicts[0]["src"], verdicts[0]["dst"]) != (2, 5):
+            raise SystemExit(
+                "traffic probe: sentry named edge "
+                f"({verdicts[0]['src']}, {verdicts[0]['dst']}), the "
+                "injected hot link is (2, 5)")
+        if not hot_events:
+            raise SystemExit("traffic probe: no traffic_hotlink trace "
+                             "instant emitted")
+        if res["traffic_unattributed_bytes"] != 0:
+            raise SystemExit(
+                "traffic probe: conservation breach — "
+                f"{res['traffic_unattributed_bytes']} unattributed "
+                "byte(s)")
+        if edge_sum + host_b != res["coll_wire_bytes"]:
+            raise SystemExit(
+                "traffic probe: conservation breach — edge sum "
+                f"{edge_sum} (+{host_b} host) != coll_wire_bytes "
+                f"{res['coll_wire_bytes']}")
+    finally:
+        var.registry.clear_cli("traffic_enabled")
+        var.registry.clear_cli("coll_xla_mode")
+        var.registry.reset_cache()
+        traffic.disable()
+        trace.disable()
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--compare" in argv:
@@ -1778,6 +1896,9 @@ def main() -> None:
             return
         if "--goodput" in sys.argv[1:]:
             run_goodput_probe(platform)
+            return
+        if "--traffic" in sys.argv[1:]:
+            run_traffic_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

@@ -314,7 +314,7 @@ class XlaModule(CollModule):
     _ALL_ARMS = ("native", "staged", "quant")
 
     def _mode(self, coll: str, x, op: Op = None,
-              allowed=_ALL_ARMS) -> str:
+              allowed=_ALL_ARMS, weights=None) -> str:
         """Pick per (collective, PER-RANK bytes, dtype) — the unit the
         sweep measures and the rules file records (a canonical array's
         row 0 is one rank's buffer), so thresholds line up with the
@@ -329,7 +329,7 @@ class XlaModule(CollModule):
         funnels through here exactly once: one decision-audit record per
         collective."""
         pick, reason, chain = self._decide(coll, x, op, allowed)
-        self._audit(coll, x, op, pick, reason, chain)
+        self._audit(coll, x, op, pick, reason, chain, weights=weights)
         return pick
 
     def _decide(self, coll: str, x, op: Op, allowed) -> tuple:
@@ -348,7 +348,7 @@ class XlaModule(CollModule):
                    "allgather": "allgather"}
 
     def _audit(self, coll: str, x, op: Op, arm: str, reason: str,
-               chain: list) -> None:
+               chain: list, weights=None) -> None:
         """ONE decision-audit record per device-dispatched collective.
         Always: the arm-count + wire-byte pvars (plain dict adds, same
         cost class as every other SPC site) and the monitoring wire-byte
@@ -397,6 +397,11 @@ class XlaModule(CollModule):
             # dispatch wrapper) with the executed arm + audited per-rank
             # wire bytes; only annotated samples fold into the model
             perf.note_arm(arm, nbytes=wire, ndev=self.dc.n)
+        from .. import traffic
+        if traffic.enabled:
+            # per-edge attribution of the SAME wire figure the pvar just
+            # banked — the conservation invariant's other half
+            traffic.note_coll(self.dc, coll, arm, wire, weights=weights)
         if trace.enabled:
             bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
             ctx = getattr(self._comm, "ctx", None)
@@ -745,7 +750,7 @@ class XlaModule(CollModule):
             # 3-D shape (L == R, indistinguishable from padded blocks)
             # keeps the block interpretation below.
             self._check_recvcounts(C, recvcounts)
-            if self._mode("alltoallv", sendbuf) == "staged":
+            if self._mode("alltoallv", sendbuf, weights=C) == "staged":
                 h = self._stage_out(sendbuf)           # (R, L, *e)
                 out_cap = self.dc._bucket(
                     int(C.sum(axis=0).max()) if C.size else 1)
@@ -759,7 +764,7 @@ class XlaModule(CollModule):
                 and sendbuf.shape[0] == sendbuf.shape[1] == C.shape[0]
                 and sendbuf.shape[2] >= int(C.max())):
             self._check_recvcounts(C, recvcounts)
-            if self._mode("alltoallv", sendbuf) == "staged":
+            if self._mode("alltoallv", sendbuf, weights=C) == "staged":
                 h = self._stage_out(sendbuf)       # (R, R, cap, *e)
                 out_cap = self.dc._bucket(
                     int(C.sum(axis=0).max()) if h.shape[0] else 1)

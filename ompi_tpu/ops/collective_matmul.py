@@ -146,6 +146,14 @@ def allgather_matmul(x: jax.Array, w: jax.Array, mesh: Mesh, axis: str,
             f"bidirectional ring needs an even per-rank row count, got "
             f"m={m} over {n} ranks (m_local={m // n})")
     w_spec = P(None, w_sharded_axis)
+    from .. import traffic
+    if traffic.enabled and not isinstance(x, jax.core.Tracer):
+        # each rank's x shard makes n-1 ring hops; direction follows the
+        # schedule actually lowered (collmm decision's reverse/bidir)
+        traffic.note_ring(
+            mesh, axis, (n - 1) * x.nbytes // max(n, 1),
+            "allgather_matmul",
+            "bidir" if bidirectional else ("rev" if reverse else "fwd"))
     return _build_allgather_matmul(mesh, axis, w_spec, bool(reverse),
                                    bool(bidirectional), batch_axis,
                                    x.ndim)(x, w)
@@ -249,5 +257,19 @@ def matmul_reduce_scatter(x: jax.Array, w: jax.Array, mesh: Mesh,
         raise ValueError(
             f"bidirectional ring needs an even per-rank row count, got "
             f"m={m} over {n} ranks (m_local={m // n})")
+    from .. import traffic
+    if traffic.enabled and not isinstance(x, jax.core.Tracer):
+        import numpy as np
+        # the ring carries (m/n, n_cols) partial-sum blocks in the
+        # promoted output dtype for n-1 hops per rank
+        odt = np.promote_types(x.dtype, w.dtype)
+        batch = x.shape[0] if x.ndim == 3 else 1
+        if batch_axis is not None:
+            batch //= max(mesh.shape[batch_axis], 1)
+        traffic.note_ring(
+            mesh, axis,
+            (n - 1) * (m // max(n, 1)) * batch * w.shape[-1]
+            * odt.itemsize,
+            "matmul_reduce_scatter", "bidir" if bidirectional else "fwd")
     return _build_matmul_rs(mesh, axis, bool(bidirectional), batch_axis,
                             x.ndim)(x, w)

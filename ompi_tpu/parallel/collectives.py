@@ -454,6 +454,30 @@ class DeviceComm:
                     return jnp.concatenate([a, b], axis=0)
             return self._shard_map(inner, self._spec, self._spec)
 
+        from .. import traffic
+        if traffic.enabled and not isinstance(x, jax.core.Tracer):
+            # charge the same static perms `build` lowers to; per-rank
+            # bytes, and note_ppermute banks the matching coll_wire_bytes
+            row = x.nbytes // max(R, 1)
+            if r == 1:
+                traffic.note_ppermute(
+                    self.mesh, self.axis,
+                    [(i, (i + shift) % self.n) for i in range(self.n)],
+                    row, spc=self.spc, coll="ring_shift")
+            else:
+                s = shift % R
+                off = (-s) % r
+                q = (-s - off) // r
+                n = self.n
+                traffic.note_ppermute(
+                    self.mesh, self.axis,
+                    [((d + q) % n, d) for d in range(n)],
+                    (r - off) * row, spc=self.spc, coll="ring_shift")
+                if off:
+                    traffic.note_ppermute(
+                        self.mesh, self.axis,
+                        [((d + q + 1) % n, d) for d in range(n)],
+                        off * row, spc=self.spc, coll="ring_shift")
         return self._compiled(key, build)(x)
 
     # -- cartesian neighborhood exchange (halo / stencil) -------------------
@@ -681,6 +705,16 @@ class DeviceComm:
                 return jnp.where(i == dst_dev, updated, xs)
             return self._shard_map(inner, self._spec, self._spec)
 
+        from .. import traffic
+        if traffic.enabled and not isinstance(x, jax.core.Tracer):
+            src_dev = int(src) // r
+            dst_dev = int(dst) // r
+            if src_dev != dst_dev:
+                # exactly one row crosses ICI (the [(src_dev, dst_dev)]
+                # perm inner lowers to)
+                traffic.note_ppermute(
+                    self.mesh, self.axis, [(src_dev, dst_dev)],
+                    x.nbytes // max(R, 1), spc=self.spc, coll="push_row")
         return self._compiled(key, build)(x)
 
     def scan(self, x: jax.Array, op: Op = SUM, exclusive: bool = False

@@ -23,6 +23,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..jaxcompat import shard_map
 from .mesh import classify_axes
 
+# classify_axes is re-exported here as the PUBLIC topology-inference
+# entry point: the traffic plane (traffic/planes.py) and auto_levels
+# both key off the same ICI/DCN axis split, so there is exactly one
+# implementation to pin in tests.
+__all__ = ["classify_axes", "hierarchical_psum",
+           "hierarchical_allreduce", "auto_levels"]
+
 
 def hierarchical_psum(x, inner: str, outer: str):
     """For use inside shard_map: reduce-scatter over `inner`, psum over
@@ -46,6 +53,15 @@ def hierarchical_allreduce(x: jax.Array, mesh: Mesh, inner: str, outer: str
         flat = xs.reshape(xs.shape[2:])
         out = hierarchical_psum(flat, inner, outer)
         return out[None, None]
+
+    from .. import traffic
+    if traffic.enabled and not isinstance(x, jax.core.Tracer):
+        # inner RS/AG rings + the outer ring on the scattered 1/n_inner
+        # fraction — the per-plane rollup shows the HAN bandwidth shape
+        ni = mesh.devices.shape[mesh.axis_names.index(inner)]
+        no = mesh.devices.shape[mesh.axis_names.index(outer)]
+        traffic.note_hierarchical(mesh, inner, outer,
+                                  x.nbytes // max(ni * no, 1))
 
     fn = jax.jit(shard_map(local, mesh=mesh, in_specs=spec,
                            out_specs=spec))

@@ -62,16 +62,19 @@ def axis_index_of(mesh: Mesh, axis: str, device) -> int:
 
 def classify_axes(mesh: Mesh) -> Dict[str, str]:
     """Classify each axis as 'ici' (within a process/slice) or 'dcn'
-    (crosses process boundaries) — the han intra/inter split. On CPU test
-    meshes everything is 'ici'."""
+    (crosses process boundaries) — the han intra/inter split. An axis is
+    'dcn' when moving along it changes the process index on ANY line of
+    the mesh, not just the first one (the old first-line probe missed
+    meshes whose process boundary only shows up at nonzero coordinates
+    of the other axes). On CPU test meshes everything is 'ici'."""
     out = {}
-    devs = mesh.devices
+    devs = np.asarray(mesh.devices)
+    procs = np.frompyfunc(
+        lambda d: int(getattr(d, "process_index", 0)), 1, 1)(
+        devs).astype(np.int64)
     for i, name in enumerate(mesh.axis_names):
-        sl = [slice(0, 1)] * devs.ndim
-        sl[i] = slice(None)
-        line = devs[tuple(sl)].reshape(-1)
-        procs = {getattr(d, "process_index", 0) for d in line}
-        out[name] = "dcn" if len(procs) > 1 else "ici"
+        moved = np.moveaxis(procs, i, 0)
+        out[name] = "dcn" if bool((moved != moved[:1]).any()) else "ici"
     return out
 
 

@@ -295,11 +295,26 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
     inner = shard_map(local, mesh=mesh, in_specs=(P(), data_spec),
                       out_specs=(P(), P()))
 
+    def _note_traffic(grads):
+        # dp ring-allreduce model of the sync: 2(n-1)/n x grad bytes per
+        # rank (the bucketed arm's quant buckets send less — the matrix
+        # keeps the native-wire convention the busbw factors use)
+        from .. import traffic
+        if not traffic.enabled or mode == "unsynced" or n < 2:
+            return
+        tot = sum(g.nbytes for g in jax.tree_util.tree_leaves(grads))
+        traffic.note_ring(mesh, "dp", 2 * (n - 1) * tot // n,
+                          "grad_sync")
+
     def vg(params, batch):
-        if not trace.enabled or isinstance(batch, jax.core.Tracer):
-            # under an outer jit/grad trace there is nothing to time:
-            # the sync inlines into the caller's program
+        if isinstance(batch, jax.core.Tracer):
+            # under an outer jit/grad trace there is nothing to time or
+            # attribute: the sync inlines into the caller's program
             return inner(params, batch)
+        if not trace.enabled:
+            loss, grads = inner(params, batch)
+            _note_traffic(grads)
+            return loss, grads
         t0 = time.perf_counter()
         try:
             loss, grads = inner(params, batch)
@@ -333,6 +348,7 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
                     args={"bucket": i, "synthetic": True, "arm": arm,
                           "nbytes": b.nbytes, "ndev": n,
                           "leaves": len(b.indices)})
+        _note_traffic(grads)
         return loss, grads
 
     return vg

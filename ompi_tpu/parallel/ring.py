@@ -73,6 +73,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     """
     if scale is None:
         scale = 1.0 / np.sqrt(q.shape[-1])
+    from .. import traffic
+    if traffic.enabled and not isinstance(q, jax.core.Tracer):
+        # all n ring steps rotate (the schedule permutes after the last
+        # block too): per-rank wire = n x its K/V shard = full K+V bytes
+        if mesh.shape[axis] > 1:
+            traffic.note_ring(mesh, axis, k.nbytes + v.nbytes,
+                              "ring_attention")
     return _build_ring(mesh, axis, bool(causal), float(scale),
                        batch_axis, head_axis, block_impl)(q, k, v)
 

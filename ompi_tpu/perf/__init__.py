@@ -139,6 +139,12 @@ def timed_coll(fn, comm, name: str, a: tuple, kw: dict):
         model.record(name, ent["arm"], ent["nbytes"], dur, ent["ndev"])
         sentry.observe_coll(name, ent["arm"], ent["nbytes"], dur,
                             ent["ndev"])
+        # plane-keyed cells next to the flat one (traffic plane's
+        # note_planes stash): best_arm("allreduce@ici", ...) and
+        # coll_tune --from-ledger answer per-plane for free
+        for plane, pb in (ent.get("planes") or {}).items():
+            model.record(f"{name}@{plane}", ent["arm"], int(pb), dur,
+                         ent["ndev"])
     return out
 
 
@@ -158,6 +164,21 @@ def note_arm(arm: str, nbytes: Optional[int] = None,
         ent["nbytes"] = int(nbytes)
     if ndev:
         ent["ndev"] = int(ndev)
+
+
+def note_planes(planes: Dict[str, int]) -> None:
+    """Called by the traffic plane right after note_arm: stash this
+    collective's per-plane byte split (ici/dcn) into the in-flight
+    timing entry so timed_coll can bank ``<coll>@<plane>`` cells with
+    the measured duration. The 'host' pseudo-plane never reaches here
+    (staged bytes cross no mesh link)."""
+    st = getattr(_tls, "stack", None)
+    if not st:
+        return
+    split = {p: int(b) for p, b in planes.items()
+             if p != "host" and int(b) > 0}
+    if split:
+        st[-1]["planes"] = split
 
 
 # ---- sample source 2: the trace span sink ----------------------------

@@ -38,7 +38,8 @@ def busbw_GBps(coll: str, nbytes: int, dur_s: float, ndev: int) -> float:
     """Effective bus bandwidth for one sample (0.0 when unmeasurable)."""
     if dur_s <= 0 or nbytes <= 0 or ndev < 2:
         return 0.0
-    f = _FACTOR.get(coll, lambda r: 1.0)(ndev)
+    # plane-keyed cells ("allreduce@ici") use the base coll's factor
+    f = _FACTOR.get(coll.split("@", 1)[0], lambda r: 1.0)(ndev)
     return f * nbytes / dur_s / 1e9
 
 

@@ -67,6 +67,17 @@ COUNTERS = [
     ("perf_mfu_pct", "EWMA model-FLOPs utilization, percent"),
     ("perf_ledger_buckets",
      "(coll, arm, size-bucket) cells held by the learned cost model"),
+    # topology traffic plane (fed by ompi_tpu/traffic; process-wide)
+    ("traffic_attributed_bytes",
+     "wire bytes placed on mesh edges / the host plane by the traffic "
+     "matrix"),
+    ("traffic_unattributed_bytes",
+     "wire bytes the traffic matrix could not place on any edge "
+     "(attribution bugs; 0 when the conservation invariant holds)"),
+    ("traffic_hotlink_trips",
+     "hot-link sentry trips (one directed edge carrying "
+     "disproportionate bytes)"),
+    ("traffic_edge_count", "directed mesh edges holding attributed bytes"),
 ]
 
 
@@ -104,11 +115,15 @@ class Counters:
             from . import perf
             if name in perf.PVARS:
                 return perf.pvar_value(name)
+        if name.startswith("traffic_"):
+            from . import traffic
+            if name in traffic.PVARS:
+                return traffic.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
         out = dict(self._v)
-        from . import health, perf, trace
+        from . import health, perf, trace, traffic
         from .parallel import overlap
         out["trace_dropped_events"] = trace.dropped_events()
         out["grad_bucket_count"] = overlap.pvar_value("grad_bucket_count")
@@ -117,6 +132,8 @@ class Counters:
             out[name] = health.pvar_value(name)
         for name in perf.PVARS:
             out[name] = perf.pvar_value(name)
+        for name in traffic.PVARS:
+            out[name] = traffic.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:
@@ -190,4 +207,8 @@ def export_prometheus(ctx, comm=None, prefix: str = "ompi_tpu") -> str:
         rows = mon.prometheus_rows(rank, comm=label, prefix=prefix)
         if rows:
             text += "\n".join(rows) + "\n"
+    from . import traffic
+    trows = traffic.prometheus_rows(rank, comm=label, prefix=prefix)
+    if trows:
+        text += "\n".join(trows) + "\n"
     return text

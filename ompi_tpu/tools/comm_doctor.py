@@ -29,6 +29,11 @@ from typing import Any, Dict, List, Optional, Tuple
 from ..trace import analyze as _an
 from ..trace import merge as _merge
 
+# bumped whenever any --json report mode changes shape; every mode
+# (default merge, --health-dump, --perf, --traffic, --live) emits it so
+# downstream tooling can detect drift (ISSUE 7 satellite)
+SCHEMA_VERSION = 2
+
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
                  z_thresh: float = 2.5) -> Tuple[str, Dict[str, Any]]:
@@ -214,6 +219,88 @@ def build_perf_report(
     return "\n".join(lines), rep
 
 
+# byte-intensity ramp for the edge heatmap (space = no traffic)
+_HEAT = " .:-=+*#%@"
+
+
+def build_traffic_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the topology traffic plane: the
+    per-edge byte matrix as an ASCII heatmap (meshes up to 16 devices),
+    the hottest edges, the ICI/DCN/host per-plane rollup, and the
+    hot-link sentry verdicts. ``path`` loads a banked TRAFFIC json
+    (bench.py --traffic); default reads the live in-process plane."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("traffic", rep)
+    else:
+        from .. import traffic
+        rep = traffic.report()
+    lines: List[str] = []
+    w = lines.append
+    edges = rep.get("edges") or []
+    planes = rep.get("planes") or {}
+    src = f" (from {path})" if path else ""
+    w(f"traffic plane: {len(edges)} directed edge(s), "
+      f"{int(rep.get('attributed_bytes', 0))} B attributed, "
+      f"{int(rep.get('unattributed_bytes', 0))} B unattributed{src}")
+    if rep.get("unattributed_bytes"):
+        w("  !! CONSERVATION BREACH: bytes placed on no edge — "
+          "attribution bug (see traffic_unattributed_bytes)")
+    if edges:
+        nodes = sorted({e["src"] for e in edges}
+                       | {e["dst"] for e in edges})
+        if max(nodes) < 16:
+            n = max(nodes) + 1
+            peak = max(e["bytes"] for e in edges)
+            grid = [[0] * n for _ in range(n)]
+            for e in edges:
+                grid[e["src"]][e["dst"]] = e["bytes"]
+            w(f"  edge heatmap (row=src, col=dst; peak {peak} B = "
+              f"'{_HEAT[-1]}'):")
+            w("       " + " ".join(f"{j:>2d}" for j in range(n)))
+            for i in range(n):
+                cells = []
+                for j in range(n):
+                    b = grid[i][j]
+                    g = (_HEAT[max(1, round(b / peak
+                                            * (len(_HEAT) - 1)))]
+                         if b else _HEAT[0])
+                    cells.append(f" {g} ")
+                w(f"    {i:>2d} " + "".join(cells).rstrip())
+        w("  hottest edges:")
+        for e in edges[:8]:
+            w(f"    {e['src']:3d} -> {e['dst']:3d} "
+              f"{e['bytes']:>14d} B  [{e['plane']}]")
+    if planes:
+        tot = sum(planes.values()) or 1
+        w("  per-plane rollup:")
+        for p, b in sorted(planes.items()):
+            w(f"    {p:5s} {int(b):>14d} B  {100.0 * b / tot:5.1f}%")
+    pc = rep.get("per_coll") or {}
+    if pc:
+        w("  per-collective attribution: " + ", ".join(
+            f"{k}={v}B" for k, v in
+            sorted(pc.items(), key=lambda kv: -kv[1])[:8]))
+    verd = rep.get("verdicts") or []
+    if verd:
+        w(f"  HOT LINK: {int(rep.get('hotlink_trips', 0))} sentry "
+          "trip(s):")
+        for v in verd[-8:]:
+            if v.get("kind") == "hotlink":
+                w(f"    edge {v['src']} -> {v['dst']} carries "
+                  f"{v['bytes']} B ({v['ratio']}x the median "
+                  f"{v['median_bytes']} B) [{v['plane']}]")
+            else:
+                w(f"    plane imbalance: {v['hot_plane']} mean/edge is "
+                  f"{v['ratio']}x the other plane "
+                  f"({v['mean_bytes']})")
+    elif edges:
+        w("  no hot-link verdicts")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -253,6 +340,13 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
     ap.add_argument("--ledger", default=None, metavar="PERF_LEDGER.json",
                     help="PERF_LEDGER file for --perf (default: "
                          "autodetect PERF_LEDGER_*.json)")
+    ap.add_argument("--traffic", nargs="?", const="", default=None,
+                    metavar="TRAFFIC.json",
+                    help="render the topology-traffic-plane section: "
+                         "per-edge ASCII heatmap, ICI/DCN rollup, "
+                         "hot-link verdicts. With a path, loads a "
+                         "banked TRAFFIC json (bench.py --traffic); "
+                         "bare flag reads the live in-process plane")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -288,8 +382,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         tl = _merge.merge(_merge.load_chrome(traces)) if traces else None
         return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
-        if ns.perf:
-            return _report(None, ns)     # perf section standalone
+        if ns.perf or ns.traffic is not None:
+            return _report(None, ns)   # perf/traffic section standalone
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
         return 2
@@ -313,6 +407,11 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         ptext, pdata = build_perf_report(ns.ledger or _default_ledger())
         text = (text + "\n" + ptext) if text else ptext
         data["perf"] = pdata
+    if getattr(ns, "traffic", None) is not None:
+        ttext, tdata = build_traffic_report(ns.traffic or None)
+        text = (text + "\n" + ttext) if text else ttext
+        data["traffic"] = tdata
+    data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:
             data["merged_chrome_trace"] = ns.merged_out

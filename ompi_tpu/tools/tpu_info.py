@@ -29,6 +29,8 @@ def main(argv=None) -> int:
     import ompi_tpu.p2p.selftrans  # noqa: F401
     import ompi_tpu.p2p.shm  # noqa: F401
     import ompi_tpu.p2p.tcp  # noqa: F401
+    import ompi_tpu.perf  # noqa: F401  (perf plane vars)
+    import ompi_tpu.traffic  # noqa: F401  (traffic plane vars)
     from ompi_tpu import mpit
     from ompi_tpu.core import var as _var
 

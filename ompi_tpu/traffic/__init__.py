@@ -1,0 +1,263 @@
+"""Topology traffic plane — per-link byte attribution over the mesh.
+
+The third observability plane (after health and perf): every audited
+collective completion is attributed to the directed mesh edges its
+algorithm geometry uses, classified into ICI vs DCN planes, and judged
+by a hot-link sentry. Three coupled pieces
+(docs/observability.md, "Topology traffic plane"):
+
+* ``matrix``  — per-edge byte aggregate; ring collectives spread the
+  audited per-rank wire bytes over the axis ring (honoring the decided
+  ring direction: native = forward, bidir = both half-rings),
+  all-to-all fills the bipartite block (alltoallv weighted by its
+  counts matrix), ppermute charges its explicit perm, hierarchical ops
+  split inner/outer, the staged arm rolls into the ``host`` plane.
+* ``planes``  — ICI/DCN edge classification (process boundaries, the
+  same inference as ``parallel.hierarchy.classify_axes``) + the
+  per-plane byte split handed to the perf cost model as plane-keyed
+  ``<coll>@<plane>`` cells.
+* ``sentry``  — hot links and plane imbalance, max/median with MAD
+  gating, one trip per episode; ``traffic_hotlink`` trace instant +
+  pvar.
+
+Ingestion sources (all behind ONE ``traffic.enabled`` attribute read,
+the same disabled-path bar as trace/health/perf):
+
+1. ``coll/xla._audit`` post-decision (``note_coll``) — the same call
+   that feeds ``coll_wire_bytes``, so the conservation invariant
+   ``sum(edge bytes) == coll_wire_bytes`` holds per attributed
+   collective; any residue lands in ``traffic_unattributed_bytes``
+   instead of vanishing.
+2. Eager DeviceComm ppermute primitives (``ring_shift``/``push_row``)
+   via ``note_ppermute`` — these also increment ``coll_wire_bytes`` so
+   the invariant spans p2p-style device traffic.
+3. Eager host wrappers with known ring schedules: collective-matmul
+   call sites (direction from the ``collmm`` decision), ring
+   attention, bucketed/perleaf grad sync, hierarchical allreduce
+   (inner/outer split). These are standalone helpers with no Context
+   — they feed the matrix and its internal ledger only.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import var as _var
+from .matrix import (TrafficMatrix, a2a_weights, bipartite_edges,  # noqa: F401
+                     perm_edges, ring_edges, spread)
+from .planes import axis_planes, plane_fn, plane_split  # noqa: F401
+from .sentry import HotlinkSentry
+
+_var.register("traffic", "", "enabled", False, type=bool, level=3,
+              help="Master switch for the topology traffic plane "
+                   "(per-edge attribution, ICI/DCN rollup, hot-link "
+                   "sentry). Off by default; the disabled path is one "
+                   "attribute read per call site.")
+
+enabled: bool = bool(_var.get("traffic_enabled", False))
+
+matrix = TrafficMatrix()
+sentry = HotlinkSentry()
+
+PVARS = ("traffic_hotlink_trips", "traffic_unattributed_bytes",
+         "traffic_attributed_bytes", "traffic_edge_count")
+
+# colls whose XLA lowering we model as the axis ring schedule (the
+# busbw-factor convention: every rank forwards its wire share to its
+# ring successor, so the per-rank wire figure spreads over ring edges)
+_RING_COLLS = frozenset({
+    "allreduce", "reduce", "bcast", "allgather", "allgatherv",
+    "reduce_scatter", "reduce_scatter_block", "scan", "exscan",
+    "gather", "gatherv", "scatter", "scatterv",
+})
+# bipartite block fills (uniform unless a counts matrix rode along)
+_A2A_COLLS = frozenset({
+    "alltoall", "alltoallv", "alltoallw",
+    "neighbor_alltoall", "neighbor_alltoallv", "neighbor_alltoallw",
+})
+
+
+def enable() -> None:
+    global enabled
+    enabled = True
+
+
+def disable() -> None:
+    global enabled
+    enabled = False
+
+
+def _on_enabled_var(v: Any) -> None:
+    # mid-run OMPI_TPU_TRAFFIC_ENABLED / set_cli writes take effect;
+    # the watcher fires on CHANGE only so enable()/disable() stay in
+    # charge
+    global enabled
+    enabled = bool(v)
+
+
+_var.watch("traffic_enabled", _on_enabled_var)
+
+
+_lock = threading.Lock()
+
+
+def _charge(mesh, coll: str, wire: int, edges, weights=None,
+            feed_perf: bool = False) -> None:
+    pf = plane_fn(mesh)
+    parts = spread(wire, edges, weights)
+    matrix.charge(coll, wire, parts, pf)
+    if feed_perf:
+        from .. import perf
+        if perf.enabled:
+            planes = plane_split(parts, pf)
+            perf.note_planes(planes)
+    sentry.check(matrix.snapshot_edges())
+
+
+# ---- source 1: the coll/xla decision audit ---------------------------
+
+def note_coll(dc, coll: str, arm: str, wire: int,
+              weights: Optional[Any] = None) -> None:
+    """Attribute one audited device collective. ``dc`` is the
+    DeviceComm the audit ran on (mesh + axis + size); ``wire`` is the
+    exact per-rank wire-byte figure the audit added to
+    ``coll_wire_bytes``; ``weights`` is the alltoallv counts matrix
+    when one rode along."""
+    wire = int(wire)
+    if wire <= 0:
+        return
+    mesh, axis = dc.mesh, dc.axis
+    if arm == "staged":
+        # host round-trip: no mesh links carried these bytes
+        matrix.charge_host(coll, wire)
+        return
+    if coll in _A2A_COLLS:
+        edges = bipartite_edges(mesh, axis)
+        w = None
+        if weights is not None:
+            import numpy as np
+            C = np.asarray(weights)
+            n = len(edges) // max(C.shape[0] * (C.shape[0] - 1), 1)
+            w = a2a_weights(C, n_lines=n)
+        _charge(mesh, coll, wire, edges, w, feed_perf=True)
+        return
+    if coll in _RING_COLLS:
+        direction = "bidir" if arm == "bidir" else "fwd"
+        _charge(mesh, coll, wire, ring_edges(mesh, axis, direction),
+                feed_perf=True)
+        return
+    # unknown geometry: never silently dropped
+    matrix.charge_unattributed(coll, wire)
+
+
+# ---- source 2: eager DeviceComm ppermute primitives ------------------
+
+def note_ppermute(mesh, axis: str, pairs: Sequence[Tuple[int, int]],
+                  nbytes: int, spc=None, coll: str = "ppermute") -> None:
+    """Charge an explicit perm's (src_pos, dst_pos) pairs along
+    ``axis``. ``nbytes`` is the per-rank wire figure; when an SPC table
+    is given it is also added to ``coll_wire_bytes`` so the
+    conservation invariant covers eager ppermute traffic."""
+    nbytes = int(nbytes)
+    edges = perm_edges(mesh, axis, pairs)
+    if nbytes <= 0 or not edges:
+        return
+    if spc is not None:
+        spc.inc("coll_wire_bytes", nbytes)
+    _charge(mesh, coll, nbytes, edges)
+
+
+# ---- source 3: eager host wrappers with known ring schedules ---------
+
+def note_ring(mesh, axis: str, nbytes: int, coll: str,
+              direction: str = "fwd") -> None:
+    """Charge ``nbytes`` per-rank wire bytes over the axis ring:
+    direction 'fwd' | 'rev' | 'bidir' (the collmm arms map native ->
+    fwd/rev by the call site's ``reverse`` flag, bidir -> both)."""
+    nbytes = int(nbytes)
+    if nbytes <= 0:
+        return
+    _charge(mesh, coll, nbytes, ring_edges(mesh, axis, direction))
+
+
+def note_hierarchical(mesh, inner: str, outer: str,
+                      nbytes: int) -> None:
+    """The HAN split for one hierarchical allreduce of ``nbytes``
+    per-rank bytes: reduce-scatter inner ((ni-1)/ni), allreduce outer
+    on the scattered 1/ni fraction (2(no-1)/no), allgather inner —
+    the outer (DCN) plane carries ni-fold fewer bytes, which is the
+    entire point of the algorithm and exactly what the per-plane
+    rollup should show."""
+    import numpy as np
+    devs = np.asarray(mesh.devices)
+    names = tuple(mesh.axis_names)
+    ni = devs.shape[names.index(inner)]
+    no = devs.shape[names.index(outer)]
+    nbytes = int(nbytes)
+    if nbytes <= 0:
+        return
+    if ni > 1:
+        stage = int((ni - 1) / ni * nbytes)
+        note_ring(mesh, inner, stage, "hier_reduce_scatter")
+        note_ring(mesh, inner, stage, "hier_allgather")
+    if no > 1:
+        note_ring(mesh, outer, int(2 * (no - 1) / no * (nbytes // ni)),
+                  "hier_allreduce")
+
+
+# ---- pvars + report --------------------------------------------------
+
+def pvar_value(name: str) -> float:
+    if name == "traffic_hotlink_trips":
+        return float(sentry.trips())
+    if name == "traffic_unattributed_bytes":
+        return float(matrix.unattributed_bytes)
+    if name == "traffic_attributed_bytes":
+        return float(matrix.placed_bytes)
+    if name == "traffic_edge_count":
+        return float(matrix.edge_count())
+    raise KeyError(name)
+
+
+def report() -> Dict[str, Any]:
+    """Structured snapshot for comm_doctor --traffic / the bench probe."""
+    doc = matrix.to_json()
+    doc["hotlink_trips"] = sentry.trips()
+    doc["verdicts"] = sentry.verdicts()
+    return doc
+
+
+def prometheus_rows(rank: int = 0, comm: str = "world",
+                    prefix: str = "ompi_tpu") -> List[str]:
+    """Per-edge + per-plane gauge families for spc.export_prometheus
+    (empty when the matrix is: families only appear once there is
+    traffic to label)."""
+    rows = matrix.rows()
+    planes = matrix.plane_totals()
+    if not rows and not planes:
+        return []
+    out: List[str] = []
+    if rows:
+        out.append(f"# HELP {prefix}_traffic_edge_bytes per-link "
+                   "attributed wire bytes (topology traffic plane)")
+        out.append(f"# TYPE {prefix}_traffic_edge_bytes gauge")
+        for r in rows:
+            out.append(
+                f'{prefix}_traffic_edge_bytes{{rank="{rank}",'
+                f'comm="{comm}",src="{r["src"]}",dst="{r["dst"]}",'
+                f'plane="{r["plane"]}"}} {r["bytes"]:.10g}')
+    if planes:
+        out.append(f"# HELP {prefix}_traffic_plane_bytes attributed "
+                   "wire bytes per plane (ici/dcn/host)")
+        out.append(f"# TYPE {prefix}_traffic_plane_bytes gauge")
+        for p, b in sorted(planes.items()):
+            out.append(
+                f'{prefix}_traffic_plane_bytes{{rank="{rank}",'
+                f'comm="{comm}",plane="{p}"}} {b:.10g}')
+    return out
+
+
+def reset() -> None:
+    matrix.clear()
+    sentry.reset()

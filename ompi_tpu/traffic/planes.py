@@ -1,0 +1,67 @@
+"""ICI/DCN plane classification for mesh edges + per-plane rollups.
+
+The axis-level inference is ``parallel.hierarchy.classify_axes`` (the
+HAN intra/inter split this plane reuses rather than re-deriving); the
+edge-level rule is the same signal one hop finer: a directed edge is
+``dcn`` when its endpoints live in different processes (slices/hosts),
+else ``ici``. Staged-arm bytes never reach an edge and roll into the
+pseudo-plane ``host``.
+
+Per-plane byte splits are also stashed into the in-flight perf timing
+entry (``perf.note_planes``) so the PR 6 cost model banks plane-keyed
+cells ``<coll>@<plane>`` next to the flat ones — ``best_arm`` and
+``coll_tune --from-ledger`` can then answer per-plane with zero new
+ledger machinery.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from .matrix import Edge
+
+# bounded cache of per-mesh process tables (meshes are long-lived and
+# few; the bound only guards pathological mesh churn in tests)
+_PROC_CACHE: Dict[int, List[int]] = {}
+_PROC_CACHE_MAX = 16
+
+
+def _procs(mesh: Any) -> List[int]:
+    key = id(mesh)
+    got = _PROC_CACHE.get(key)
+    if got is None:
+        devs = np.asarray(mesh.devices).reshape(-1)
+        got = [int(getattr(d, "process_index", 0)) for d in devs]
+        if len(_PROC_CACHE) >= _PROC_CACHE_MAX:
+            _PROC_CACHE.clear()
+        _PROC_CACHE[key] = got
+    return got
+
+
+def plane_fn(mesh: Any) -> Callable[[int, int], str]:
+    """(src, dst) -> 'ici' | 'dcn' for global flat device positions."""
+    procs = _procs(mesh)
+
+    def plane_of(src: int, dst: int) -> str:
+        return "dcn" if procs[src] != procs[dst] else "ici"
+
+    return plane_of
+
+
+def axis_planes(mesh: Any) -> Dict[str, str]:
+    """Axis -> 'ici' | 'dcn' via the hierarchy layer's public helper
+    (imported lazily: this module loads from inside dispatch hooks)."""
+    from ..parallel.hierarchy import classify_axes
+    return classify_axes(mesh)
+
+
+def plane_split(parts: Sequence[Tuple[Edge, int]],
+                plane_of: Callable[[int, int], str]) -> Dict[str, int]:
+    """{'ici': bytes, 'dcn': bytes} rollup of one spread."""
+    out: Dict[str, int] = {}
+    for (s, d), b in parts:
+        p = plane_of(s, d)
+        out[p] = out.get(p, 0) + int(b)
+    return out

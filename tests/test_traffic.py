@@ -1,0 +1,606 @@
+"""Topology traffic plane: per-edge byte attribution, ICI/DCN plane
+ledger, hot-link sentry (ompi_tpu/traffic).
+
+Acceptance pins (ISSUE 7): the conservation invariant — the sum of
+per-edge bytes equals the ``coll_wire_bytes`` pvar for every attributed
+collective, any residue surfacing in ``traffic_unattributed_bytes``;
+``classify_axes`` pinned directly on 2/4/8-device meshes (plus the
+full-grid fix: a process boundary visible only on a nonzero line still
+classifies the axis 'dcn'); exactly one hot-link trip per episode; the
+disabled path is one plain-bool attribute read with zero matrix
+allocations; every ``comm_doctor --json`` mode emits ``schema_version``.
+"""
+
+import json
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = pytest.mark.traffic
+
+from ompi_tpu import perf, runtime, spc, trace, traffic  # noqa: E402
+from ompi_tpu.core import var  # noqa: E402
+from ompi_tpu.parallel import attach_mesh, make_mesh  # noqa: E402
+from ompi_tpu.traffic import planes as tplanes  # noqa: E402
+from ompi_tpu.traffic.matrix import (  # noqa: E402
+    a2a_weights,
+    bipartite_edges,
+    perm_edges,
+    ring_edges,
+    spread,
+)
+from ompi_tpu.traffic.sentry import HotlinkSentry  # noqa: E402
+
+N = 8
+_VARS = (
+    "traffic_enabled", "perf_enabled", "coll_xla_mode",
+    "traffic_sentry_ratio", "traffic_sentry_z",
+    "traffic_sentry_min_edges", "traffic_sentry_min_bytes",
+)
+
+
+@pytest.fixture
+def plane():
+    """set(name=value, ...) applies vars through the CLI layer;
+    everything clears (and the plane's process-wide matrix/sentry zero)
+    on teardown regardless of how the test exits."""
+    traffic.reset()
+    perf.reset()
+    trace.clear()
+    tplanes._PROC_CACHE.clear()
+
+    def set_vars(**kw):
+        for k, v in kw.items():
+            var.registry.set_cli(k, str(v))
+        var.registry.reset_cache()
+
+    yield set_vars
+    for name in _VARS:
+        var.registry.clear_cli(name)
+    var.registry.reset_cache()
+    traffic.disable()
+    perf.disable()
+    trace.disable()
+    trace.clear()
+    traffic.reset()
+    perf.reset()
+    tplanes._PROC_CACHE.clear()
+
+
+def fake_mesh(shape, axis_names, proc_of=None):
+    """Duck-typed mesh over fake device objects — lets the geometry and
+    ICI/DCN tests pin multi-process topologies without real hardware."""
+    size = int(np.prod(shape))
+    devs = np.empty(size, dtype=object)
+    for i in range(size):
+        devs[i] = SimpleNamespace(
+            id=i, platform="cpu",
+            process_index=proc_of(i) if proc_of else 0)
+    return SimpleNamespace(devices=devs.reshape(shape),
+                           axis_names=tuple(axis_names))
+
+
+def _fake_dc(n=4, proc_of=None):
+    return SimpleNamespace(mesh=fake_mesh((n,), ("x",), proc_of),
+                           axis="x", n=n)
+
+
+# ---------------------------------------------------------------------------
+# geometry: exact apportionment, ring/bipartite/perm edge sets
+# ---------------------------------------------------------------------------
+
+def test_spread_is_byte_exact():
+    edges = [(0, 1), (1, 2), (2, 0)]
+    # 100 over 3 edges cannot divide evenly — must still sum exactly
+    parts = spread(100, edges)
+    assert sum(b for _, b in parts) == 100
+    assert {e for e, _ in parts} == set(edges)
+    # weighted: zero-weight edges get nothing, total still exact
+    parts = spread(1000, edges, weights=[3.0, 1.0, 0.0])
+    d = dict(parts)
+    assert d[(0, 1)] == 750 and d[(1, 2)] == 250 and (2, 0) not in d
+    assert spread(0, edges) == []
+    assert spread(100, []) == []
+    assert spread(100, edges, weights=[0, 0, 0]) == []
+
+
+def test_spread_is_deterministic():
+    edges = [(i, i + 1) for i in range(7)]
+    assert spread(103, edges) == spread(103, edges)
+    assert sum(b for _, b in spread(103, edges)) == 103
+
+
+def test_ring_edges_directions():
+    m = fake_mesh((4,), ("x",))
+    assert ring_edges(m, "x", "fwd") == [(0, 1), (1, 2), (2, 3), (3, 0)]
+    assert ring_edges(m, "x", "rev") == [(0, 3), (1, 0), (2, 1), (3, 2)]
+    bidir = ring_edges(m, "x", "bidir")
+    assert set(bidir) == set(ring_edges(m, "x", "fwd")
+                             + ring_edges(m, "x", "rev"))
+    # size-1 axis: no edges
+    assert ring_edges(fake_mesh((1,), ("x",)), "x") == []
+
+
+def test_ring_edges_per_line_on_2d_mesh():
+    # 2x3 mesh, flat positions [[0,1,2],[3,4,5]]: the "b" rings are the
+    # two rows, the "a" rings the three columns
+    m = fake_mesh((2, 3), ("a", "b"))
+    assert set(ring_edges(m, "b", "fwd")) == {
+        (0, 1), (1, 2), (2, 0), (3, 4), (4, 5), (5, 3)}
+    assert set(ring_edges(m, "a", "fwd")) == {
+        (0, 3), (3, 0), (1, 4), (4, 1), (2, 5), (5, 2)}
+
+
+def test_bipartite_and_perm_edges():
+    m = fake_mesh((3,), ("x",))
+    bp = bipartite_edges(m, "x")
+    assert len(bp) == 6 and all(s != d for s, d in bp)
+    # src-major order — a2a_weights' off-diagonal order must line up
+    assert bp == [(0, 1), (0, 2), (1, 0), (1, 2), (2, 0), (2, 1)]
+    pe = perm_edges(m, "x", [(0, 2), (1, 1), (2, 0)])
+    assert pe == [(0, 2), (2, 0)]      # self-pair dropped
+
+
+def test_a2a_weights_order_and_skew(plane):
+    C = np.array([[0, 9, 0, 0], [1, 0, 0, 0],
+                  [0, 0, 0, 1], [0, 0, 1, 0]])
+    assert a2a_weights(C)[:3] == [9.0, 0.0, 0.0]
+    dc = _fake_dc(4)
+    traffic.note_coll(dc, "alltoallv", "native", 1200, weights=C)
+    rows = traffic.matrix.rows()
+    assert (rows[0]["src"], rows[0]["dst"]) == (0, 1)
+    assert rows[0]["bytes"] == 900     # 1200 * 9/12, exactly
+    assert sum(r["bytes"] for r in rows) == 1200
+    assert traffic.matrix.unattributed_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: classify_axes pinned directly (2/4/8-dev + the line-0 fix)
+# ---------------------------------------------------------------------------
+
+def test_classify_axes_real_meshes_all_ici():
+    from ompi_tpu.parallel.mesh import classify_axes
+    devs = jax.devices()
+    for n in (2, 4, 8):
+        m = make_mesh({"x": n}, devices=devs[:n])
+        assert classify_axes(m) == {"x": "ici"}
+    m = make_mesh({"dp": 2, "tp": 4})
+    assert classify_axes(m) == {"dp": "ici", "tp": "ici"}
+
+
+def test_classify_axes_is_public_in_hierarchy():
+    # ONE implementation: the traffic plane and auto_levels share it
+    from ompi_tpu.parallel import hierarchy, mesh
+    assert "classify_axes" in hierarchy.__all__
+    assert hierarchy.classify_axes is mesh.classify_axes
+
+
+def test_classify_axes_fake_dcn_meshes():
+    from ompi_tpu.parallel.mesh import classify_axes
+    # 2 processes split along the first axis of a 2x2 mesh
+    m = fake_mesh((2, 2), ("dp", "tp"), proc_of=lambda i: i // 2)
+    assert classify_axes(m) == {"dp": "dcn", "tp": "ici"}
+    # 4 processes: both axes cross
+    m = fake_mesh((2, 2), ("dp", "tp"), proc_of=lambda i: i)
+    assert classify_axes(m) == {"dp": "dcn", "tp": "dcn"}
+
+
+def test_classify_axes_sees_every_line():
+    from ompi_tpu.parallel.mesh import classify_axes
+    # The regression this PR fixes: process boundary visible ONLY on the
+    # second line along 'a' (flat 3 is the lone process-1 device). The
+    # old line-0-only probe called 'a' ici; scanning the full grid must
+    # call it dcn.
+    m = fake_mesh((2, 2), ("a", "b"),
+                  proc_of=lambda i: 1 if i == 3 else 0)
+    assert classify_axes(m)["a"] == "dcn"
+    assert classify_axes(m)["b"] == "dcn"
+
+
+def test_plane_fn_edge_classification():
+    m = fake_mesh((4,), ("x",), proc_of=lambda i: i // 2)
+    pf = tplanes.plane_fn(m)
+    assert pf(0, 1) == "ici" and pf(2, 3) == "ici"
+    assert pf(1, 2) == "dcn" and pf(3, 0) == "dcn"
+
+
+# ---------------------------------------------------------------------------
+# tentpole: end-to-end conservation over real dispatches
+# ---------------------------------------------------------------------------
+
+def test_e2e_conservation_8dev(plane):
+    plane(traffic_enabled="true", coll_xla_mode="native")
+    assert traffic.enabled
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        x = d.from_ranks([np.ones(256, np.float32)] * N)
+        c.coll.allreduce(c, x)
+        c.coll.allgather(c, x)
+        xa = d.from_ranks(
+            [np.stack([np.full(16, 1.0, np.float32)] * N)] * N)
+        c.coll.alltoall(c, xa)
+        d.push_row(x, 2, 5)
+        snap = ctx.spc.snapshot()
+        return {k: int(snap[k]) for k in
+                ("coll_wire_bytes", "traffic_attributed_bytes",
+                 "traffic_unattributed_bytes", "traffic_edge_count")}
+
+    res = runtime.run_ranks(1, fn)[0]
+    assert res["coll_wire_bytes"] > 0
+    # THE invariant: every wire-counted byte landed on an edge
+    assert res["traffic_attributed_bytes"] == res["coll_wire_bytes"]
+    assert res["traffic_unattributed_bytes"] == 0
+    edge_sum = sum(e["bytes"] for e in traffic.matrix.rows())
+    assert edge_sum == res["coll_wire_bytes"]
+    # alltoall's bipartite block covers every directed pair — the ring
+    # edges and the (2, 5) push land on edges already in it
+    assert res["traffic_edge_count"] == N * (N - 1)
+    pc = traffic.matrix.per_coll()
+    assert set(pc) == {"allreduce", "allgather", "alltoall", "push_row"}
+    # single process: everything is ICI
+    assert set(traffic.matrix.plane_totals()) == {"ici"}
+
+
+def test_staged_arm_rolls_into_host_plane(plane):
+    traffic.note_coll(_fake_dc(), "allreduce", "staged", 4096)
+    assert traffic.matrix.plane_totals() == {"host": 4096}
+    assert traffic.matrix.edge_count() == 0
+    assert traffic.matrix.unattributed_bytes == 0    # conserved
+    assert traffic.matrix.placed_bytes == 4096
+
+
+def test_unknown_coll_never_silently_dropped(plane):
+    traffic.note_coll(_fake_dc(), "frobnicate", "native", 1000)
+    assert traffic.pvar_value("traffic_unattributed_bytes") == 1000
+    assert traffic.matrix.edge_count() == 0
+
+
+def test_ring_direction_honored(plane):
+    dc = _fake_dc(4)
+    traffic.note_coll(dc, "allreduce", "native", 400)
+    assert {(r["src"], r["dst"]) for r in traffic.matrix.rows()} == {
+        (0, 1), (1, 2), (2, 3), (3, 0)}
+    traffic.reset()
+    traffic.note_coll(dc, "allreduce", "bidir", 400)
+    assert len(traffic.matrix.rows()) == 8   # both half-rings
+
+
+# ---------------------------------------------------------------------------
+# eager wrappers: collective matmul, hierarchical, grad sync
+# ---------------------------------------------------------------------------
+
+def test_collmm_attribution_directions(plane):
+    plane(traffic_enabled="true")
+    from ompi_tpu.ops.collective_matmul import (allgather_matmul,
+                                               matmul_reduce_scatter)
+    mesh = make_mesh({"x": N})
+    x = jnp.ones((16, 8), jnp.float32)
+    w = jnp.ones((8, 4), jnp.float32)
+    fwd = {(i, (i + 1) % N) for i in range(N)}
+    rev = {(i, (i - 1) % N) for i in range(N)}
+
+    allgather_matmul(x, w, mesh, "x")
+    assert {(r["src"], r["dst"])
+            for r in traffic.matrix.rows()} == fwd
+    wire = (N - 1) * x.nbytes // N
+    assert traffic.matrix.placed_bytes == wire
+
+    traffic.reset()
+    allgather_matmul(x, w, mesh, "x", reverse=True)
+    assert {(r["src"], r["dst"])
+            for r in traffic.matrix.rows()} == rev
+
+    traffic.reset()
+    allgather_matmul(x, w, mesh, "x", bidirectional=True)
+    assert {(r["src"], r["dst"])
+            for r in traffic.matrix.rows()} == fwd | rev
+
+    traffic.reset()
+    matmul_reduce_scatter(x, w, mesh, "x")
+    # (m/n, n_cols) f32 partial blocks for n-1 hops
+    assert traffic.matrix.placed_bytes == (N - 1) * (16 // N) * 4 * 4
+    assert traffic.matrix.unattributed_bytes == 0
+
+
+def test_hierarchical_attribution_split(plane):
+    plane(traffic_enabled="true")
+    from ompi_tpu.parallel.hierarchy import hierarchical_allreduce
+    mesh = make_mesh({"dp": 2, "tp": 4})
+    x = jnp.ones((2, 4, 64), jnp.float32)
+    hierarchical_allreduce(x, mesh, inner="tp", outer="dp")
+    pc = traffic.matrix.per_coll()
+    assert {"hier_reduce_scatter", "hier_allgather",
+            "hier_allreduce"} <= set(pc)
+    # inner stages ride tp rings, outer rides dp rings on 1/n_inner
+    per_rank = x.nbytes // 8
+    assert pc["hier_reduce_scatter"] == int(3 / 4 * per_rank)
+    assert pc["hier_allreduce"] == int(2 * 1 / 2 * (per_rank // 4))
+    assert traffic.matrix.unattributed_bytes == 0
+
+
+def test_grad_sync_attribution_untraced_path(plane):
+    plane(traffic_enabled="true")
+    assert not trace.enabled     # the restructured early-return path
+    from ompi_tpu.parallel.overlap import make_grad_sync
+    mesh = make_mesh({"dp": N})
+    params = {"w": jnp.ones((N, 4), jnp.float32)}
+
+    def local_loss(p, t):
+        return jnp.sum(p["w"]) * jnp.mean(t)
+
+    vg = make_grad_sync("perleaf", mesh, local_loss)
+    batch = jnp.ones((N, 2), jnp.float32)
+    _loss, grads = vg(params, batch)
+    tot = sum(g.nbytes for g in jax.tree_util.tree_leaves(grads))
+    assert traffic.matrix.per_coll() == {
+        "grad_sync": 2 * (N - 1) * tot // N}
+    # unsynced moves nothing
+    traffic.reset()
+    vg_u = make_grad_sync("unsynced", mesh, local_loss)
+    vg_u(params, batch)
+    assert traffic.matrix.ops == 0
+
+
+def test_ring_attention_attribution(plane):
+    plane(traffic_enabled="true")
+    from ompi_tpu.parallel.ring import ring_attention
+    mesh = make_mesh({"sp": N})
+    q = jnp.ones((1, 16, 2, 4), jnp.float32)
+    k = jnp.ones((1, 16, 2, 4), jnp.float32)
+    v = jnp.ones((1, 16, 2, 4), jnp.float32)
+    ring_attention(q, k, v, mesh, axis="sp")
+    assert traffic.matrix.per_coll() == {
+        "ring_attention": k.nbytes + v.nbytes}
+    assert {(r["src"], r["dst"]) for r in traffic.matrix.rows()} == {
+        (i, (i + 1) % N) for i in range(N)}
+
+
+# ---------------------------------------------------------------------------
+# hot-link sentry: one trip per episode, MAD gate, plane imbalance
+# ---------------------------------------------------------------------------
+
+def _edges(vals, proc=lambda e: "ici"):
+    return [(e, b, proc(e)) for e, b in vals.items()]
+
+
+def test_hotlink_trips_once_per_episode(plane):
+    s = HotlinkSentry()
+    base = {(i, i + 1): 10_000 for i in range(7)}
+    assert s.check(_edges(base)) is None           # uniform: no trip
+    hot = dict(base)
+    hot[(0, 5)] = 30_000                           # 3x median: below 4x
+    assert s.check(_edges(hot)) is None
+    hot[(0, 5)] = 90_000                           # 9x median: trip
+    v = s.check(_edges(hot))
+    assert v and (v["src"], v["dst"]) == (0, 5)
+    assert s.trips() == 1
+    # sustained hot: same episode, no re-trip
+    assert s.check(_edges(hot)) is None
+    assert s.check(_edges(hot)) is None
+    assert s.trips() == 1
+    # episode ends (uniform again) -> re-arm -> second trip
+    assert s.check(_edges(base)) is None
+    hot[(0, 5)] = 120_000
+    assert s.check(_edges(hot)) is not None
+    assert s.trips() == 2
+
+
+def test_hotlink_gates(plane):
+    s = HotlinkSentry()
+    # below min_edges: never judged
+    assert s.check(_edges({(0, 1): 10 ** 9})) is None
+    # below min_bytes floor: never trips
+    small = {(i, i + 1): 10 for i in range(7)}
+    small[(0, 5)] = 1000
+    assert s.check(_edges(small)) is None
+    assert s.trips() == 0
+
+
+def test_hotlink_trip_emits_trace_instant(plane):
+    trace.enable()
+    s = HotlinkSentry()
+    hot = {(i, i + 1): 10_000 for i in range(7)}
+    hot[(0, 5)] = 90_000
+    assert s.check(_edges(hot)) is not None
+    evs = [e for e in trace.events()
+           if e.get("name") == "traffic_hotlink"]
+    assert len(evs) == 1
+    assert evs[0]["args"]["src"] == 0 and evs[0]["args"]["dst"] == 5
+
+
+def test_plane_imbalance_one_trip_per_episode(plane):
+    s = HotlinkSentry()
+    proc = lambda e: "dcn" if e[0] >= 4 else "ici"   # noqa: E731
+    skew = {(i, i + 1): 100_000 for i in range(4)}
+    skew.update({(i + 4, i + 5): 1_000 for i in range(4)})
+    s.check(_edges(skew, proc))
+    verd = [v for v in s.verdicts() if v["kind"] == "plane_imbalance"]
+    assert len(verd) == 1 and verd[0]["hot_plane"] == "ici"
+    s.check(_edges(skew, proc))                      # same episode
+    assert len([v for v in s.verdicts()
+                if v["kind"] == "plane_imbalance"]) == 1
+    balanced = {e: 50_000 for e in skew}
+    s.check(_edges(balanced, proc))                  # re-arm
+    s.check(_edges(skew, proc))
+    assert len([v for v in s.verdicts()
+                if v["kind"] == "plane_imbalance"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: disabled path — plain bool, zero events, zero allocations
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_zero_state(plane):
+    # ONE attribute read per call site: a plain module bool, not a
+    # property/descriptor (the PR 5/6 bar extended to this plane)
+    assert traffic.enabled is False
+    assert isinstance(vars(traffic)["enabled"], bool)
+    trace.enable()
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        x = d.from_ranks([np.ones(64, np.float32)] * N)
+        c.coll.allreduce(c, x)
+        d.push_row(x, 0, 3)
+        return True
+
+    assert runtime.run_ranks(1, fn)[0]
+    assert traffic.matrix.edge_count() == 0
+    assert traffic.matrix.ops == 0
+    assert traffic.matrix.asked_bytes == 0
+    assert traffic.sentry.trips() == 0
+    assert not [e for e in trace.events()
+                if str(e.get("name", "")).startswith("traffic_")]
+
+
+def test_enable_via_var_watcher(plane):
+    plane(traffic_enabled="true")
+    assert traffic.enabled is True
+    var.registry.clear_cli("traffic_enabled")
+    var.registry.reset_cache()
+    assert traffic.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# surfaces: pvars in spc, Prometheus grammar + per-edge labels, doctor
+# ---------------------------------------------------------------------------
+
+import re  # noqa: E402
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+_PROM_SAMPLE = re.compile(
+    rf"^{_PROM_NAME}(?:\{{{_PROM_LABEL}(?:,{_PROM_LABEL})*\}})?"
+    r" [-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|Inf)$")
+_PROM_HELP = re.compile(rf"^# HELP {_PROM_NAME} \S.*$")
+_PROM_TYPE = re.compile(
+    rf"^# TYPE ({_PROM_NAME}) (counter|gauge|histogram|summary|untyped)$")
+
+
+def _assert_prometheus_grammar(text):
+    assert text.endswith("\n")
+    typed = set()
+    samples = 0
+    for line in text.rstrip("\n").split("\n"):
+        m = _PROM_TYPE.match(line)
+        if m:
+            typed.add(m.group(1))
+            continue
+        if _PROM_HELP.match(line):
+            continue
+        assert _PROM_SAMPLE.match(line), f"bad exposition line: {line!r}"
+        samples += 1
+        assert line.split("{")[0] in typed, f"sample before TYPE: {line!r}"
+    assert samples > 0
+    return samples
+
+
+def test_pvars_and_prometheus_rows(plane):
+    plane(traffic_enabled="true", coll_xla_mode="native")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        x = d.from_ranks([np.ones(256, np.float32)] * N)
+        c.coll.allreduce(c, x)
+        snap = ctx.spc.snapshot()
+        return snap, spc.export_prometheus(ctx)
+
+    snap, text = runtime.run_ranks(1, fn)[0]
+    for name in traffic.PVARS:
+        assert name in snap
+    assert snap["traffic_attributed_bytes"] == snap["coll_wire_bytes"]
+    assert snap["traffic_edge_count"] == N
+    # per-edge/per-plane families parse under the exposition grammar
+    _assert_prometheus_grammar(text)
+    assert re.search(
+        r'ompi_tpu_traffic_edge_bytes\{rank="0",comm="world",'
+        r'src="0",dst="1",plane="ici"\} ', text)
+    assert 'ompi_tpu_traffic_plane_bytes{rank="0",comm="world",' \
+        'plane="ici"}' in text
+
+
+def test_prometheus_rows_empty_when_idle(plane):
+    assert traffic.prometheus_rows() == []
+
+
+def _doctor_json(capsys, args):
+    from ompi_tpu.tools import comm_doctor
+    rc = comm_doctor.main(args)
+    return rc, json.loads(capsys.readouterr().out)
+
+
+def test_doctor_schema_version_all_modes(plane, capsys, tmp_path):
+    from ompi_tpu.tools.comm_doctor import SCHEMA_VERSION
+    # dumps mode
+    trace.enable()
+    trace.instant("tick", "event")
+    dump = tmp_path / "TRACE.0.json"
+    trace.save_chrome(str(dump))
+    trace.disable()
+    trace.clear()
+    rc, d = _doctor_json(capsys, [str(dump), "--json"])
+    assert rc == 0 and d["schema_version"] == SCHEMA_VERSION
+    # --health-dump mode
+    hd = tmp_path / "hd"
+    hd.mkdir()
+    (hd / "rank0.health.json").write_text(json.dumps({"rank": 0}))
+    rc, d = _doctor_json(capsys, ["--health-dump", str(hd), "--json"])
+    assert rc == 0 and d["schema_version"] == SCHEMA_VERSION
+    # --perf mode (standalone)
+    rc, d = _doctor_json(capsys, ["--perf", "--json"])
+    assert rc == 0 and d["schema_version"] == SCHEMA_VERSION
+    # --traffic mode (live, empty plane)
+    rc, d = _doctor_json(capsys, ["--traffic", "--json"])
+    assert rc == 0 and d["schema_version"] == SCHEMA_VERSION
+    assert "traffic" in d
+
+
+def test_doctor_traffic_report_heatmap(plane, capsys):
+    plane(traffic_enabled="true")
+    dc = _fake_dc(4)
+    traffic.note_coll(dc, "allreduce", "native", 4000)
+    from ompi_tpu.tools.comm_doctor import build_traffic_report
+    text, data = build_traffic_report()
+    assert "edge heatmap" in text and "per-plane rollup" in text
+    assert data["attributed_bytes"] == 4000
+    assert data["planes"] == {"ici": 4000}
+
+
+# ---------------------------------------------------------------------------
+# plane-keyed perf ledger cells
+# ---------------------------------------------------------------------------
+
+def test_perf_plane_keyed_cells(plane):
+    plane(traffic_enabled="true", perf_enabled="true",
+          coll_xla_mode="native")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        x = d.from_ranks([np.ones(256, np.float32)] * N)
+        c.coll.allreduce(c, x)
+        return True
+
+    assert runtime.run_ranks(1, fn)[0]
+    colls = {r["coll"] for r in perf.model.table()}
+    assert "allreduce" in colls
+    assert "allreduce@ici" in colls    # the traffic plane's cell
+
+
+def test_busbw_factor_falls_back_to_base_coll():
+    from ompi_tpu.perf.model import busbw_GBps
+    flat = busbw_GBps("allreduce", 1 << 20, 1e-3, 8)
+    assert busbw_GBps("allreduce@ici", 1 << 20, 1e-3, 8) == flat
+    assert busbw_GBps("allreduce@dcn", 1 << 20, 1e-3, 8) == flat
