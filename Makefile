@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
-	health-tests perf-tests traffic-tests bench-compare
+	health-tests perf-tests traffic-tests hier-tests bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -15,8 +15,11 @@ SHELL := /bin/bash
 # rides along — its suite is also seconds-cheap and its probe banks the
 # trajectory artifact bench-compare diffs against; the traffic-plane
 # gate closes the loop — its probe injects a skewed ppermute an 8-dev
-# fleet's matrix must attribute to the exact hot edge, conservation held
-tier1: health-tests perf-tests traffic-tests
+# fleet's matrix must attribute to the exact hot edge, conservation held;
+# the hier gate rides last — its probe folds the 8 devices into a
+# simulated 2x4 ICI×DCN pod and fails unless the hier arm beats flat
+# wall-clock while moving exactly 1/n_inner of the bytes on the slow plane
+tier1: health-tests perf-tests traffic-tests hier-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -74,6 +77,16 @@ traffic-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_traffic.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --traffic
+
+# the hierarchical multi-plane tier: hier/hier+quant decision arms,
+# '<coll>@<plane>' rule rows, padding fix, simulated-DCN classification
+# — then the end-to-end pod probe (8 devices as a 2x4 outer×inner mesh
+# with the outer axis DCN-skewed; exits nonzero unless hier beats flat
+# and the outer stage carries exactly 1/n_inner of the flat-arm bytes)
+hier-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_hier.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --pod
 
 # regression gate over the banked trajectory artifact: non-zero exit
 # names every phase whose busbw/goodput/MFU column lost >10% (run it

@@ -1860,6 +1860,168 @@ def run_traffic_probe(platform: str) -> None:
         trace.disable()
 
 
+def run_pod_probe(platform: str) -> None:
+    """--pod: end-to-end acceptance for the hierarchical (two-tier)
+    decision arm on a simulated pod.  The 8 devices fold into a 2×4
+    outer×inner mesh whose outer axis is force-classified DCN
+    (``topo_sim_dcn_axes``) with a per-MiB dispatch delay
+    (``topo_sim_dcn_us_per_mib``) skewing the slow plane, then the same
+    allreduce runs under the flat native, hier, and hier+quant arms.
+    Asserts: the decision audit names each executed arm; the hier arm's
+    outer (DCN) stage moves exactly 1/n_inner of the bytes a flat DCN
+    allreduce of the full buffer would (traffic conservation, divisible
+    sizes so the figure is exact); hier beats flat wall-clock on the
+    skewed mesh; hier+quant keeps the inner stages bitwise-native
+    (identical inner bytes) while the outer stage shrinks ~4x with the
+    audit's quant_ratio recording it.  Banks BENCH_POD_<platform>.json;
+    exits non-zero on any miss."""
+    import jax
+
+    from ompi_tpu import runtime, trace, traffic
+    from ompi_tpu.core import var
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"pod probe: needs 8 devices, have {ndev}")
+    ni, no = 4, 2
+    count = 1 << 20                       # 4 MiB f32 per rank, ni | count
+    nbytes = count * 4
+    iters = 3
+    us_mib = 2000.0
+
+    var.registry.set_cli("traffic_enabled", "true")
+    var.registry.set_cli("topo_sim_dcn_axes", "outer")
+    var.registry.set_cli("topo_sim_dcn_us_per_mib", str(us_mib))
+    var.registry.reset_cache()
+    traffic.reset()
+    traffic.enable()
+    trace.enable()
+    try:
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"outer": no, "inner": ni}),
+                        ("outer", "inner"))
+            d = c.device_comm
+            x = d.from_ranks([np.ones(count, np.float32)] * (no * ni))
+            out = {}
+            for arm in ("native", "hier", "hier+quant"):
+                var.registry.set_cli("coll_xla_allreduce_mode", arm)
+                var.registry.reset_cache()
+                traffic.reset()
+                before = int(ctx.spc.snapshot()["coll_wire_bytes"])
+                c.coll.allreduce(c, x)    # warm/compile outside the clock
+                traffic.reset()
+                before = int(ctx.spc.snapshot()["coll_wire_bytes"])
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    jax.block_until_ready(c.coll.allreduce(c, x))
+                wall = time.perf_counter() - t0
+                rep = traffic.report()
+                snap = ctx.spc.snapshot()
+                out[arm] = {
+                    "wall_ms": round(wall * 1e3, 2),
+                    "busbw_GBps": round(
+                        iters * 2 * (no * ni - 1) / (no * ni) * nbytes
+                        / wall / 1e9, 3),
+                    "wire_bytes": int(snap["coll_wire_bytes"]) - before,
+                    "unattributed": int(snap["traffic_unattributed_bytes"]),
+                    "edge_sum": sum(e["bytes"] for e in rep["edges"]),
+                    "host_bytes": int(rep["planes"].get("host", 0)),
+                    "planes": dict(rep["planes"]),
+                    "hier": rep.get("hier"),
+                    "decision": trace.explain_last("allreduce"),
+                }
+            var.registry.set_cli("coll_xla_allreduce_mode", "")
+            var.registry.reset_cache()
+            return out
+
+        res = runtime.run_ranks(1, fn)[0]
+        doc = {
+            "metric": "pod_hier_speedup",
+            "value": round(res["native"]["wall_ms"]
+                           / max(res["hier"]["wall_ms"], 1e-9), 3),
+            "unit": "flat/hier wall ratio on the DCN-skewed mesh "
+                    "(must be > 1)",
+            "platform": platform, "ndev": ndev,
+            "mesh": {"outer": no, "inner": ni},
+            "sim_dcn_us_per_mib": us_mib,
+            "per_rank_bytes": nbytes, "iters": iters,
+            "arms": res,
+        }
+        with open(os.path.join(here, f"BENCH_POD_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items() if k != "arms"}),
+              flush=True)
+
+        # 1. the audit names each executed arm
+        for arm in ("native", "hier", "hier+quant"):
+            dec = res[arm]["decision"]
+            if not dec or dec.get("arm") != arm:
+                raise SystemExit(
+                    f"pod probe: decision audit names "
+                    f"{dec and dec.get('arm')!r}, forced arm is {arm!r}")
+        # 2. conservation per arm: every wire-counted byte attributed
+        for arm, r in res.items():
+            if r["unattributed"] != 0:
+                raise SystemExit(
+                    f"pod probe: {arm}: {r['unattributed']} "
+                    "unattributed byte(s)")
+            if r["edge_sum"] + r["host_bytes"] != r["wire_bytes"]:
+                raise SystemExit(
+                    f"pod probe: {arm}: edge sum {r['edge_sum']} "
+                    f"(+{r['host_bytes']} host) != wire bytes "
+                    f"{r['wire_bytes']}")
+        # 3. the hier outer (DCN) stage carries exactly 1/n_inner of a
+        # full-buffer flat DCN allreduce (divisible sizes: exact)
+        hier = res["hier"]["hier"]
+        flat_dcn_equiv = iters * 2 * (no - 1) * nbytes // no
+        if hier["outer_bytes"] * ni != flat_dcn_equiv:
+            raise SystemExit(
+                "pod probe: hier outer stage moved "
+                f"{hier['outer_bytes']}B on the DCN plane; expected "
+                f"exactly 1/{ni} of the flat-arm equivalent "
+                f"{flat_dcn_equiv}B")
+        if res["hier"]["planes"].get("dcn", 0) != hier["outer_bytes"]:
+            raise SystemExit(
+                "pod probe: DCN plane rollup "
+                f"{res['hier']['planes'].get('dcn')}B != hier outer "
+                f"stage {hier['outer_bytes']}B")
+        # 4. hier beats flat wall-clock under the simulated DCN skew
+        if res["hier"]["wall_ms"] >= res["native"]["wall_ms"]:
+            raise SystemExit(
+                f"pod probe: hier ({res['hier']['wall_ms']}ms) did not "
+                f"beat flat ({res['native']['wall_ms']}ms) on the "
+                "DCN-skewed mesh")
+        # 5. hier+quant: inner stages bitwise-native (identical inner
+        # bytes), outer quantized (audit ratio < 1, fewer DCN bytes)
+        hq = res["hier+quant"]["hier"]
+        if hq["inner_bytes"] != hier["inner_bytes"]:
+            raise SystemExit(
+                "pod probe: hier+quant inner bytes "
+                f"{hq['inner_bytes']} != hier inner bytes "
+                f"{hier['inner_bytes']} (inner stages must stay native)")
+        if not hq["outer_bytes"] < hier["outer_bytes"]:
+            raise SystemExit(
+                "pod probe: hier+quant outer stage "
+                f"({hq['outer_bytes']}B) not below native outer "
+                f"({hier['outer_bytes']}B)")
+        ratio = (res["hier+quant"]["decision"] or {}).get("quant_ratio")
+        if not ratio or not 0 < ratio < 1:
+            raise SystemExit(
+                "pod probe: hier+quant audit carries no quant_ratio "
+                f"(got {ratio!r})")
+    finally:
+        for v in ("traffic_enabled", "topo_sim_dcn_axes",
+                  "topo_sim_dcn_us_per_mib", "coll_xla_allreduce_mode"):
+            var.registry.clear_cli(v)
+        var.registry.reset_cache()
+        traffic.disable()
+        trace.disable()
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--compare" in argv:
@@ -1899,6 +2061,9 @@ def main() -> None:
             return
         if "--traffic" in sys.argv[1:]:
             run_traffic_probe(platform)
+            return
+        if "--pod" in sys.argv[1:]:
+            run_pod_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

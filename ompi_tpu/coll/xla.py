@@ -51,13 +51,15 @@ def _is_device(x) -> bool:
 
 _var.register("coll", "xla", "mode", "", type=str, level=3,
               help="Force device-collective mode for every entry: "
-                   "native|staged|quant (empty = per-entry decision; "
-                   "quant applies to entries with a quantized arm, "
-                   "others keep the auto decision).")
+                   "native|staged|quant|hier|hier+quant (empty = "
+                   "per-entry decision; quant/hier apply to entries "
+                   "with that arm, others keep the auto decision).")
 _var.register("coll", "xla", "dynamic_rules", "", type=str, level=4,
               help="Path to a device decision rules file: lines of "
-                   "'<coll> <min_ndev> <min_bytes> "
-                   "<native|staged|quant|bidir>'.")
+                   "'<coll>[@<plane>] <min_ndev> <min_bytes> "
+                   "<native|staged|quant|bidir|hier|hier+quant>' "
+                   "(plane in {ici,dcn}; plane-keyed rows beat plain "
+                   "rows on comms spanning that plane).")
 _var.register("coll", "xla", "grad_bucket_bytes", 4 << 20, type=int, level=3,
               help="Target bytes per gradient-sync bucket for the "
                    "bucketed overlap tier (parallel/overlap): grads are "
@@ -93,8 +95,9 @@ for _c in _DECIDED:
 # gradient sync (parallel/overlap) and the collective-matmul ring
 # direction (ops/collective_matmul via Config(tp_overlap="fused"))
 _var.register("coll", "xla", "grad_sync_mode", "", type=str, level=3,
-              help="Force the gradient-sync bucket arm (native|quant; "
-                   "empty = auto via DEVICE_RULES grad_sync rows).")
+              help="Force the gradient-sync bucket arm (native|quant|"
+                   "hier|hier+quant; empty = auto via DEVICE_RULES "
+                   "grad_sync rows).")
 _var.register("coll", "xla", "collmm_mode", "", type=str, level=3,
               help="Force the collective-matmul ring schedule "
                    "(native = unidirectional ring | bidir = two "
@@ -109,8 +112,14 @@ _var.register("coll", "xla", "rules", "", type=str, level=3,
                    "through to the static chain on a model miss. "
                    "Force vars and blanket switches still outrank.")
 
-# every mode any decision point can name (rules-file vocabulary)
-_MODES = ("native", "staged", "quant", "bidir")
+# every mode any decision point can name (rules-file vocabulary);
+# "hier" = the two-tier HAN arm (reduce_scatter ICI -> allreduce DCN on
+# the scattered 1/n_inner -> allgather ICI), "hier+quant" the same shape
+# with ONLY the outer (DCN) stage on the EQuARX quantized tier
+_MODES = ("native", "staged", "quant", "bidir", "hier", "hier+quant")
+# plane vocabulary for '<coll>@<plane>' rule rows (parallel/hierarchy's
+# classify_axes split, incl. the topo_sim_dcn_axes override)
+_PLANES = ("ici", "dcn")
 
 
 def _load_device_rules(path: Optional[str] = None):
@@ -119,7 +128,13 @@ def _load_device_rules(path: Optional[str] = None):
     ``coll_xla_dynamic_rules`` path is read (the dispatch-time caller);
     an explicit path serves offline consumers — the trace analyzer's
     decision-drift check re-evaluates audited arms against any rules
-    file, e.g. the repo's DEVICE_RULES.txt."""
+    file, e.g. the repo's DEVICE_RULES.txt.
+
+    The coll column may be plane-keyed: ``<coll>@<plane>`` (plane in
+    {ici, dcn}) rows apply only to communicators whose axes include
+    that plane and BEAT plain rows for the same coll at decision time
+    (decide_mode's two-lane rule walk).  An unknown plane is a loud
+    ValueError — a typo must not silently deactivate a row."""
     if path is None:
         path = _var.get("coll_xla_dynamic_rules", "")
     rules = []
@@ -141,8 +156,15 @@ def _load_device_rules(path: Optional[str] = None):
                 except ValueError as exc:
                     raise ValueError(
                         f"{path}:{lineno}: bad device rule {line!r} "
-                        "(want '<coll> <min_ndev> <min_bytes> "
+                        "(want '<coll>[@<plane>] <min_ndev> <min_bytes> "
                         f"<native|staged>'): {exc}") from None
+                if "@" in coll:
+                    base, plane = coll.split("@", 1)
+                    if not base or plane not in _PLANES:
+                        raise ValueError(
+                            f"{path}:{lineno}: unknown plane in "
+                            f"{coll!r} (want '<coll>@<plane>' with "
+                            f"plane one of {', '.join(_PLANES)})")
                 if mode not in _MODES:
                     raise ValueError(
                         f"{path}:{lineno}: unknown device mode {mode!r} "
@@ -153,7 +175,8 @@ def _load_device_rules(path: Optional[str] = None):
 
 def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
                 rules, allowed, quant_ok: bool = False,
-                dtype=None, op: Op = None) -> tuple:
+                dtype=None, op: Op = None, plane: Optional[str] = None,
+                hier_ok: bool = False, hier_why: str = "") -> tuple:
     """The device decision-precedence chain as a reusable module-level
     function, returned as (arm, reason, chain): per-entry force var >
     blanket coll_xla_mode > blanket COLL_QUANT > platform default, then
@@ -167,9 +190,17 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
     for this buffer/op — the decision never names an arm the entry would
     silently ignore.  XlaModule dispatches funnel through here (via
     ``_decide``); the overlap tier calls it directly with the coll names
-    ``grad_sync`` (bucketed dp gradient sync, native|quant) and
+    ``grad_sync`` (bucketed dp gradient sync, native|quant|hier) and
     ``collmm`` (collective-matmul ring direction, native|bidir).
-    """
+
+    Two-tier extensions: ``plane`` is the calling comm's plane context
+    ('dcn' when any comm axis crosses a DCN boundary, else 'ici') —
+    ``<coll>@<plane>`` rule rows match only their plane and BEAT plain
+    rows for the same coll (their vetoes included).  The hierarchical
+    arms (hier, hier+quant) are gated by ``hier_ok`` instead of
+    ``allowed``: an ineligible comm (flat mesh, single axis, non-sum
+    op) records the audited ``ineligible:hier:<hier_why>`` veto, and an
+    explicit per-entry force of an impossible hier raises."""
     from .quant import check_quantizable
 
     chain: list = []
@@ -203,6 +234,25 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
                              "(entry has no quantized arm)")
             # global quant force: entries without a quantized arm
             # keep the auto decision below
+        elif forced in ("hier", "hier+quant"):
+            if not hier_ok:
+                if ent:
+                    # a per-entry force of an impossible hier must fail
+                    # loudly, not silently take the flat path
+                    raise ValueError(
+                        f"coll_xla mode for {coll!r} forces {forced} "
+                        f"but the comm is ineligible: {hier_why}")
+                chain.append(f"force:{src}={forced} skipped "
+                             f"(ineligible:hier:{hier_why})")
+            elif forced == "hier+quant" and not quant_ok:
+                if ent:
+                    check_quantizable(op or SUM,
+                                      dtype if dtype is not None
+                                      else np.float32)
+                chain.append(f"force:{src}={forced} skipped "
+                             "(op/dtype has no quantized outer stage)")
+            else:
+                return forced, f"force:{src}={forced}", chain
         elif forced in allowed:
             return forced, f"force:{src}={forced}", chain
         else:
@@ -227,6 +277,10 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
         cand = tuple(m for m in allowed
                      if m != "quant"
                      or (q_ok and not quant_off and nbytes >= floor))
+        if hier_ok:
+            cand = cand + ("hier",)
+            if quant_ok and not quant_off:
+                cand = cand + ("hier+quant",)
         learned = perf.best_arm(coll, nbytes, cand)
         if learned is not None:
             return learned[0], learned[1], chain
@@ -245,32 +299,59 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
     if pick not in allowed:
         pick = "native"
     reason = f"default:platform={platform}"
+
+    def _veto_of(mode: str, rule: str) -> Optional[str]:
+        """Gates shared by plain and plane-keyed rows.  The quant floor
+        deliberately does NOT veto hier+quant: only the scattered
+        1/n_inner fraction is quantized there, so the flat-arm latency
+        calculus behind the floor does not carry over."""
+        if mode in ("quant", "hier+quant"):
+            if quant_off:
+                return f"off:COLL_QUANT={qvar} (vetoed {rule})"
+            if not (q_ok if mode == "quant" else quant_ok):
+                return f"ineligible:op/dtype/layout (vetoed {rule})"
+            if mode == "quant" and nbytes < floor:
+                return (f"floor:coll_quant_min_bytes={floor}"
+                        f">{nbytes} (vetoed {rule})")
+        if mode in ("hier", "hier+quant") and not hier_ok:
+            return f"ineligible:hier:{hier_why} (vetoed {rule})"
+        return None
+
+    # two-lane walk: plain rows accumulate as before; '<coll>@<plane>'
+    # rows matching the comm's plane accumulate separately and override
+    # the plain lane at the end (vetoes included — a vetoed plane row's
+    # reason still beats a plain row's pick)
+    p_pick: Optional[str] = None
+    p_reason: Optional[str] = None
     for c, mn, mb, mode in rules:
-        if c != coll or ndev < mn or nbytes < mb:
+        base_coll, _, row_plane = c.partition("@")
+        if base_coll != coll or ndev < mn or nbytes < mb:
+            continue
+        if row_plane and row_plane != (plane or ""):
             continue
         rule = f"rule:{c} {mn} {mb} {mode}"
-        if mode == "quant":
+        veto = _veto_of(mode, rule)
+        if veto is not None:
             # vetoed rule: keep the prior pick, but the veto IS the
             # deciding word unless a later rule overrides it
-            if quant_off:
-                reason = f"off:COLL_QUANT={qvar} (vetoed {rule})"
-                chain.append(reason)
-                continue
-            if not q_ok:
-                reason = f"ineligible:op/dtype/layout (vetoed {rule})"
-                chain.append(reason)
-                continue
-            if nbytes < floor:
-                reason = (f"floor:coll_quant_min_bytes={floor}"
-                          f">{nbytes} (vetoed {rule})")
-                chain.append(reason)
-                continue
-        elif mode not in allowed:
+            chain.append(veto)
+            if row_plane:
+                p_reason = veto
+            else:
+                reason = veto
+            continue
+        if mode not in ("hier", "hier+quant") and mode not in allowed:
             chain.append(f"{rule} skipped (no {mode} kernel)")
             continue
-        pick = mode
-        reason = rule
+        if row_plane:
+            p_pick, p_reason = mode, rule
+        else:
+            pick, reason = mode, rule
         chain.append(rule)
+    if p_reason is not None:
+        reason = p_reason
+    if p_pick is not None:
+        pick = p_pick
     return pick, reason, chain
 
 
@@ -305,6 +386,17 @@ class XlaModule(CollModule):
         self._comm = comm               # decision-audit wire accounting
         self._rules = _load_device_rules()
         self._platform = next(iter(self.dc.mesh.devices.flat)).platform
+        # two-tier context, fixed at attach time: whether the comm's
+        # axis (tuple) spans an inner ICI + outer DCN split (the hier
+        # arm's eligibility) and which plane keys '<coll>@<plane>' rows
+        from ..parallel.hierarchy import classify_axes, hier_axes
+        self._hier_inner, self._hier_outer, self._hier_why = hier_axes(
+            self.dc.mesh, self.dc.axis)
+        axes = (self.dc.axis if isinstance(self.dc.axis, tuple)
+                else (self.dc.axis,))
+        kinds = classify_axes(self.dc.mesh)
+        self._plane = ("dcn" if any(kinds.get(a) == "dcn" for a in axes)
+                       else "ici")
 
     # Device layout contract: x is (n, *elem) sharded on dim 0 over the comm
     # axis — row i is "rank i"'s buffer (parallel/collectives.py docstring).
@@ -334,12 +426,29 @@ class XlaModule(CollModule):
 
     def _decide(self, coll: str, x, op: Op, allowed) -> tuple:
         """Module-entry shim over :func:`decide_mode`: per-RANK bytes from
-        the canonical layout, quant eligibility from the op/dtype gate."""
+        the canonical layout, quant eligibility from the op/dtype gate,
+        hier eligibility from the comm's two-tier context."""
         nbytes = x.nbytes // max(x.shape[0], 1)
+        hier_ok, hier_why = self._hier_eligible(coll, op)
         return decide_mode(coll, nbytes, self.dc.n, self._platform,
                            self._rules, allowed,
                            quant_ok=self._quant_ok(coll, x, op),
-                           dtype=x.dtype, op=op)
+                           dtype=x.dtype, op=op, plane=self._plane,
+                           hier_ok=hier_ok, hier_why=hier_why)
+
+    def _hier_eligible(self, coll: str, op: Op = None) -> tuple:
+        """(ok, why-not) for the hierarchical arm on this entry: only
+        allreduce has a hier kernel, the comm must span a real two-tier
+        axis split (hier_axes), and the staged shape reduces via psum —
+        sum only."""
+        if coll != "allreduce":
+            return False, "entry has no hierarchical kernel"
+        if self._hier_inner is None:
+            return False, self._hier_why
+        if (op or SUM).name != "sum":
+            return False, (f"op {(op or SUM).name} has no hierarchical "
+                           "reduce (psum stages are sum-only)")
+        return True, ""
 
     # modeled wire-byte collectives: coll -> coll/quant hop-table name
     _WIRE_MODEL = {"allreduce": "allreduce",
@@ -362,30 +471,62 @@ class XlaModule(CollModule):
         nbytes = x.nbytes // rows
         wire = nbytes
         ratio = None
-        qcoll = self._WIRE_MODEL.get(coll)
-        if qcoll is not None:
-            from .quant import wire_bytes
-            try:
-                wb = wire_bytes(qcoll, max(x.size // rows, 1), self.dc.n,
-                                x.dtype)
-            except (ValueError, TypeError):
-                wb = None
-            if wb is not None:
-                ratio = wb["ratio"]
-                if arm == "quant":
-                    wire = wb["quant_bytes"]
-                elif arm == "native":
-                    wire = wb["native_bytes"]
-                if arm == "quant":
-                    from .. import monitoring
-                    # satellite fix: record_coll logged the logical size;
-                    # correct the coll matrix to int8-payload+scales
-                    monitoring.coll_wire_event(self._comm, coll,
-                                               wb["quant_bytes"], x.nbytes)
+        hier_split = None
+        if arm in ("hier", "hier+quant"):
+            # the HAN stage math is the wire model: inner RS + AG at
+            # (ni-1)/ni each, outer allreduce on the scattered 1/ni
+            # fraction (quantized for hier+quant — the inner stages
+            # stay native, so only the outer figure shrinks)
+            from ..parallel.hierarchy import hier_wire_bytes
+            ni = self.dc.mesh.shape[self._hier_inner]
+            no = self.dc.mesh.shape[self._hier_outer]
+            hw = hier_wire_bytes(max(x.size // rows, 1), x.dtype, ni, no,
+                                 quant=(arm == "hier+quant"))
+            wire = hw["total_bytes"]
+            ratio = hw["ratio"]
+            hier_split = (self._hier_inner, self._hier_outer,
+                          hw["inner_stage_bytes"], hw["outer_bytes"],
+                          hw["outer_native_bytes"])
+            if arm == "hier+quant":
+                from .. import monitoring
+                monitoring.coll_wire_event(self._comm, coll, wire,
+                                           x.nbytes)
+        else:
+            qcoll = self._WIRE_MODEL.get(coll)
+            if qcoll is not None:
+                from .quant import wire_bytes
+                try:
+                    wb = wire_bytes(qcoll, max(x.size // rows, 1),
+                                    self.dc.n, x.dtype)
+                except (ValueError, TypeError):
+                    wb = None
+                if wb is not None:
+                    ratio = wb["ratio"]
+                    if arm == "quant":
+                        wire = wb["quant_bytes"]
+                    elif arm == "native":
+                        wire = wb["native_bytes"]
+                    if arm == "quant":
+                        from .. import monitoring
+                        # satellite fix: record_coll logged the logical
+                        # size; correct the coll matrix to
+                        # int8-payload+scales
+                        monitoring.coll_wire_event(
+                            self._comm, coll, wb["quant_bytes"], x.nbytes)
         spc = self.dc.spc
         if spc is not None:
             spc.inc(f"coll_arm_{arm}_count")
             spc.inc("coll_wire_bytes", wire)
+        from ..parallel import simdcn
+        if simdcn.us_per_mib() > 0:
+            # simulated-DCN delay shim: charge the bytes this arm's
+            # geometry moves across the simulated slow plane (hier pays
+            # only its outer stage — the skew the hier arm exists for)
+            if hier_split is not None:
+                simdcn.charge(hier_split[3])
+            elif arm != "staged":
+                simdcn.charge(int(wire * simdcn.ring_dcn_fraction(
+                    self.dc.mesh, self.dc.axis)))
         from .. import health, perf
         if health.enabled:
             # fold the decided arm into the in-flight entry's signature —
@@ -400,11 +541,20 @@ class XlaModule(CollModule):
         from .. import traffic
         if traffic.enabled:
             # per-edge attribution of the SAME wire figure the pvar just
-            # banked — the conservation invariant's other half
-            traffic.note_coll(self.dc, coll, arm, wire, weights=weights)
+            # banked — the conservation invariant's other half (hier
+            # passes its stage split so the matrix charges inner RS/AG
+            # rings + the outer ring instead of one flat ring)
+            traffic.note_coll(self.dc, coll, arm, wire, weights=weights,
+                              hier=hier_split)
         if trace.enabled:
             bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
             ctx = getattr(self._comm, "ctx", None)
+            extra = {}
+            if hier_split is not None:
+                extra = {"hier_inner": hier_split[0],
+                         "hier_outer": hier_split[1],
+                         "hier_inner_bytes": 2 * hier_split[2],
+                         "hier_outer_bytes": hier_split[3]}
             trace.decision(
                 coll, arm=arm, reason=reason,
                 nbytes=nbytes, rank=getattr(ctx, "rank", 0),
@@ -412,7 +562,7 @@ class XlaModule(CollModule):
                 dtype=str(x.dtype),
                 reduce_op=getattr(op, "name", None),
                 ndev=self.dc.n, wire_bytes=wire, quant_ratio=ratio,
-                chain=list(chain))
+                chain=list(chain), **extra)
 
     def _quant_ok(self, coll: str, x, op: Op = None) -> bool:
         """Whether the quantized arm can carry this call at all
@@ -455,6 +605,9 @@ class XlaModule(CollModule):
                           allowed=self._ALL_ARMS
                           if op.name in _NP_FOLD
                           else ("native", "quant"))
+        if mode in ("hier", "hier+quant"):
+            return self._hier_allreduce(sendbuf, op,
+                                        quant=(mode == "hier+quant"))
         if mode == "quant":
             return self.dc.quant.allreduce(sendbuf, op)
         if mode == "staged":
@@ -462,6 +615,38 @@ class XlaModule(CollModule):
             red = _NP_FOLD[op.name](h, axis=0)
             return self._stage_in(np.broadcast_to(red, h.shape))
         return self.dc.allreduce(sendbuf, op)
+
+    def _hier_allreduce(self, x, op: Op, quant: bool):
+        """The two-tier HAN arm: reduce_scatter(inner ICI) →
+        allreduce(outer DCN, on the scattered 1/n_inner — quantized
+        when ``quant``) → allgather(inner ICI), compiled through the
+        same executable cache as every flat arm.  Only reachable when
+        the decision layer said so, i.e. the comm spans a two-tier axis
+        split and op is sum."""
+        import jax.numpy as jnp
+
+        from ..parallel.hierarchy import (hierarchical_psum,
+                                          hierarchical_psum_quant)
+        dc = self.dc
+        inner, outer = self._hier_inner, self._hier_outer
+        no = dc.mesh.shape[outer]
+        key = ("hier_allreduce", bool(quant), inner, outer, x.shape,
+               str(x.dtype))
+
+        def build():
+            def fn(xs):              # (r, *e) local rows
+                red = dc._fold_local(xs, op)
+                shape = red.shape
+                flat = red.reshape(-1)
+                if quant:
+                    out = hierarchical_psum_quant(flat, inner, outer, no)
+                else:
+                    out = hierarchical_psum(flat, inner, outer)
+                return jnp.broadcast_to(out.reshape(shape)[None],
+                                        xs.shape)
+            return dc._shard_map(fn, dc._spec, dc._spec)
+
+        return dc._compiled(key, build)(x)
 
     def reduce(self, comm, sendbuf, recvbuf=None, op: Op = None, root: int = 0):
         op = op or SUM

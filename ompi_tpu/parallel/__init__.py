@@ -37,7 +37,7 @@ from .collectives import (  # noqa: F401
 )
 
 
-def attach_mesh(comm, mesh, axis: str) -> None:
+def attach_mesh(comm, mesh, axis) -> None:
     """Give a communicator a device mesh, enabling the coll/xla component
     (re-runs coll selection so xla outranks the host components).
 
@@ -76,9 +76,18 @@ def attach_mesh(comm, mesh, axis: str) -> None:
         comm.device_mesh = mesh
         comm.device_axis = None
         return
-    if comm.size != 1 and mesh.shape[axis] != comm.size:
+    if isinstance(axis, (tuple, list)):
+        # a tuple of axis names spans their row-major product — the
+        # two-tier (ICI×DCN) comm shape the hier arm addresses by level
+        axis = tuple(axis)
+        ax_size = 1
+        for a in axis:
+            ax_size *= mesh.shape[a]
+    else:
+        ax_size = mesh.shape[axis]
+    if comm.size != 1 and ax_size != comm.size:
         raise ValueError(
-            f"mesh axis {axis!r} has {mesh.shape[axis]} devices but "
+            f"mesh axis {axis!r} has {ax_size} devices but "
             f"comm {comm.name} has {comm.size} ranks")
     comm.device_mesh = mesh
     comm.device_axis = axis

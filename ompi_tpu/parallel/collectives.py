@@ -153,12 +153,25 @@ class DeviceComm:
     ``n`` "ranks" = positions along `axis`. Input arrays use the canonical
     (n, *elem) dim-0-sharded layout (see module docstring); `from_ranks`/
     `to_ranks` convert to/from per-rank host arrays.
+
+    ``axis`` may also be a TUPLE of axis names: the comm then spans the
+    row-major product of those axes (outer-to-inner order), which is how
+    a two-tier ICI×DCN comm presents one flat rank space while the
+    hierarchical (`hier`) arm in coll/xla still addresses the individual
+    levels by name.  Every flat collective here passes the tuple straight
+    into the lax primitive (tuple axis names are first-class in jax);
+    the cartesian/ring helpers, which need a single line geometry, keep
+    requiring a single named axis.
     """
 
-    def __init__(self, mesh: Mesh, axis: str) -> None:
+    def __init__(self, mesh: Mesh, axis) -> None:
         self.mesh = mesh
+        if isinstance(axis, (tuple, list)):
+            axis = tuple(axis)
+            self.n = int(np.prod([mesh.shape[a] for a in axis]))
+        else:
+            self.n = mesh.shape[axis]
         self.axis = axis
-        self.n = mesh.shape[axis]
         self._cache: Dict[tuple, Callable] = {}
         # counts → device gather maps, LRU-bounded: repeated patterns (the
         # bench, fixed decompositions) hit; per-step MoE routings churn

@@ -16,16 +16,39 @@ classification, per-axis subcommunicator views).
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, FrozenSet, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import var as _var
 from ..jaxcompat import auto_axis_types
 
 # conventional axis names, outer→inner (DCN-most → ICI-most)
 STANDARD_AXES = ("dp", "fsdp", "pp", "ep", "sp", "tp")
+
+# the simulated DCN plane: a single-process CPU test mesh has no real
+# slice boundaries, so the two-tier decision layer (hier arm, plane-keyed
+# rules, per-plane traffic rollup) would be untestable before multi-slice
+# hardware.  Naming axes here force-classifies them as 'dcn' everywhere
+# the topology is consulted (classify_axes, traffic/planes.plane_fn); the
+# companion delay shim (parallel/simdcn) charges wall-clock per byte that
+# crosses the simulated boundary so arm sweeps see a skewed fabric.
+_var.register("topo", "sim", "dcn_axes", "", type=str, level=4,
+              help="Comma-separated mesh axis names to force-classify as "
+                   "DCN (simulated slow plane for single-process test "
+                   "meshes; empty = infer from process boundaries).")
+_var.register("topo", "sim", "dcn_us_per_mib", 0.0, type=float, level=4,
+              help="Simulated-DCN delay shim: host-side microseconds "
+                   "charged per MiB that crosses a simulated DCN "
+                   "boundary (parallel/simdcn; 0 = shim off).")
+
+
+def sim_dcn_axes() -> FrozenSet[str]:
+    """Axis names the sim-DCN override forces to 'dcn' (empty = off)."""
+    raw = str(_var.get("topo_sim_dcn_axes", "") or "")
+    return frozenset(a.strip() for a in raw.split(",") if a.strip())
 
 
 def make_mesh(axes: Dict[str, int],
@@ -66,13 +89,18 @@ def classify_axes(mesh: Mesh) -> Dict[str, str]:
     'dcn' when moving along it changes the process index on ANY line of
     the mesh, not just the first one (the old first-line probe missed
     meshes whose process boundary only shows up at nonzero coordinates
-    of the other axes). On CPU test meshes everything is 'ici'."""
+    of the other axes). On CPU test meshes everything is 'ici' unless
+    the ``topo_sim_dcn_axes`` override names a simulated slow plane."""
     out = {}
+    sim = sim_dcn_axes()
     devs = np.asarray(mesh.devices)
     procs = np.frompyfunc(
         lambda d: int(getattr(d, "process_index", 0)), 1, 1)(
         devs).astype(np.int64)
     for i, name in enumerate(mesh.axis_names):
+        if name in sim:
+            out[name] = "dcn"
+            continue
         moved = np.moveaxis(procs, i, 0)
         out[name] = "dcn" if bool((moved != moved[:1]).any()) else "ici"
     return out

@@ -136,11 +136,15 @@ def _mesh_platform(mesh: Mesh) -> str:
 
 
 def _decide_buckets(plan: BucketPlan, ndev: int, platform: str,
-                    block: int) -> Tuple[str, ...]:
+                    block: int, plane: Optional[str] = None,
+                    hier_ok: bool = False,
+                    hier_why: str = "") -> Tuple[str, ...]:
     """One decision-layer pass per bucket (coll name ``grad_sync``,
-    arms native|quant) + the audit record feeding explain_last and the
-    bucket pvars.  Runs at trace/build time — once per compiled program,
-    which is exactly how often the arm can change."""
+    arms native|quant|hier|hier+quant — the hier arms only when the
+    sync spans a two-tier dpo×dp split) + the audit record feeding
+    explain_last and the bucket pvars.  Runs at trace/build time — once
+    per compiled program, which is exactly how often the arm can
+    change."""
     from ..coll import xla as _xla
 
     rules = _xla._load_device_rules()
@@ -148,7 +152,8 @@ def _decide_buckets(plan: BucketPlan, ndev: int, platform: str,
     for i, b in enumerate(plan.buckets):
         arm, reason, chain = _xla.decide_mode(
             "grad_sync", b.nbytes, ndev, platform, rules,
-            allowed=("native", "quant"), quant_ok=True, dtype=np.float32)
+            allowed=("native", "quant"), quant_ok=True, dtype=np.float32,
+            plane=plane, hier_ok=hier_ok, hier_why=hier_why)
         arms.append(arm)
         if trace.enabled:
             details = dict(bucket=i, n_buckets=plan.n_buckets,
@@ -168,19 +173,34 @@ def _decide_buckets(plan: BucketPlan, ndev: int, platform: str,
 
 # -- the custom_vjp bucket tag ----------------------------------------------
 
-def _make_bucket_tag(shapes, dtypes, arm: str, axis: str, n: int,
-                     block: int):
+def _make_bucket_tag(shapes, dtypes, arm: str, axis, n: int,
+                     block: int, levels=None):
     """Identity on a tuple of leaves whose backward rule syncs the
     bucket: concatenate the cotangents into one flat f32 vector, ONE
-    allreduce (native pmean or psum_quant per the decided arm), split
-    back.  The rule fires exactly when the backward pass has produced
-    every cotangent in the bucket — the overlap point."""
+    allreduce (native pmean, psum_quant, or the two-tier hierarchical
+    form per the decided arm), split back.  The rule fires exactly when
+    the backward pass has produced every cotangent in the bucket — the
+    overlap point.  ``axis`` may be a tuple of mesh axis names (the
+    dpo×dp sync domain); ``levels`` is ``(inner, outer, n_outer)`` for
+    the hier arms."""
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
 
     def sync(cts):
         parts = [jnp.reshape(c, (-1,)).astype(jnp.float32) for c in cts]
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        if arm == "quant":
+        if arm in ("hier", "hier+quant"):
+            # HAN shape over the two-tier sync domain: RS(inner ICI) →
+            # allreduce(outer DCN, 1/n_inner of the bytes, quantized
+            # for hier+quant) → AG(inner ICI); mean via the static n
+            inner, outer, n_outer = levels
+            from .hierarchy import (hierarchical_psum,
+                                    hierarchical_psum_quant)
+            if arm == "hier+quant":
+                flat = hierarchical_psum_quant(flat, inner, outer,
+                                               n_outer, block=block) / n
+            else:
+                flat = hierarchical_psum(flat, inner, outer) / n
+        elif arm == "quant":
             from ..coll.quant import psum_quant
             flat = psum_quant(flat, axis, n, avg=True, block=block)
         else:
@@ -208,15 +228,15 @@ def _make_bucket_tag(shapes, dtypes, arm: str, axis: str, n: int,
 
 
 def _apply_bucket_tags(leaves: list, plan: BucketPlan,
-                       arms: Sequence[str], axis: str, n: int,
-                       block: int) -> list:
+                       arms: Sequence[str], axis, n: int,
+                       block: int, levels=None) -> list:
     out = list(leaves)
     for b, arm in zip(plan.buckets, arms):
         group = tuple(out[j] for j in b.indices)
         tag = _make_bucket_tag(
             tuple(tuple(x.shape) for x in group),
             tuple(jnp.result_type(x) for x in group),
-            arm, axis, n, block)
+            arm, axis, n, block, levels=levels)
         for j, t in zip(b.indices, tag(group)):
             out[j] = t
     return out
@@ -224,22 +244,34 @@ def _apply_bucket_tags(leaves: list, plan: BucketPlan,
 
 # -- grad-sync builders ------------------------------------------------------
 
+def dp_sync_axes(mesh: Mesh):
+    """The sync domain: ``("dpo", "dp")`` when the mesh carries an
+    outer data-parallel axis (the two-tier ICI×DCN shape the hier arms
+    address by level), else plain ``"dp"``."""
+    return ("dpo", "dp") if "dpo" in mesh.axis_names else "dp"
+
+
 def check_dp_mesh(mesh: Mesh, what: str) -> int:
     """dp-only contract shared with _quant_grad_sync: a shard_map over
-    dp replicates every other axis, which would silently undo tp/sp
-    parameter sharding — refuse instead."""
+    the data-parallel axes replicates every other axis, which would
+    silently undo tp/sp parameter sharding — refuse instead.  An
+    optional ``dpo`` outer data-parallel axis (slice-of-slices DP over
+    DCN) is part of the sync domain, not a sharded dimension."""
     if "dp" not in mesh.axis_names:
         raise ValueError(
             f"{what} needs a 'dp' mesh axis to sync over "
             f"(mesh axes: {mesh.axis_names})")
+    n = mesh.shape["dp"]
     for a in mesh.axis_names:
-        if a != "dp" and mesh.shape[a] > 1:
+        if a == "dpo":
+            n *= mesh.shape[a]
+        elif a != "dp" and mesh.shape[a] > 1:
             raise ValueError(
                 f"{what} is dp-only: the shard_map over dp would "
                 f"replicate axis {a!r} (size {mesh.shape[a]}) and undo "
                 "its parameter sharding; use grad_sync='native' on "
                 "dp×tp/sp meshes")
-    return mesh.shape["dp"]
+    return n
 
 
 def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
@@ -269,42 +301,78 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
     n = check_dp_mesh(mesh, f"grad_sync={mode!r}")
     platform = _mesh_platform(mesh)
     nb = resolve_bucket_bytes(bucket_bytes)
-    data_spec = P(*("dp" if a == "dp" else None for a in mesh.axis_names))
+    sync_axis = dp_sync_axes(mesh)
+    if isinstance(sync_axis, tuple):
+        # batch dim 0 shards over the row-major dpo×dp product; the
+        # two-tier context feeds the hier arms + '@<plane>' rule rows
+        data_spec = P(sync_axis)
+        from .hierarchy import classify_axes, hier_axes
+        h_inner, h_outer, h_why = hier_axes(mesh, sync_axis)
+        kinds = classify_axes(mesh)
+        plane = ("dcn" if any(kinds.get(a) == "dcn" for a in sync_axis)
+                 else "ici")
+        levels = ((h_inner, h_outer, mesh.shape[h_outer])
+                  if h_inner is not None else None)
+    else:
+        data_spec = P(*("dp" if a == "dp" else None
+                        for a in mesh.axis_names))
+        h_inner, h_why = None, "single-axis comm (no inner/outer levels)"
+        plane, levels = None, None
 
     def local(params, batch):
         if mode == "bucketed":
             leaves, _ = jax.tree_util.tree_flatten(params)
             plan = bucket_plan(leaves, nb)
-            arms = _decide_buckets(plan, n, platform, quant_block)
+            arms = _decide_buckets(plan, n, platform, quant_block,
+                                   plane=plane,
+                                   hier_ok=(h_inner is not None),
+                                   hier_why=h_why or "")
             global _last_plan
             _last_plan = (plan, arms)
 
             def tagged_loss(p, t):
                 lv, td = jax.tree_util.tree_flatten(p)
-                lv = _apply_bucket_tags(lv, plan, arms, "dp", n,
-                                        quant_block)
+                lv = _apply_bucket_tags(lv, plan, arms, sync_axis, n,
+                                        quant_block, levels=levels)
                 return local_loss(jax.tree_util.tree_unflatten(td, lv), t)
 
             loss, grads = jax.value_and_grad(tagged_loss)(params, batch)
         else:
             loss, grads = jax.value_and_grad(local_loss)(params, batch)
             if mode == "perleaf":
-                grads = jax.tree.map(lambda g: lax.pmean(g, "dp"), grads)
-        return lax.pmean(loss, "dp"), grads
+                grads = jax.tree.map(
+                    lambda g: lax.pmean(g, sync_axis), grads)
+        return lax.pmean(loss, sync_axis), grads
 
     inner = shard_map(local, mesh=mesh, in_specs=(P(), data_spec),
                       out_specs=(P(), P()))
 
     def _note_traffic(grads):
-        # dp ring-allreduce model of the sync: 2(n-1)/n x grad bytes per
-        # rank (the bucketed arm's quant buckets send less — the matrix
-        # keeps the native-wire convention the busbw factors use)
+        # ring-allreduce model of the sync over the (possibly two-tier)
+        # sync domain: 2(n-1)/n x grad bytes per rank (the bucketed
+        # arm's quant buckets send less — the matrix keeps the
+        # native-wire convention the busbw factors use).  Buckets the
+        # decision layer routed to a hier arm charge the HAN stage
+        # split instead: inner RS/AG rings + the outer ring on the
+        # scattered 1/n_inner fraction.
         from .. import traffic
         if not traffic.enabled or mode == "unsynced" or n < 2:
             return
         tot = sum(g.nbytes for g in jax.tree_util.tree_leaves(grads))
-        traffic.note_ring(mesh, "dp", 2 * (n - 1) * tot // n,
-                          "grad_sync")
+        hier_b = 0
+        if (mode == "bucketed" and _last_plan is not None
+                and levels is not None):
+            plan, arms = _last_plan
+            hier_b = sum(b.nbytes for b, a in zip(plan.buckets, arms)
+                         if a in ("hier", "hier+quant"))
+            hier_b = min(hier_b, tot)
+            if hier_b:
+                traffic.note_hierarchical(mesh, levels[0], levels[1],
+                                          hier_b)
+        flat_b = tot - hier_b
+        if flat_b:
+            traffic.note_ring(mesh, sync_axis,
+                              2 * (n - 1) * flat_b // n, "grad_sync")
 
     def vg(params, batch):
         if isinstance(batch, jax.core.Tracer):

@@ -379,6 +379,110 @@ def run_device_sweep(iters: int, sizes=None):
     return rows, winners
 
 
+def run_hier_sweep(iters: int, sizes=None,
+                   dcn_us_per_mib: float = 200.0):
+    """Hier-vs-flat allreduce sweep on a simulated two-tier mesh: the
+    devices fold into an outer×inner (2 × n/2) grid with the outer axis
+    force-classified DCN (``topo_sim_dcn_axes``), and each size times
+    the flat tuple-axis psum against the staged HAN form (and its
+    quantized-outer composition).  Because the raw kernels run on one
+    host fabric, the DCN skew enters ANALYTICALLY: each arm's measured
+    µs is topped up by its slow-plane bytes × ``dcn_us_per_mib`` — the
+    exact per-arm figures the simulated-DCN shim would charge at
+    dispatch (hierarchy.hier_wire_bytes is the shared source of truth).
+    Winners land under the ``allreduce@dcn`` key, so emit_device_rules
+    writes PER-PLANE rows the '<coll>@<plane>' grammar consumes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as _P
+
+    from ompi_tpu.core import var
+    from ompi_tpu.jaxcompat import shard_map as _shard_map
+    from ompi_tpu.parallel import make_mesh, simdcn
+    from ompi_tpu.parallel.hierarchy import (hier_wire_bytes,
+                                             hierarchical_psum,
+                                             hierarchical_psum_quant)
+
+    ndev = len(jax.devices())
+    if ndev < 4 or ndev % 2:
+        print(f"hier sweep needs an even device count >= 4 (have {ndev});"
+              " skipping", flush=True)
+        return [], {}
+    no, ni = 2, ndev // 2
+    var.registry.set_cli("topo_sim_dcn_axes", "outer")
+    var.registry.reset_cache()
+    simdcn.clear_cache()
+    try:
+        mesh = make_mesh({"outer": no, "inner": ni})
+        spec = _P(("outer", "inner"))
+        rng = np.random.default_rng(0)
+        rows, winners = [], {}
+
+        def timed(fn):
+            fn()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                fn()
+            return (time.perf_counter() - t0) / iters * 1e6
+
+        def build(kind):
+            def fn(xs):
+                flat = xs.reshape(-1)
+                if kind == "hier":
+                    out = hierarchical_psum(flat, "inner", "outer")
+                elif kind == "hier+quant":
+                    out = hierarchical_psum_quant(flat, "inner", "outer",
+                                                  no)
+                else:
+                    out = jax.lax.psum(flat, ("outer", "inner"))
+                return out.reshape(xs.shape)
+            return jax.jit(_shard_map(fn, mesh=mesh, in_specs=spec,
+                                      out_specs=spec))
+
+        fns = {k: build(k) for k in ("native", "hier", "hier+quant")}
+        frac = simdcn.ring_dcn_fraction(mesh, ("outer", "inner"))
+        for nbytes in sizes or DEVICE_SIZES:
+            count = max(ndev, nbytes // 4)
+            count -= count % (ndev * ni)     # divisible: no pad noise
+            x = jax.device_put(
+                jnp.asarray(rng.standard_normal((ndev, count // ndev)),
+                            jnp.float32),
+                jax.sharding.NamedSharding(mesh, spec))
+            x.block_until_ready()
+            per = count // ndev
+            eff = per * 4
+            hw = hier_wire_bytes(per, np.float32, ni, no)
+            hwq = hier_wire_bytes(per, np.float32, ni, no, quant=True)
+            dcn_bytes = {
+                "native": int(2 * (ndev - 1) / ndev * eff * frac),
+                "hier": hw["outer_bytes"],
+                "hier+quant": hwq["outer_bytes"],
+            }
+            arms = {}
+            for kind, fn in fns.items():
+                us = timed(lambda f=fn: f(x).block_until_ready())
+                arms[kind] = us + simdcn.penalty_us(
+                    dcn_bytes[kind], dcn_us_per_mib)
+            mode = min(arms, key=arms.get)
+            rows.append({"coll": "allreduce@dcn", "bytes": eff,
+                         "nominal_bytes": nbytes,
+                         "native_us": round(arms["native"], 1),
+                         "hier_us": round(arms["hier"], 1),
+                         "hier_quant_us": round(arms["hier+quant"], 1),
+                         "dcn_bytes": dcn_bytes,
+                         "winner": mode})
+            winners.setdefault("allreduce@dcn", {})[eff] = mode
+            print(f"device {'allreduce@dcn':14s} {eff:>9d}B  native "
+                  f"{arms['native']:9.1f}us hier {arms['hier']:9.1f}us "
+                  f"hier+quant {arms['hier+quant']:9.1f}us -> {mode}",
+                  flush=True)
+        return rows, winners
+    finally:
+        var.registry.set_cli("topo_sim_dcn_axes", "")
+        var.registry.reset_cache()
+        simdcn.clear_cache()
+
+
 def emit_device_rules(winners: dict, path: str,
                       platform: str = "unknown",
                       provenance: str = None) -> None:
@@ -392,7 +496,8 @@ def emit_device_rules(winners: dict, path: str,
     sweep-measured one across re-emits (rules_provenance round-trips it)."""
     lines = [f"# device decision rules measured by coll_tune --device "
              f"on platform={platform}",
-             "# <coll> <min_ndev> <min_bytes> <native|staged|quant>"]
+             "# <coll>[@<plane>] <min_ndev> <min_bytes> "
+             "<native|staged|quant|hier|hier+quant>"]
     if provenance:
         lines.insert(1, provenance if provenance.startswith("#")
                      else f"# {provenance}")
@@ -567,6 +672,9 @@ def main(argv=None) -> int:
             jax.config.update("jax_platforms", args.platform)
 
         rows, winners = run_device_sweep(args.iters)
+        hrows, hwinners = run_hier_sweep(args.iters)
+        rows += hrows
+        winners.update(hwinners)
         platform = jax.devices()[0].platform
         args.device_rules_out = args.device_rules_out or "DEVICE_RULES.txt"
         emit_device_rules(winners, args.device_rules_out,

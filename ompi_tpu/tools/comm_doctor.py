@@ -32,7 +32,7 @@ from ..trace import merge as _merge
 # bumped whenever any --json report mode changes shape; every mode
 # (default merge, --health-dump, --perf, --traffic, --live) emits it so
 # downstream tooling can detect drift (ISSUE 7 satellite)
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -283,6 +283,24 @@ def build_traffic_report(
         w("  per-collective attribution: " + ", ".join(
             f"{k}={v}B" for k, v in
             sorted(pc.items(), key=lambda kv: -kv[1])[:8]))
+    hier = rep.get("hier")
+    if hier and hier.get("count"):
+        ni = int(hier.get("n_inner") or 0)
+        inner_b = int(hier["inner_bytes"])
+        outer_b = int(hier["outer_bytes"])
+        expect = int(hier["expected_outer_bytes"])
+        w(f"  hierarchical split: {int(hier['count'])} collective(s), "
+          f"inner (ICI) {inner_b} B vs outer (DCN) {outer_b} B "
+          f"(expected <= {expect} B at 1/{ni or '?'} of the buffer)")
+        if outer_b > expect:
+            w("  !! HIER SPLIT BREACH: outer-plane bytes exceed the "
+              f"expected 1/{ni or '?'} fraction — the slow-plane cut "
+              "the hier arm exists for is NOT happening (quantized "
+              "outer inflated by block padding, or a stage charged to "
+              "the wrong plane)")
+        else:
+            w("  hier outer plane within the expected 1/n_inner "
+              "fraction")
     verd = rep.get("verdicts") or []
     if verd:
         w(f"  HOT LINK: {int(rep.get('hotlink_trips', 0))} sentry "

@@ -32,13 +32,21 @@ import numpy as np
 Edge = Tuple[int, int]          # (src, dst) global flat device positions
 
 
-def _axis_lines(mesh: Any, axis: str) -> np.ndarray:
+def _axis_lines(mesh: Any, axis) -> np.ndarray:
     """(n_lines, axis_size) of global flat device positions: one row per
-    line along ``axis`` (every combination of the other axes' coords)."""
+    line along ``axis`` (every combination of the other axes' coords).
+    A TUPLE of axis names is the row-major flattened super-axis — the
+    ring a flat collective over a two-tier comm actually schedules."""
     devs = np.asarray(mesh.devices)
-    ax = tuple(mesh.axis_names).index(axis)
+    names = tuple(mesh.axis_names)
+    axes = tuple(axis) if isinstance(axis, (tuple, list)) else (axis,)
+    src = tuple(names.index(a) for a in axes)
     idx = np.arange(devs.size).reshape(devs.shape)
-    return np.moveaxis(idx, ax, -1).reshape(-1, devs.shape[ax])
+    idx = np.moveaxis(idx, src, tuple(range(-len(src), 0)))
+    size = 1
+    for a in axes:
+        size *= devs.shape[names.index(a)]
+    return idx.reshape(-1, size)
 
 
 def ring_edges(mesh: Any, axis: str, direction: str = "fwd") -> List[Edge]:

@@ -40,12 +40,37 @@ def _procs(mesh: Any) -> List[int]:
     return got
 
 
+def _sim_slabs(mesh: Any) -> List[Any]:
+    """Per-flat-position slice id under the sim-DCN override: the
+    coordinate tuple along the overridden axes (uncached — the override
+    can change mid-process via set_cli, unlike real process indices)."""
+    from ..parallel.mesh import sim_dcn_axes
+    sim = sim_dcn_axes()
+    if not sim:
+        return []
+    names = tuple(mesh.axis_names)
+    dims = [i for i, a in enumerate(names) if a in sim]
+    if not dims:
+        return []
+    shape = np.asarray(mesh.devices).shape
+    return [tuple(np.unravel_index(i, shape)[k] for k in dims)
+            for i in range(int(np.prod(shape)))]
+
+
 def plane_fn(mesh: Any) -> Callable[[int, int], str]:
-    """(src, dst) -> 'ici' | 'dcn' for global flat device positions."""
+    """(src, dst) -> 'ici' | 'dcn' for global flat device positions.
+    An edge is 'dcn' when its endpoints live in different processes OR
+    on opposite sides of a simulated slice boundary
+    (``topo_sim_dcn_axes``) — the edge-level view of classify_axes."""
     procs = _procs(mesh)
+    slabs = _sim_slabs(mesh)
 
     def plane_of(src: int, dst: int) -> str:
-        return "dcn" if procs[src] != procs[dst] else "ici"
+        if procs[src] != procs[dst]:
+            return "dcn"
+        if slabs and slabs[src] != slabs[dst]:
+            return "dcn"
+        return "ici"
 
     return plane_of
 

@@ -1,0 +1,552 @@
+"""Hierarchical multi-plane collective tier (parallel/hierarchy +
+coll/xla hier arms): the HAN split as a first-class decision arm on
+two-tier ICI×DCN comms, CI-driven through the simulated-DCN override
+(`topo_sim_dcn_axes` folds the 8-device CPU fabric into an outer×inner
+pod).
+
+Acceptance pins (ISSUE): non-divisible buffers pad exactly (padded ==
+unpadded numerics); '<coll>@<plane>' rule rows load, beat base rows,
+and reject unknown planes loudly; hier eligibility is audited (a
+single-plane comm records `ineligible:hier:<why>`, a per-entry force of
+an impossible hier raises); hier+quant quantizes ONLY the outer stage
+(inner bytes identical to plain hier); and the traffic ledger's
+inner/outer split plus comm_doctor's verdict line read the same
+hier_wire_bytes figures the decision audit banks.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from ompi_tpu import trace  # noqa: E402
+import ompi_tpu.traffic as traffic  # noqa: E402
+from ompi_tpu.coll.xla import (  # noqa: E402
+    XlaModule,
+    _load_device_rules,
+    decide_mode,
+)
+from ompi_tpu.core import var  # noqa: E402
+from ompi_tpu.parallel import (  # noqa: E402
+    DeviceComm,
+    classify_axes,
+    make_mesh,
+    simdcn,
+)
+from ompi_tpu.parallel.hierarchy import (  # noqa: E402
+    auto_levels,
+    hier_axes,
+    hier_wire_bytes,
+    hierarchical_allreduce,
+)
+
+pytestmark = pytest.mark.hier
+
+
+@pytest.fixture
+def cli():
+    """CLI-source var setter that restores every touched knob (and the
+    simdcn fraction cache, which keys on the classification)."""
+    touched = []
+
+    def _set(name, value):
+        var.registry.set_cli(name, str(value))
+        touched.append(name)
+        var.registry.reset_cache()
+        simdcn.clear_cache()
+
+    yield _set
+    for name in touched:
+        var.registry.clear_cli(name)
+    var.registry.reset_cache()
+    simdcn.clear_cache()
+
+
+@pytest.fixture
+def traced():
+    trace.enable(capacity=65536)
+    yield
+    trace.disable()
+
+
+@pytest.fixture
+def plane():
+    traffic.enable()
+    traffic.reset()
+    yield
+    traffic.disable()
+    traffic.reset()
+
+
+class FakeComm:
+    """Just enough comm for XlaModule: the attached DeviceComm plus the
+    attributes the host-fallback TunedModule and the audit read."""
+
+    name = "hier-test"
+    size = 8
+    rank = 0
+    is_inter = False
+    ctx = None
+    spc = None
+
+    def __init__(self, dc):
+        self.device_comm = dc
+        self.device_mesh = dc.mesh
+        self.device_axis = dc.axis
+
+
+def _two_tier(cli, outer=2, inner=4):
+    """A simulated two-tier mesh: outer axis force-classified DCN."""
+    cli("topo_sim_dcn_axes", "outer")
+    return make_mesh({"outer": outer, "inner": inner})
+
+
+# -- eligibility + classification (satellite 3) ------------------------------
+
+class TestEligibility:
+    def test_sim_dcn_override_classifies(self, cli):
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        assert set(classify_axes(mesh).values()) == {"ici"}
+        cli("topo_sim_dcn_axes", "outer")
+        kinds = classify_axes(mesh)
+        assert kinds == {"outer": "dcn", "inner": "ici"}
+
+    @pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+    def test_auto_levels_two_tier(self, cli, shape):
+        no, ni = shape
+        mesh = _two_tier(cli, outer=no, inner=ni)
+        assert auto_levels(mesh) == ("inner", "outer")
+
+    @pytest.mark.parametrize("shape", [(2, 4), (4, 2)])
+    def test_hier_axes_eligible(self, cli, shape):
+        no, ni = shape
+        mesh = _two_tier(cli, outer=no, inner=ni)
+        inner, outer, why = hier_axes(mesh, ("outer", "inner"))
+        assert (inner, outer, why) == ("inner", "outer", None)
+
+    def test_single_axis_comm_veto(self, cli):
+        mesh = _two_tier(cli)
+        inner, outer, why = hier_axes(mesh, "inner")
+        assert inner is None and outer is None
+        assert "single-axis" in why
+
+    def test_single_plane_mesh_veto(self):
+        # no sim override: the CPU fabric is all-ICI, no slow tier
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        inner, outer, why = hier_axes(mesh, ("outer", "inner"))
+        assert inner is None
+        assert "single-plane" in why
+
+    def test_all_dcn_veto(self, cli):
+        cli("topo_sim_dcn_axes", "outer,inner")
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        inner, outer, why = hier_axes(mesh, ("outer", "inner"))
+        assert inner is None
+        assert "no ICI axis" in why
+
+    def test_degenerate_outer_veto(self, cli):
+        # the single-slice pod: a size-1 DCN level buys nothing
+        cli("topo_sim_dcn_axes", "outer")
+        mesh = make_mesh({"outer": 1, "inner": 8})
+        inner, outer, why = hier_axes(mesh, ("outer", "inner"))
+        assert inner is None
+        assert "degenerate" in why and "outer" in why
+
+
+# -- the padding fix (satellite 1) -------------------------------------------
+
+class TestPadding:
+    @pytest.mark.parametrize("length", [8, 7, 5, 1])
+    def test_padded_matches_unpadded_numerics(self, cli, length):
+        # ni = 4: length 8 takes the unpadded path, 7/5/1 pad to the
+        # next multiple and slice back — exact for a sum, so every
+        # length must match the flat reference to the same tolerance
+        mesh = _two_tier(cli)
+        rng = np.random.default_rng(length)
+        x = rng.standard_normal((2, 4, length)).astype(np.float32)
+        out = hierarchical_allreduce(jnp.asarray(x), mesh, "inner", "outer")
+        ref = np.broadcast_to(x.sum((0, 1)), x.shape)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                                   atol=1e-5)
+
+
+# -- '<coll>@<plane>' rule rows (satellite 2) --------------------------------
+
+class TestPlaneRules:
+    def _write(self, tmp_path, text):
+        p = tmp_path / "rules.txt"
+        p.write_text(text)
+        return str(p)
+
+    def test_loader_accepts_plane_rows(self, tmp_path):
+        path = self._write(tmp_path, "allreduce 1 0 native\n"
+                                     "allreduce@dcn 4 0 hier\n"
+                                     "grad_sync@ici 1 0 native\n")
+        assert _load_device_rules(path) == [
+            ("allreduce", 1, 0, "native"),
+            ("allreduce@dcn", 4, 0, "hier"),
+            ("grad_sync@ici", 1, 0, "native"),
+        ]
+
+    def test_loader_unknown_plane_is_loud(self, tmp_path):
+        path = self._write(tmp_path, "allreduce@hbm 1 0 native\n")
+        with pytest.raises(ValueError, match="unknown plane"):
+            _load_device_rules(path)
+
+    def test_loader_empty_base_is_loud(self, tmp_path):
+        path = self._write(tmp_path, "@dcn 1 0 hier\n")
+        with pytest.raises(ValueError, match="unknown plane"):
+            _load_device_rules(path)
+
+    def test_loader_hier_modes_in_vocabulary(self, tmp_path):
+        path = self._write(tmp_path, "allreduce 1 0 hier\n"
+                                     "allreduce 1 1024 hier+quant\n")
+        modes = [mode for *_, mode in _load_device_rules(path)]
+        assert modes == ["hier", "hier+quant"]
+
+    def test_plane_row_beats_base_row(self):
+        rules = [("allreduce", 1, 0, "native"),
+                 ("allreduce@dcn", 1, 0, "hier")]
+        arm, reason, _ = decide_mode(
+            "allreduce", 1 << 20, 8, "cpu", rules,
+            ("native", "staged", "quant"), plane="dcn", hier_ok=True)
+        assert arm == "hier"
+        assert reason == "rule:allreduce@dcn 1 0 hier"
+
+    @pytest.mark.parametrize("plane", [None, "ici"])
+    def test_plane_row_ignored_off_plane(self, plane):
+        rules = [("allreduce", 1, 0, "native"),
+                 ("allreduce@dcn", 1, 0, "hier")]
+        arm, reason, _ = decide_mode(
+            "allreduce", 1 << 20, 8, "cpu", rules,
+            ("native", "staged", "quant"), plane=plane, hier_ok=True)
+        assert arm == "native"
+        assert reason == "rule:allreduce 1 0 native"
+
+    def test_vetoed_plane_row_keeps_pick_owns_reason(self):
+        # an ineligible comm: the plane row's hier cannot run, the base
+        # pick carries the call, but the veto IS the audited word
+        rules = [("allreduce", 1, 0, "native"),
+                 ("allreduce@dcn", 1, 0, "hier")]
+        arm, reason, chain = decide_mode(
+            "allreduce", 1 << 20, 8, "cpu", rules,
+            ("native", "staged", "quant"), plane="dcn",
+            hier_ok=False, hier_why="single-axis comm")
+        assert arm == "native"
+        assert "ineligible:hier:single-axis comm" in reason
+        assert any("vetoed rule:allreduce@dcn" in c for c in chain)
+
+    def test_forced_hier_on_ineligible_comm_raises(self, cli):
+        cli("coll_xla_allreduce_mode", "hier")
+        with pytest.raises(ValueError, match="ineligible"):
+            decide_mode("allreduce", 1 << 20, 8, "cpu", [],
+                        ("native", "staged", "quant"),
+                        hier_ok=False, hier_why="single-plane mesh")
+
+    def test_blanket_hier_skip_is_audited(self, cli):
+        cli("coll_xla_mode", "hier")
+        arm, _, chain = decide_mode(
+            "allreduce", 1 << 20, 8, "cpu", [],
+            ("native", "staged", "quant"),
+            hier_ok=False, hier_why="single-plane mesh")
+        assert arm == "native"
+        assert any("ineligible:hier:single-plane mesh" in c for c in chain)
+
+    def test_emit_load_roundtrip(self, tmp_path):
+        from ompi_tpu.tools.coll_tune import emit_device_rules
+
+        path = str(tmp_path / "rules.txt")
+        emit_device_rules({"allreduce@dcn": {0: "hier",
+                                             131072: "native"}},
+                          path, platform="cpu")
+        assert _load_device_rules(path) == [
+            ("allreduce@dcn", 1, 0, "hier"),
+            ("allreduce@dcn", 1, 131072, "native"),
+        ]
+
+
+# -- the wire model (single source of truth) ---------------------------------
+
+class TestWireModel:
+    def test_native_stage_math(self):
+        hw = hier_wire_bytes(1024, np.float32, ni=4, no=2)
+        assert hw["inner_stage_bytes"] == 3072      # (ni-1)/ni * 4096
+        assert hw["inner_bytes"] == 6144            # RS + AG
+        assert hw["outer_bytes"] == 1024            # 2(no-1)/no * 4096/ni
+        assert hw["outer_native_bytes"] == 1024
+        assert hw["total_bytes"] == 7168
+        assert hw["ratio"] is None
+
+    def test_outer_conserves_flat_fraction(self):
+        # the algorithm's whole point: outer_bytes * ni == the flat
+        # ring's wire bytes — the slow plane carries exactly 1/ni
+        count, ni, no = 1 << 18, 4, 2
+        nbytes = count * 4
+        hw = hier_wire_bytes(count, np.float32, ni=ni, no=no)
+        assert hw["outer_bytes"] * ni == 2 * (no - 1) * nbytes // no
+
+    def test_quant_shrinks_only_outer(self):
+        native = hier_wire_bytes(1 << 20, np.float32, ni=4, no=2)
+        quant = hier_wire_bytes(1 << 20, np.float32, ni=4, no=2,
+                                quant=True)
+        assert quant["inner_bytes"] == native["inner_bytes"]
+        assert quant["outer_bytes"] < native["outer_native_bytes"]
+        assert 0 < quant["ratio"] < 1
+
+    def test_degenerate_inner(self):
+        hw = hier_wire_bytes(1024, np.float32, ni=1, no=2)
+        assert hw["inner_bytes"] == 0
+        assert hw["outer_bytes"] == hw["total_bytes"]
+
+
+# -- the hier arm end-to-end (tentpole) --------------------------------------
+
+class TestHierDispatch:
+    def _module(self, mesh):
+        dc = DeviceComm(mesh, ("outer", "inner"))
+        comm = FakeComm(dc)
+        return comm, XlaModule(comm)
+
+    def test_attach_time_plane_context(self, cli):
+        mesh = _two_tier(cli)
+        _, mod = self._module(mesh)
+        assert mod._plane == "dcn"
+        assert (mod._hier_inner, mod._hier_outer) == ("inner", "outer")
+
+    def test_forced_hier_numerics_audit_and_traffic(self, cli, traced,
+                                                    plane):
+        mesh = _two_tier(cli)
+        comm, mod = self._module(mesh)
+        cli("coll_xla_allreduce_mode", "hier")
+        x = jnp.arange(8 * 16, dtype=jnp.float32).reshape(8, 16)
+        out = mod.allreduce(comm, x)
+        ref = np.broadcast_to(np.asarray(x).sum(0, keepdims=True), x.shape)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
+
+        hw = hier_wire_bytes(16, np.float32, ni=4, no=2)
+        rec = trace.explain_last("allreduce")
+        assert rec["arm"] == "hier"
+        assert rec["reason"].startswith("force:")
+        assert rec["hier_inner"] == "inner"
+        assert rec["hier_outer"] == "outer"
+        assert rec["hier_inner_bytes"] == hw["inner_bytes"]
+        assert rec["hier_outer_bytes"] == hw["outer_bytes"]
+        assert rec["wire_bytes"] == hw["total_bytes"]
+
+        rep = traffic.report()
+        hier = rep["hier"]
+        assert hier["count"] == 1
+        assert hier["n_inner"] == 4
+        assert hier["inner_bytes"] == hw["inner_bytes"]
+        assert hier["outer_bytes"] == hw["outer_bytes"]
+        assert hier["expected_outer_bytes"] == hw["outer_native_bytes"]
+        # conservation: both planes hold exactly the audited stage bytes
+        assert rep["unattributed_bytes"] == 0
+        assert rep["planes"].get("dcn", 0) == hw["outer_bytes"]
+        assert rep["planes"].get("ici", 0) == hw["inner_bytes"]
+
+    @pytest.mark.parametrize("arm,tol", [("hier", 1e-6),
+                                         ("hier+quant", 2e-2)])
+    def test_non_divisible_count(self, cli, arm, tol):
+        # 7 floats/rank over ni=4: the padded path end to end
+        mesh = _two_tier(cli)
+        comm, mod = self._module(mesh)
+        cli("coll_xla_allreduce_mode", arm)
+        y = jnp.arange(8 * 7, dtype=jnp.float32).reshape(8, 7) / 7.0
+        out = mod.allreduce(comm, y)
+        ref = np.broadcast_to(np.asarray(y).sum(0, keepdims=True), y.shape)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=tol, atol=tol)
+
+    def test_hier_quant_outer_stage_only(self, cli, traced, plane):
+        mesh = _two_tier(cli)
+        comm, mod = self._module(mesh)
+        count = 1 << 14                  # past the quant block padding
+        x = jnp.ones((8, count), jnp.float32)
+
+        cli("coll_xla_allreduce_mode", "hier")
+        mod.allreduce(comm, x)
+        base = dict(traffic.report()["hier"])
+        traffic.reset()
+
+        cli("coll_xla_allreduce_mode", "hier+quant")
+        out = mod.allreduce(comm, x)
+        np.testing.assert_allclose(np.asarray(out), 8.0, rtol=2e-2)
+        hq = traffic.report()["hier"]
+        # inner stages bitwise-native: identical ICI bytes; only the
+        # outer (DCN) figure shrinks, and the audit records the ratio
+        assert hq["inner_bytes"] == base["inner_bytes"]
+        assert hq["outer_bytes"] < base["outer_bytes"]
+        assert hq["expected_outer_bytes"] == base["expected_outer_bytes"]
+        rec = trace.explain_last("allreduce")
+        assert rec["arm"] == "hier+quant"
+        assert 0 < rec["quant_ratio"] < 1
+
+    def test_forced_hier_on_flat_comm_raises(self, cli):
+        # no sim override: all-ICI mesh, per-entry force must fail loudly
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        comm, mod = self._module(mesh)
+        cli("coll_xla_allreduce_mode", "hier")
+        x = jnp.ones((8, 4), jnp.float32)
+        with pytest.raises(ValueError, match="ineligible"):
+            mod.allreduce(comm, x)
+
+    def test_blanket_hier_on_flat_comm_audited(self, cli, traced):
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        comm, mod = self._module(mesh)
+        cli("coll_xla_mode", "hier")
+        x = jnp.ones((8, 4), jnp.float32)
+        mod.allreduce(comm, x)
+        rec = trace.explain_last("allreduce")
+        assert rec["arm"] == "native"
+        assert any("ineligible:hier" in c for c in rec["chain"])
+
+    def test_plane_rule_drives_hier(self, cli, traced, tmp_path):
+        rules = tmp_path / "rules.txt"
+        rules.write_text("allreduce 1 0 native\n"
+                         "allreduce@dcn 1 0 hier\n")
+        cli("coll_xla_dynamic_rules", str(rules))
+        mesh = _two_tier(cli)
+        comm, mod = self._module(mesh)     # rules load at attach
+        x = jnp.ones((8, 8), jnp.float32)
+        out = mod.allreduce(comm, x)
+        np.testing.assert_allclose(np.asarray(out), 8.0, rtol=1e-6)
+        rec = trace.explain_last("allreduce")
+        assert rec["arm"] == "hier"
+        assert rec["reason"] == "rule:allreduce@dcn 1 0 hier"
+
+
+# -- the bucketed grad_sync hier arm -----------------------------------------
+
+class TestGradSyncHier:
+    def _setup(self, cli):
+        cli("topo_sim_dcn_axes", "dpo")
+        mesh = make_mesh({"dpo": 2, "dp": 4})
+        params = {"w": jnp.ones((8, 16)), "b": jnp.zeros((17,)),
+                  "v": jnp.ones((5,))}
+        batch = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+
+        def local_loss(p, t):
+            return (jnp.sum(p["w"]) * jnp.mean(t)
+                    + jnp.sum(p["b"] ** 2)
+                    + jnp.sum(p["v"] * jnp.mean(t)))
+
+        return mesh, params, batch, local_loss
+
+    @pytest.mark.parametrize("arm,tol", [("hier", 1e-6),
+                                         ("hier+quant", 2e-2)])
+    def test_matches_perleaf(self, cli, plane, arm, tol):
+        from ompi_tpu.parallel.overlap import make_grad_sync
+
+        mesh, params, batch, local_loss = self._setup(cli)
+        l0, g0 = make_grad_sync("perleaf", mesh, local_loss)(params, batch)
+        cli("coll_xla_grad_sync_mode", arm)
+        vg = make_grad_sync("bucketed", mesh, local_loss, bucket_bytes=256)
+        l1, g1 = vg(params, batch)
+        np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+        for k in g0:
+            np.testing.assert_allclose(np.asarray(g1[k]),
+                                       np.asarray(g0[k]),
+                                       rtol=tol, atol=tol)
+        # the hier buckets landed on the ledger's inner/outer split
+        hier = traffic.report().get("hier")
+        assert hier and hier["count"] >= 1 and hier["n_inner"] == 4
+
+    def test_forced_hier_on_flat_dp_raises(self, cli):
+        from ompi_tpu.parallel.overlap import make_grad_sync
+
+        mesh = make_mesh({"dp": 8})
+        cli("coll_xla_grad_sync_mode", "hier")
+        vg = make_grad_sync("bucketed", mesh,
+                            lambda p, t: jnp.sum(p["w"]) * jnp.mean(t),
+                            bucket_bytes=256)
+        with pytest.raises(ValueError, match="ineligible"):
+            vg({"w": jnp.ones((4, 4))},
+               jnp.ones((8, 2), jnp.float32))
+
+
+# -- the traffic ledger + comm_doctor verdict (satellite 6) ------------------
+
+class TestTrafficVerdict:
+    def test_note_hierarchical_ledger(self, cli, plane):
+        mesh = _two_tier(cli)
+        nbytes = 1 << 20
+        traffic.note_hierarchical(mesh, "inner", "outer", nbytes)
+        rep = traffic.report()
+        hier = rep["hier"]
+        assert hier["count"] == 1
+        assert hier["inner_bytes"] == 2 * int(3 / 4 * nbytes)
+        assert hier["outer_bytes"] == int(2 * (1 / 2) * (nbytes // 4))
+        assert hier["expected_outer_bytes"] == hier["outer_bytes"]
+        assert rep["unattributed_bytes"] == 0
+
+    def test_reset_clears_ledger(self, cli, plane):
+        mesh = _two_tier(cli)
+        traffic.note_hierarchical(mesh, "inner", "outer", 4096)
+        traffic.reset()
+        assert "hier" not in traffic.report()
+
+    def test_doctor_verdict_within(self, cli, plane):
+        from ompi_tpu.tools.comm_doctor import build_traffic_report
+
+        mesh = _two_tier(cli)
+        traffic.note_hierarchical(mesh, "inner", "outer", 1 << 20)
+        text, _ = build_traffic_report()
+        assert "hierarchical split" in text
+        assert "within the expected 1/n_inner fraction" in text
+        assert "HIER SPLIT BREACH" not in text
+
+    def test_doctor_verdict_breach(self, cli, plane):
+        from ompi_tpu.tools.comm_doctor import build_traffic_report
+
+        mesh = _two_tier(cli)
+        # outer charged above the native expectation: the quant-padding
+        # inflation case on tiny buffers, or a wrong split — flagged
+        traffic.note_hier_split(mesh, "inner", "outer", 100, 500,
+                                expected_outer=50)
+        text, _ = build_traffic_report()
+        assert "HIER SPLIT BREACH" in text
+
+    def test_schema_version_bumped(self):
+        from ompi_tpu.tools.comm_doctor import SCHEMA_VERSION
+
+        assert SCHEMA_VERSION >= 3
+
+
+# -- the simulated-DCN delay shim --------------------------------------------
+
+class TestSimDcn:
+    def test_ring_dcn_fraction(self, cli):
+        simdcn.clear_cache()
+        mesh = make_mesh({"outer": 2, "inner": 4})
+        assert simdcn.ring_dcn_fraction(mesh, ("outer", "inner")) == 0.0
+        cli("topo_sim_dcn_axes", "outer")
+        # the flat 8-ring in (outer, inner) row-major order crosses the
+        # outer boundary on 2 of its 8 hops
+        frac = simdcn.ring_dcn_fraction(mesh, ("outer", "inner"))
+        assert frac == pytest.approx(0.25)
+
+    def test_penalty_math(self, cli):
+        assert simdcn.us_per_mib() == 0.0
+        cli("topo_sim_dcn_us_per_mib", "50.0")
+        assert simdcn.us_per_mib() == 50.0
+        assert simdcn.penalty_us(2 << 20) == pytest.approx(100.0)
+        assert simdcn.penalty_us(1 << 20, 100.0) == pytest.approx(100.0)
+
+
+# -- the coll_tune hier sweep ------------------------------------------------
+
+class TestHierSweep:
+    def test_sweep_emits_plane_rows(self, tmp_path):
+        from ompi_tpu.tools.coll_tune import (emit_device_rules,
+                                              run_hier_sweep)
+
+        rows, winners = run_hier_sweep(1, sizes=[64 << 10])
+        assert rows and all(r["coll"] == "allreduce@dcn" for r in rows)
+        assert set(winners) == {"allreduce@dcn"}
+        assert all(m in ("native", "hier", "hier+quant")
+                   for m in winners["allreduce@dcn"].values())
+        path = str(tmp_path / "rules.txt")
+        emit_device_rules(winners, path, platform="cpu")
+        loaded = _load_device_rules(path)
+        assert loaded and all(c == "allreduce@dcn" for c, *_ in loaded)
