@@ -6,7 +6,8 @@
 SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
-	health-tests perf-tests traffic-tests hier-tests bench-compare
+	health-tests perf-tests traffic-tests hier-tests numerics-tests \
+	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -18,8 +19,11 @@ SHELL := /bin/bash
 # fleet's matrix must attribute to the exact hot edge, conservation held;
 # the hier gate rides last — its probe folds the 8 devices into a
 # simulated 2x4 ICI×DCN pod and fails unless the hier arm beats flat
-# wall-clock while moving exactly 1/n_inner of the bytes on the slow plane
-tier1: health-tests perf-tests traffic-tests hier-tests
+# wall-clock while moving exactly 1/n_inner of the bytes on the slow
+# plane; the numerics gate watches the payload itself — its probe
+# injects a NaN and a bit flip the plane must attribute to the exact
+# (rank, step, op) / (step, bucket, rank)
+tier1: health-tests perf-tests traffic-tests hier-tests numerics-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -87,6 +91,16 @@ hier-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_hier.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --pod
+
+# the numerics tier: probes/sentries/divergence-auditor suite, then the
+# end-to-end probe (8-dev comm with an injected NaN + a bit-flipped
+# replica; exits nonzero unless both are attributed to exactly the
+# injected (rank, step, op) and (step, bucket, rank); banks
+# NUMERICS_<platform>.json)
+numerics-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_numerics.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --numerics
 
 # regression gate over the banked trajectory artifact: non-zero exit
 # names every phase whose busbw/goodput/MFU column lost >10% (run it

@@ -2022,6 +2022,142 @@ def run_pod_probe(platform: str) -> None:
         trace.disable()
 
 
+def run_numerics_probe(platform: str) -> None:
+    """--numerics: end-to-end acceptance for the numerics plane.  On an
+    8-device comm, runs clean allreduce steps and then injects ONE NaN
+    into rank 5's contribution at step 2 — the non-finite sentry must
+    attribute the episode to exactly (rank 5, step 2, op allreduce)
+    with origin 'input' and emit the ``numerics_nonfinite`` trace
+    instant; quant collectives must land live SNR samples near the
+    EQuARX baseline.  Then 4 threaded replicas publish identical
+    post-sync gradient buckets except rank 2, whose buffer has one BIT
+    flipped — every replica's divergence audit must name exactly
+    (step 7, bucket 0, rank 2).  Banks NUMERICS_<platform>.json; exits
+    non-zero on any missed or mis-attributed verdict."""
+    import jax
+
+    from ompi_tpu import numerics, runtime, trace
+    from ompi_tpu.core import var
+    from ompi_tpu.numerics import consistency
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"numerics probe: needs 8 devices, have {ndev}")
+
+    INJ_RANK, INJ_STEP, INJ_OP = 5, 2, "allreduce"
+    DIV_RANK, DIV_STEP, DIV_BUCKET = 2, 7, 0
+
+    var.registry.set_cli("numerics_enabled", "true")
+    var.registry.reset_cache()
+    numerics.reset()
+    numerics.enable()
+    trace.enable()
+    try:
+        # -- phase A: non-finite origin attribution + live quant SNR --
+        def fn(ctx):
+            c = ctx.comm_world
+            attach_mesh(c, make_mesh({"x": 8}), "x")
+            d = c.device_comm
+            rng = np.random.default_rng(0)
+            for step in range(4):
+                numerics.begin_step(step)
+                rows = [rng.standard_normal(4096).astype(np.float32)
+                        for _ in range(8)]
+                if step == INJ_STEP:
+                    rows[INJ_RANK][17] = np.nan   # the injected origin
+                x = d.from_ranks(rows)
+                c.coll.allreduce(c, x)
+                # quant arm: the dequant-path SNR sample source
+                xq = d.from_ranks(
+                    [rng.standard_normal(4096).astype(np.float32)
+                     for _ in range(8)])
+                d.quant.allreduce(xq)
+            snap = ctx.spc.snapshot()
+            return {k: float(snap[k]) for k in numerics.PVARS}
+
+        res = runtime.run_ranks(1, fn)[0]
+        nf_verdicts = numerics.nonfinite.verdicts()
+        nf_events = [e for e in trace.events()
+                     if e.get("name") == "numerics_nonfinite"]
+        snr_samples = numerics.snr.samples()
+
+        # -- phase B: cross-replica divergence (bit flip on one rank) --
+        def replica(ctx):
+            buf = np.arange(1024, dtype=np.float32)
+            if ctx.rank == DIV_RANK:
+                # one flipped mantissa bit: invisible to every
+                # metadata sentry, bitwise-visible to the auditor
+                buf.view(np.uint32)[13] ^= 1
+            buckets = [consistency.bucket_summary(buf, arm="native")]
+            return numerics.audit_replicas(ctx, DIV_STEP, buckets)
+
+        audits = runtime.run_ranks(4, replica)
+
+        rep = numerics.report()
+        doc = {
+            "metric": "numerics_attribution",
+            "value": len(nf_verdicts),
+            "unit": "non-finite episodes (must be exactly 1, "
+                    "attributed to the injected rank/step/op)",
+            "platform": platform, "ndev": ndev,
+            "injected": {"rank": INJ_RANK, "step": INJ_STEP,
+                         "op": INJ_OP},
+            "nonfinite_verdicts": nf_verdicts,
+            "snr_db_last": res["numerics_snr_db"],
+            "snr_sample_count": len(snr_samples),
+            "divergence_injected": {"rank": DIV_RANK, "step": DIV_STEP,
+                                    "bucket": DIV_BUCKET},
+            "divergence_first": [a["first"] for a in audits],
+            "pvars": res,
+            "report": rep,
+        }
+        with open(os.path.join(here, f"NUMERICS_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k != "report"}), flush=True)
+
+        if len(nf_verdicts) != 1:
+            raise SystemExit(
+                "numerics probe: expected exactly one non-finite "
+                f"episode, got {len(nf_verdicts)}")
+        v = nf_verdicts[0]
+        if (v["rank"], v["step"], v["op"]) != (INJ_RANK, INJ_STEP,
+                                               INJ_OP):
+            raise SystemExit(
+                "numerics probe: episode attributed to "
+                f"(rank {v['rank']}, step {v['step']}, op {v['op']!r}); "
+                f"injected (rank {INJ_RANK}, step {INJ_STEP}, "
+                f"op {INJ_OP!r})")
+        if v["origin"] != "input" or v["origin_ranks"] != [INJ_RANK]:
+            raise SystemExit(
+                "numerics probe: origin attribution wrong — "
+                f"origin={v['origin']!r} origin_ranks={v['origin_ranks']}"
+                f" (the NaN was injected into rank {INJ_RANK}'s input)")
+        if not nf_events:
+            raise SystemExit("numerics probe: no numerics_nonfinite "
+                             "trace instant emitted")
+        if not snr_samples or res["numerics_snr_db"] <= 0:
+            raise SystemExit(
+                "numerics probe: quant collectives produced no live "
+                f"SNR samples (last_db={res['numerics_snr_db']})")
+        want_first = {"step": DIV_STEP, "bucket": DIV_BUCKET,
+                      "rank": DIV_RANK}
+        for r, a in enumerate(audits):
+            if a is None or a["first"] != want_first:
+                raise SystemExit(
+                    f"numerics probe: rank {r}'s divergence audit named "
+                    f"{None if a is None else a['first']}, the bit flip "
+                    f"was injected on {want_first}")
+    finally:
+        var.registry.clear_cli("numerics_enabled")
+        var.registry.reset_cache()
+        numerics.disable()
+        trace.disable()
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--compare" in argv:
@@ -2064,6 +2200,9 @@ def main() -> None:
             return
         if "--pod" in sys.argv[1:]:
             run_pod_probe(platform)
+            return
+        if "--numerics" in sys.argv[1:]:
+            run_numerics_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

@@ -24,10 +24,15 @@ bootstrap exactly like every other collective bring-up step.
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import jax
+
+CHECKSUM_FILE = "ompi_tpu_checksums.json"
+_HASH_CHUNK = 1 << 20
 
 
 def _ocp():
@@ -35,11 +40,90 @@ def _ocp():
     return ocp
 
 
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint shard failed its blake2s verification on load.
+
+    Recovery (ft/__init__: detect → revoke → shrink → restore) must not
+    restore silently corrupted state — a flipped bit in a shard file
+    would re-inject exactly the divergence the numerics plane exists to
+    catch, one step after the rebuild."""
+
+
+def _file_digest(path: str) -> str:
+    h = hashlib.blake2s(digest_size=16)
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(_HASH_CHUNK), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _shard_files(path: str) -> Dict[str, str]:
+    """Relative path -> digest for every payload file under a finalized
+    checkpoint directory (the manifest itself is excluded)."""
+    out: Dict[str, str] = {}
+    for root, _dirs, files in os.walk(path):
+        for name in sorted(files):
+            if name == CHECKSUM_FILE:
+                continue
+            full = os.path.join(root, name)
+            out[os.path.relpath(full, path)] = _file_digest(full)
+    return out
+
+
+def write_checksums(path: str) -> Dict[str, str]:
+    """Bank a blake2s digest per shard file alongside the checkpoint
+    (``ompi_tpu_checksums.json``); called after every finalized save."""
+    path = os.path.abspath(path)
+    digests = _shard_files(path)
+    tmp = os.path.join(path, CHECKSUM_FILE + ".tmp")
+    with open(tmp, "w") as fh:
+        json.dump({"version": 1, "algo": "blake2s-16", "files": digests},
+                  fh, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(path, CHECKSUM_FILE))
+    return digests
+
+
+def verify_checksums(path: str, rank: int = 0) -> int:
+    """Re-hash every banked shard file; raise
+    :class:`CheckpointCorruptionError` naming the bad shard(s) and the
+    restoring rank.  Checkpoints written before the manifest existed
+    (no ``ompi_tpu_checksums.json``) verify trivially (returns 0) —
+    refusing to restore them would break every pre-existing checkpoint.
+    Returns the number of files verified."""
+    path = os.path.abspath(path)
+    manifest = os.path.join(path, CHECKSUM_FILE)
+    if not os.path.exists(manifest):
+        return 0
+    with open(manifest) as fh:
+        banked = json.load(fh).get("files", {})
+    bad, missing = [], []
+    for rel, want in sorted(banked.items()):
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            missing.append(rel)
+        elif _file_digest(full) != want:
+            bad.append(rel)
+    if bad or missing:
+        parts = []
+        if bad:
+            parts.append(f"corrupted shard file(s) {bad}")
+        if missing:
+            parts.append(f"missing shard file(s) {missing}")
+        raise CheckpointCorruptionError(
+            f"checkpoint {path} failed verification on rank {rank}: "
+            + "; ".join(parts)
+            + " — refusing to restore corrupted state "
+            "(ompi_tpu_checksums.json banks the save-time blake2s "
+            "digests; the bytes on disk no longer match them)")
+    return len(banked)
+
+
 def save(path: str, state: Any, force: bool = True) -> None:
     """Blocking save of a pytree of (possibly sharded) jax arrays."""
     ckptr = _ocp().StandardCheckpointer()
     ckptr.save(os.path.abspath(path), state, force=force)
     ckptr.wait_until_finished()
+    write_checksums(path)
 
 
 def save_async(path: str, state: Any) -> "AsyncSave":
@@ -47,12 +131,13 @@ def save_async(path: str, state: Any) -> "AsyncSave":
     IO in the background; ``wait()`` (or the next save) joins it."""
     ckptr = _ocp().AsyncCheckpointer(_ocp().StandardCheckpointHandler())
     ckptr.save(os.path.abspath(path), args=_ocp().args.StandardSave(state))
-    return AsyncSave(ckptr)
+    return AsyncSave(ckptr, os.path.abspath(path))
 
 
 class AsyncSave:
-    def __init__(self, ckptr) -> None:
+    def __init__(self, ckptr, path: Optional[str] = None) -> None:
         self._ckptr = ckptr
+        self._path = path
 
     def wait(self) -> None:
         if self._ckptr is not None:
@@ -61,12 +146,20 @@ class AsyncSave:
             # background threads outlive the save and accumulate
             self._ckptr.close()
             self._ckptr = None
+            if self._path:
+                # the manifest can only hash FINALIZED bytes: written at
+                # join time, after orbax renames the tmp dir into place
+                write_checksums(self._path)
 
 
-def restore(path: str, like: Any) -> Any:
+def restore(path: str, like: Any, rank: int = 0) -> Any:
     """Restore onto the shardings/dtypes/shapes of ``like`` (an abstract or
     concrete pytree). ``like`` may live on a DIFFERENT mesh than the save —
-    orbax reshards on read, which is what shrink-recovery needs."""
+    orbax reshards on read, which is what shrink-recovery needs.  Shard
+    files are verified against the save-time checksum manifest first; a
+    mismatch raises :class:`CheckpointCorruptionError` naming the bad
+    shard and rank."""
+    verify_checksums(path, rank=rank)
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_shard(x))
         if hasattr(x, "shape") else x, like)

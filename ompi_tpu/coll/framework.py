@@ -73,7 +73,7 @@ class CollTable:
                     spc.inc("collectives")
                     if name == "barrier":
                         spc.inc("barriers")
-                from .. import health, monitoring, perf, trace
+                from .. import health, monitoring, numerics, perf, trace
                 if trace.enabled:
                     # per-rank arrival marker: dispatch time is the entry
                     # timestamp the fleet skew analysis keys on — every
@@ -90,6 +90,14 @@ class CollTable:
                     # PMPI-analog hooks fire even without an installed
                     # Monitor, matching the osc events' gating
                     monitoring.coll_event(comm, name, a[0] if a else None)
+                call = fn
+                if numerics.enabled:
+                    # payload fingerprints: wrap the innermost invocation
+                    # so pre/post stats surround the actual collective and
+                    # the xla audit's note_arm lands in the in-flight
+                    # probe entry (ompi_tpu/numerics/probes.py)
+                    def call(comm, *a, **kw):
+                        return numerics.probed_coll(fn, comm, name, a, kw)
                 if health.enabled:
                     # flight recorder: hold a (cid, seq, signature) entry
                     # while in flight so the watchdog/desync sentinel can
@@ -102,13 +110,13 @@ class CollTable:
                             # audit (perf.note_arm) — un-annotated
                             # dispatches are dropped, and a raising
                             # collective contributes nothing
-                            return perf.timed_coll(fn, comm, name, a, kw)
-                        return fn(comm, *a, **kw)
+                            return perf.timed_coll(call, comm, name, a, kw)
+                        return call(comm, *a, **kw)
                     finally:
                         health.op_end(htok)
                 if perf.enabled:
-                    return perf.timed_coll(fn, comm, name, a, kw)
-                return fn(comm, *a, **kw)
+                    return perf.timed_coll(call, comm, name, a, kw)
+                return call(comm, *a, **kw)
 
             return counted
         # nonblocking variants: i<name> falls back to eager execution wrapped

@@ -325,6 +325,11 @@ class QuantDeviceComm:
             return dc._shard_map(inner, dc._spec, dc._spec)
 
         self._spc("device_quant_collectives")
+        from .. import numerics
+        if numerics.enabled:
+            # live SNR of the same per-block rounding the wire applies,
+            # measured on the actual payload (numerics quant-SNR sentry)
+            numerics.observe_quant_snr("allreduce", x, block, sdt)
         xp = self._padded(x, L, Lpad)
         if trace.enabled:
             # allreduce = quantized reduce_scatter ring (accumulate in
@@ -378,6 +383,9 @@ class QuantDeviceComm:
             return dc._shard_map(inner, dc._spec, dc._spec)
 
         self._spc("device_quant_collectives")
+        from .. import numerics
+        if numerics.enabled:
+            numerics.observe_quant_snr("reduce_scatter", x, block, sdt)
         flat = self._padded(x, R * b * E, R * b * E)
         if trace.enabled:
             # ring phase alone: one rounding per element, accumulation
@@ -421,6 +429,9 @@ class QuantDeviceComm:
             return dc._shard_map(inner, dc._spec, dc._spec)
 
         self._spc("device_quant_collectives")
+        from .. import numerics
+        if numerics.enabled:
+            numerics.observe_quant_snr("allgather", x, block, sdt)
         xp = self._padded(x, L, Lpad)
         if trace.enabled:
             # each contribution quantized exactly once on the wire

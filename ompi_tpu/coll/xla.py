@@ -527,12 +527,17 @@ class XlaModule(CollModule):
             elif arm != "staged":
                 simdcn.charge(int(wire * simdcn.ring_dcn_fraction(
                     self.dc.mesh, self.dc.axis)))
-        from .. import health, perf
+        from .. import health, numerics, perf
         if health.enabled:
             # fold the decided arm into the in-flight entry's signature —
             # the last field of the flight-recorder hash (op, dtype,
             # count, reduction, arm)
             health.note_arm(arm)
+        if numerics.enabled:
+            # annotate the in-flight fingerprint entry so the non-finite
+            # verdict names the executed arm (compare semantics differ:
+            # bitwise on native, tolerance-bounded on quant)
+            numerics.note_arm(arm)
         if perf.enabled:
             # annotate the in-flight timing entry (coll/framework's
             # dispatch wrapper) with the executed arm + audited per-rank

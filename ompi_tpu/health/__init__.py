@@ -65,6 +65,13 @@ _var.register("health", "", "dump_dir", "health_dumps", type=str, level=3,
               help="Directory the watchdog writes rank<r>.health.json + "
                    "rank<r>.trace.json flight-recorder dumps into "
                    "(empty = no dump files).")
+_var.register("health", "", "payload_digest", False, type=bool, level=4,
+              help="Fold a payload digest (numerics probes, blake2s over "
+                   "the pre-collective buffer) into the flight-recorder "
+                   "signature so the desync sentinel catches same-seq/"
+                   "same-metadata/DIFFERENT-DATA divergence. Needs the "
+                   "numerics plane enabled; pulls sampled buffers to the "
+                   "host — off by default.")
 _var.register("health", "", "http_port", 0, type=int, level=3,
               help="Serve /metrics (Prometheus) and /health (JSON) on "
                    "this port when the plane is installed; 0 = off. "
@@ -152,6 +159,7 @@ def waitset_begin(requests, op: str) -> int:
 
 op_end = registry.end
 note_arm = registry.note_arm
+note_payload = registry.note_payload
 
 
 # -- lifecycle ---------------------------------------------------------------

@@ -38,15 +38,20 @@ _tls = threading.local()                          # per-thread entry stack
 
 
 def signature_of(op: str, dtype: str, count: int, reduction: str,
-                 arm: str) -> str:
+                 arm: str, payload: str = "") -> str:
+    # the optional payload digest (health_payload_digest mode, fed by
+    # the numerics probes) extends the hash only when present, so the
+    # metadata-only signature stays stable for every existing consumer
     blob = f"{op}|{dtype}|{count}|{reduction}|{arm}"
+    if payload:
+        blob += f"|{payload}"
     return hashlib.blake2s(blob.encode(), digest_size=6).hexdigest()
 
 
 class Entry:
     __slots__ = ("token", "rank", "cid", "comm_name", "seq", "kind", "op",
-                 "dtype", "count", "nbytes", "reduction", "arm", "peer",
-                 "peers", "signature", "t0", "tripped", "parent")
+                 "dtype", "count", "nbytes", "reduction", "arm", "payload",
+                 "peer", "peers", "signature", "t0", "tripped", "parent")
 
     def __init__(self, token: int, rank: int, cid: int, comm_name: str,
                  seq: int, kind: str, op: str, dtype: str, count: int,
@@ -64,6 +69,7 @@ class Entry:
         self.nbytes = nbytes
         self.reduction = reduction
         self.arm = ""                    # annotated by coll/xla once decided
+        self.payload = ""                # opt-in payload digest (numerics)
         self.peer = peer
         self.peers = peers
         self.signature = signature_of(op, dtype, count, reduction, "")
@@ -128,7 +134,30 @@ def note_arm(arm: str) -> None:
             return
         e.arm = str(arm)
         e.signature = signature_of(e.op, e.dtype, e.count, e.reduction,
-                                   e.arm)
+                                   e.arm, e.payload)
+        if e.kind == "coll":
+            head = _heads.get((e.rank, e.cid))
+            if head is not None and head["seq"] == e.seq:
+                head["sig"] = e.signature
+
+
+def note_payload(digest: str) -> None:
+    """Annotate the calling thread's innermost in-flight entry with a
+    payload digest (``health_payload_digest`` mode, fed by the numerics
+    probes' pre-collective fingerprint) and fold it into the signature —
+    two ranks at the same seq with identical metadata but DIFFERENT data
+    now hash apart, so the desync sentinel catches silent payload
+    divergence the metadata-only signature cannot see."""
+    stack = getattr(_tls, "stack", None)
+    if not stack:
+        return
+    with _lock:
+        e = _entries.get(stack[-1])
+        if e is None:
+            return
+        e.payload = str(digest)
+        e.signature = signature_of(e.op, e.dtype, e.count, e.reduction,
+                                   e.arm, e.payload)
         if e.kind == "coll":
             head = _heads.get((e.rank, e.cid))
             if head is not None and head["seq"] == e.seq:

@@ -559,8 +559,17 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
     fpt = train_flops_per_token(cfg)
 
     def timed_step(params, opt_state, tokens):
-        from .. import perf
-        if not perf.enabled or isinstance(tokens, jax.core.Tracer):
+        from .. import numerics, perf
+        if isinstance(tokens, jax.core.Tracer):
+            return jstep(params, opt_state, tokens)
+        if not perf.enabled:
+            if numerics.enabled:
+                # per-step loss telemetry for the NUMERICS ledger (the
+                # grad norm comes from the overlap.vg hook; record_step
+                # pairs them on the step row and advances the counter)
+                out = jstep(params, opt_state, tokens)
+                numerics.record_step(loss=float(out[2]))
+                return out
             return jstep(params, opt_state, tokens)
         # goodput/MFU ledger: blocked wall per step. Only wall + token
         # FLOPs are measurable from one blocked call — the comm split
@@ -574,6 +583,8 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
                                                       1),
                          flops_per_token=fpt,
                          peak_tflops=perf.peak_tflops())
+        if numerics.enabled:
+            numerics.record_step(loss=float(out[2]))
         return out
 
     return init_opt, timed_step

@@ -1,0 +1,145 @@
+"""Numerics probes — cheap tensor fingerprints at collective boundaries.
+
+A fingerprint is the per-buffer summary the plane's sentries judge:
+l2 norm, absmax, and NaN/Inf counts — computed per RANK ROW when the
+buffer is in the canonical ``(R, *elem)`` device layout (row ``i`` is
+rank ``i``'s contribution), which is what lets the non-finite sentry
+name the rank that *produced* a NaN versus ranks that merely received
+it through a reduction.  The reductions run on-device (one jnp pass);
+only the tiny per-row result vectors cross to the host, and only on
+sampled collectives (``numerics_sample_interval``).
+
+``payload_digest`` is the optional chunked deterministic blake2s over
+the raw buffer bytes — the opt-in payload mode of the health
+registry's flight-recorder signature (same-seq / same-metadata /
+different-data desync) and the divergence auditor's bitwise compare.
+It pulls the buffer to the host: strictly opt-in, never on the default
+sampled path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+from typing import Any, Dict, Optional, Sequence
+
+import numpy as np
+
+_DIGEST_CHUNK = 1 << 20        # 1 MiB hash chunks: bounded peak memory
+
+
+def _rowwise(x) -> tuple:
+    """(l2, absmax, nan_counts, inf_counts) per dim-0 row, as numpy
+    arrays.  Accepts jax or numpy arrays; non-float dtypes get zero
+    non-finite counts (ints cannot hold NaN/Inf)."""
+    import jax.numpy as jnp
+
+    xr = x.reshape((x.shape[0], -1)) if getattr(x, "ndim", 0) >= 1 \
+        else x.reshape((1, 1))
+    if not jnp.issubdtype(xr.dtype, jnp.inexact):
+        n = xr.shape[0]
+        xf = xr.astype(jnp.float32)
+        l2 = jnp.sqrt(jnp.sum(xf * xf, axis=1))
+        return (np.asarray(l2), np.asarray(jnp.max(jnp.abs(xf), axis=1)),
+                np.zeros(n, np.int64), np.zeros(n, np.int64))
+    xf = xr.astype(jnp.float32)
+    nan = jnp.sum(jnp.isnan(xf), axis=1)
+    inf = jnp.sum(jnp.isinf(xf), axis=1)
+    finite = jnp.where(jnp.isfinite(xf), xf, 0.0)
+    l2 = jnp.sqrt(jnp.sum(finite * finite, axis=1))
+    amax = jnp.max(jnp.abs(finite), axis=1)
+    return (np.asarray(l2), np.asarray(amax),
+            np.asarray(nan, np.int64), np.asarray(inf, np.int64))
+
+
+def fingerprint(x) -> Dict[str, Any]:
+    """Per-row fingerprint of a canonical ``(R, *elem)`` buffer (or any
+    array — a 0/1-d buffer is one row).  Keys: ``l2``/``absmax`` (lists
+    of finite-masked per-row values), ``nonfinite`` (per-row NaN+Inf
+    counts), ``total_nonfinite``."""
+    l2, amax, nan, inf = _rowwise(x)
+    nf = [int(a) + int(b) for a, b in zip(nan, inf)]
+    return {
+        "rows": len(nf),
+        "l2": [float(v) for v in l2],
+        "absmax": [float(v) for v in amax],
+        "nonfinite": nf,
+        "total_nonfinite": int(sum(nf)),
+    }
+
+
+def tree_nonfinite(leaves: Sequence) -> Dict[str, Any]:
+    """Total NaN/Inf count over a flat leaf list (grad-sync boundary)
+    plus the index and total of the FIRST offending leaf — enough for
+    the bucket-level attribution overlap's plan provides."""
+    import jax.numpy as jnp
+
+    first, total = -1, 0
+    for i, g in enumerate(leaves):
+        if not jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact):
+            continue
+        n = int(jnp.sum(~jnp.isfinite(jnp.asarray(g, jnp.float32))))
+        if n and first < 0:
+            first = i
+        total += n
+    return {"total_nonfinite": total, "first_leaf": first}
+
+
+def grad_norm(leaves: Sequence) -> float:
+    """Global l2 over a flat leaf list, NaN/Inf masked to 0 (the norm
+    telemetry must stay plottable through a non-finite episode)."""
+    import jax.numpy as jnp
+
+    acc = 0.0
+    for g in leaves:
+        gf = jnp.asarray(g, jnp.float32)
+        gf = jnp.where(jnp.isfinite(gf), gf, 0.0)
+        acc += float(jnp.sum(gf * gf))
+    return math.sqrt(acc)
+
+
+def payload_digest(x, digest_size: int = 8) -> str:
+    """Chunked deterministic blake2s over the raw buffer bytes.
+    Deterministic across processes (unlike ``hash()``), chunked so a
+    multi-GiB buffer never doubles in host memory during hashing."""
+    arr = np.ascontiguousarray(np.asarray(x))
+    h = hashlib.blake2s(digest_size=digest_size)
+    view = memoryview(arr).cast("B")
+    for off in range(0, len(view), _DIGEST_CHUNK):
+        h.update(view[off:off + _DIGEST_CHUNK])
+    return h.hexdigest()
+
+
+def snr_db(x, block: int, scale_dtype=None,
+           max_elems: int = 65536) -> Optional[float]:
+    """Live quantization SNR (dB) of one quantize→dequantize round trip
+    over (a bounded prefix of) ``x`` — the same per-block symmetric
+    rounding the wire dequant path applies, measured on the actual data
+    distribution.  None when the buffer carries no signal (all zero /
+    non-finite) — silence is not an SNR sample."""
+    import jax.numpy as jnp
+
+    from ..coll.quant import dequantize_blocks, quantize_blocks
+
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    n = int(flat.shape[0])
+    if n == 0:
+        return None
+    take = min(n, max(int(max_elems), block))
+    take -= take % block
+    if take < block:
+        pad = block - n % block if n % block else 0
+        flat = jnp.pad(flat, (0, pad))
+        take = block
+    sample = flat[:take]
+    sample = jnp.where(jnp.isfinite(sample), sample, 0.0)
+    q, s = quantize_blocks(sample, block, scale_dtype)
+    back = dequantize_blocks(q, s, block)
+    sig = float(jnp.sum(sample * sample))
+    if sig <= 0.0:
+        return None
+    err = sample - back
+    noise = float(jnp.sum(err * err))
+    if noise <= 0.0:
+        return float("inf")
+    return 10.0 * math.log10(sig / noise)

@@ -374,7 +374,21 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
             traffic.note_ring(mesh, sync_axis,
                               2 * (n - 1) * flat_b // n, "grad_sync")
 
+    def _note_numerics(grads):
+        # payload fingerprints at the grad-sync boundary: grad-norm /
+        # non-finite telemetry with bucket attribution when the bucketed
+        # plan is in hand (ompi_tpu/numerics).  Callers gate on
+        # numerics.enabled — the disabled path stays one attribute read.
+        from .. import numerics
+        if mode == "unsynced":
+            return
+        leaves = jax.tree_util.tree_leaves(grads)
+        plan, arms = ((_last_plan if mode == "bucketed" and
+                       _last_plan is not None else (None, None)))
+        numerics.observe_grad_sync(leaves, mode, n, plan=plan, arms=arms)
+
     def vg(params, batch):
+        from .. import numerics
         if isinstance(batch, jax.core.Tracer):
             # under an outer jit/grad trace there is nothing to time or
             # attribute: the sync inlines into the caller's program
@@ -382,6 +396,8 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
         if not trace.enabled:
             loss, grads = inner(params, batch)
             _note_traffic(grads)
+            if numerics.enabled:
+                _note_numerics(grads)
             return loss, grads
         t0 = time.perf_counter()
         try:
@@ -417,6 +433,8 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
                           "nbytes": b.nbytes, "ndev": n,
                           "leaves": len(b.indices)})
         _note_traffic(grads)
+        if numerics.enabled:
+            _note_numerics(grads)
         return loss, grads
 
     return vg

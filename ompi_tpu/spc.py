@@ -78,6 +78,17 @@ COUNTERS = [
      "hot-link sentry trips (one directed edge carrying "
      "disproportionate bytes)"),
     ("traffic_edge_count", "directed mesh edges holding attributed bytes"),
+    # numerics plane (fed by ompi_tpu/numerics; process-wide)
+    ("numerics_samples",
+     "payload fingerprints taken at collective / grad-sync boundaries"),
+    ("numerics_nonfinite_trips",
+     "non-finite sentry trips (a NaN/Inf episode attributed to its "
+     "producing rank/step/op)"),
+    ("numerics_snr_trips",
+     "quant-SNR sentry trips: sustained SNR shortfall vs the baseline"),
+    ("numerics_snr_db", "most recent sampled quantization SNR, dB"),
+    ("numerics_divergence_trips",
+     "cross-replica divergence audits that found replicas disagreeing"),
 ]
 
 
@@ -119,11 +130,15 @@ class Counters:
             from . import traffic
             if name in traffic.PVARS:
                 return traffic.pvar_value(name)
+        if name.startswith("numerics_"):
+            from . import numerics
+            if name in numerics.PVARS:
+                return numerics.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
         out = dict(self._v)
-        from . import health, perf, trace, traffic
+        from . import health, numerics, perf, trace, traffic
         from .parallel import overlap
         out["trace_dropped_events"] = trace.dropped_events()
         out["grad_bucket_count"] = overlap.pvar_value("grad_bucket_count")
@@ -134,6 +149,8 @@ class Counters:
             out[name] = perf.pvar_value(name)
         for name in traffic.PVARS:
             out[name] = traffic.pvar_value(name)
+        for name in numerics.PVARS:
+            out[name] = numerics.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

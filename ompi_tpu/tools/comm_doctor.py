@@ -30,9 +30,10 @@ from ..trace import analyze as _an
 from ..trace import merge as _merge
 
 # bumped whenever any --json report mode changes shape; every mode
-# (default merge, --health-dump, --perf, --traffic, --live) emits it so
-# downstream tooling can detect drift (ISSUE 7 satellite)
-SCHEMA_VERSION = 3
+# (default merge, --health-dump, --perf, --traffic, --numerics, --live)
+# emits it so downstream tooling can detect drift (ISSUE 7 satellite;
+# 4 = the numerics plane section, ISSUE 9)
+SCHEMA_VERSION = 4
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -319,6 +320,69 @@ def build_traffic_report(
     return "\n".join(lines), rep
 
 
+def build_numerics_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the numerics plane: sample
+    counts, non-finite origin verdicts (the first rank/step/op that
+    produced each NaN/Inf episode), quant-SNR state vs the banked
+    baseline, divergence-auditor verdicts and the per-step grad-norm /
+    loss telemetry tail. ``path`` loads a banked NUMERICS json
+    (bench.py --numerics); default reads the live in-process plane."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from .. import numerics
+        rep = numerics.report()
+    lines: List[str] = []
+    w = lines.append
+    nf = rep.get("nonfinite") or {}
+    snr = rep.get("snr") or {}
+    div = rep.get("divergence") or {}
+    src = f" (from {path})" if path else ""
+    w(f"numerics plane: {int(rep.get('samples', 0))} payload "
+      f"fingerprint(s){src}")
+    if nf.get("verdicts"):
+        w(f"  NON-FINITE: {int(nf.get('trips', 0))} episode(s):")
+        for v in nf["verdicts"][-8:]:
+            who = (f"rank {v['rank']} (input already non-finite)"
+                   if v.get("origin") == "input"
+                   else "the reduction itself (every input was clean)")
+            w(f"    step {v['step']} {v['op']}"
+              + (f" [{v['arm']}]" if v.get("arm") else "")
+              + f": produced by {who}; "
+              f"received by rank(s) {v.get('received_ranks')}")
+    else:
+        w("  no non-finite episodes")
+    if snr.get("samples"):
+        w(f"  quant SNR: last {snr.get('last_db')} dB over "
+          f"{len(snr['samples'])} sample(s)")
+    if snr.get("verdicts"):
+        w(f"  SNR REGRESSION: {int(snr.get('trips', 0))} trip(s):")
+        for v in snr["verdicts"][-8:]:
+            w(f"    {v['coll']} block {v['block']}: {v['snr_db']} dB vs "
+              f"baseline p50 {v['baseline_p50']} dB "
+              f"(z={v['z']}, {v['sustained']} consecutive)")
+    if div.get("verdicts"):
+        from ..numerics import consistency
+        w(f"  DIVERGENCE: {int(div.get('trips', 0))} audit(s) found "
+          "replicas disagreeing:")
+        for v in div["verdicts"][-4:]:
+            for ln in consistency.format_verdict(v).splitlines():
+                w("    " + ln)
+    elif div is not None:
+        w("  no cross-replica divergence")
+    steps = rep.get("steps") or []
+    if steps:
+        w("  step telemetry (tail):")
+        for row in steps[-6:]:
+            w(f"    step {row.get('step')}: "
+              f"loss={row.get('loss')} grad_norm={row.get('grad_norm')} "
+              f"grad_nonfinite={row.get('grad_nonfinite', 0)}")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -365,6 +429,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "hot-link verdicts. With a path, loads a "
                          "banked TRAFFIC json (bench.py --traffic); "
                          "bare flag reads the live in-process plane")
+    ap.add_argument("--numerics", nargs="?", const="", default=None,
+                    metavar="NUMERICS.json",
+                    help="render the numerics-plane section: non-finite "
+                         "origin verdicts (rank/step/op), quant-SNR "
+                         "sentry state, divergence-auditor verdicts, "
+                         "step telemetry. With a path, loads a banked "
+                         "NUMERICS json (bench.py --numerics); bare "
+                         "flag reads the live in-process plane")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -400,8 +472,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         tl = _merge.merge(_merge.load_chrome(traces)) if traces else None
         return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
-        if ns.perf or ns.traffic is not None:
-            return _report(None, ns)   # perf/traffic section standalone
+        if ns.perf or ns.traffic is not None or ns.numerics is not None:
+            # perf/traffic/numerics section standalone
+            return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
         return 2
@@ -429,6 +502,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         ttext, tdata = build_traffic_report(ns.traffic or None)
         text = (text + "\n" + ttext) if text else ttext
         data["traffic"] = tdata
+    if getattr(ns, "numerics", None) is not None:
+        ntext, ndata = build_numerics_report(ns.numerics or None)
+        text = (text + "\n" + ntext) if text else ntext
+        data["numerics"] = ndata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

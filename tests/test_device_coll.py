@@ -551,14 +551,17 @@ class TestDeviceDecision:
         coll_tune.emit_device_rules(winners, str(path))
         text = path.read_text()
         assert "allreduce 1 0" in text
-        # the emitted file parses through the decision layer's loader
-        from ompi_tpu.coll.xla import _load_device_rules
+        # the emitted file parses through the decision layer's loader;
+        # the sweep's winners span the full mode vocabulary (quant rows,
+        # collmm bidir, rma staged) so the modes are pinned against
+        # _MODES, not the native/staged pair the sweep originally knew
+        from ompi_tpu.coll.xla import _MODES, _load_device_rules
         from ompi_tpu.core import var
         var.registry.set_cli("coll_xla_dynamic_rules", str(path))
         var.registry.reset_cache()
         try:
             parsed = _load_device_rules()
-            assert all(r[3] in ("native", "staged") for r in parsed)
+            assert all(r[3] in _MODES for r in parsed)
             assert any(r[0] == "allreduce" for r in parsed)
         finally:
             var.registry.set_cli("coll_xla_dynamic_rules", "")

@@ -1,0 +1,554 @@
+"""Numerics plane: non-finite origin attribution, quant-SNR sentry,
+cross-replica divergence auditor (ompi_tpu/numerics).
+
+Acceptance pins (ISSUE 9): the non-finite sentry names the rank whose
+INPUT already carried the NaN (origin) versus ranks that merely received
+it through the reduction, one trip per episode; the quant-SNR sentry
+judges live roundtrip SNR against the ~40 dB EQuARX baseline with the
+perf trip grammar; the divergence auditor majority-votes per-bucket
+digests over the control plane and names the first divergent (step,
+bucket, rank); the health registry's opt-in payload-digest mode hashes
+same-metadata/different-data apart; ckpt save banks per-shard blake2s
+checksums that restore verifies loudly; the disabled path is one plain
+module-bool read with zero ``numerics_*`` trace events.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+pytestmark = pytest.mark.numerics
+
+from ompi_tpu import health, numerics, runtime, spc, trace  # noqa: E402
+from ompi_tpu.core import var  # noqa: E402
+from ompi_tpu.health import registry as hreg  # noqa: E402
+from ompi_tpu.numerics import consistency, probes  # noqa: E402
+from ompi_tpu.numerics.sentry import NonfiniteSentry, SnrSentry  # noqa: E402
+from ompi_tpu.parallel import attach_mesh, make_mesh  # noqa: E402
+
+N = 8
+_VARS = (
+    "numerics_enabled", "numerics_sample_interval",
+    "numerics_sentry_ratio", "numerics_sentry_z",
+    "numerics_sentry_sustain", "numerics_snr_baseline_db",
+    "health_enabled", "health_payload_digest", "trace_enabled",
+)
+
+
+@pytest.fixture
+def plane():
+    """set(name=value, ...) applies vars through the CLI layer;
+    everything clears (and the plane's process-wide sentries zero) on
+    teardown regardless of how the test exits."""
+    numerics.reset()
+    health.reset()
+    trace.clear()
+
+    def set_vars(**kw):
+        for k, v in kw.items():
+            var.registry.set_cli(k, str(v))
+        var.registry.reset_cache()
+
+    yield set_vars
+    for k in _VARS:
+        var.registry.clear_cli(k)
+    var.registry.reset_cache()
+    numerics.disable()
+    numerics.reset()
+    health.disable()
+    health.reset()
+    trace.disable()
+    trace.clear()
+
+
+# ---------------------------------------------------------------------------
+# probes: fingerprints, digests, SNR
+# ---------------------------------------------------------------------------
+
+def test_fingerprint_rowwise_attribution():
+    x = jnp.array([[1.0, 2.0], [np.nan, 3.0], [4.0, np.inf], [5.0, 6.0]])
+    fp = probes.fingerprint(x)
+    assert fp["rows"] == 4
+    assert fp["nonfinite"] == [0, 1, 1, 0]
+    assert fp["total_nonfinite"] == 2
+    # l2/absmax are finite-masked: row 1's NaN contributes 0, not NaN
+    assert fp["l2"][1] == pytest.approx(3.0)
+    assert fp["absmax"][2] == pytest.approx(4.0)
+
+
+def test_fingerprint_int_dtype_has_no_nonfinite():
+    fp = probes.fingerprint(jnp.arange(12, dtype=jnp.int32).reshape(4, 3))
+    assert fp["total_nonfinite"] == 0
+    assert fp["l2"][1] > 0
+
+
+def test_payload_digest_deterministic_and_bit_sensitive():
+    a = np.arange(1024, dtype=np.float32)
+    b = a.copy()
+    assert probes.payload_digest(a) == probes.payload_digest(b)
+    b.view(np.uint32)[5] ^= 1          # one mantissa bit
+    assert probes.payload_digest(a) != probes.payload_digest(b)
+
+
+def test_tree_nonfinite_first_leaf():
+    leaves = [np.ones(4, np.float32),
+              np.array([1.0, np.nan], np.float32),
+              np.array([np.inf], np.float32)]
+    t = probes.tree_nonfinite(leaves)
+    assert t["total_nonfinite"] == 2
+    assert t["first_leaf"] == 1
+    assert probes.tree_nonfinite([np.ones(3)])["first_leaf"] == -1
+
+
+def test_grad_norm_masks_nonfinite():
+    leaves = [np.array([3.0, 4.0], np.float32),
+              np.array([np.nan], np.float32)]
+    assert probes.grad_norm(leaves) == pytest.approx(5.0)
+
+
+def test_snr_db_near_equarx_baseline():
+    x = np.random.default_rng(0).standard_normal(8192).astype(np.float32)
+    db = probes.snr_db(x, 256)
+    # int8 block-256 symmetric rounding on unit-scale data: ~40 dB
+    # (arXiv 2506.17615) — pin a generous band, not the exact figure
+    assert 35.0 < db < 50.0
+    assert probes.snr_db(np.zeros(512, np.float32), 256) is None
+
+
+# ---------------------------------------------------------------------------
+# non-finite sentry: origin vs received, episodes, trace instant
+# ---------------------------------------------------------------------------
+
+def _fp(nonfinite):
+    return {"nonfinite": list(nonfinite)}
+
+
+def test_nonfinite_origin_vs_received(plane):
+    s = NonfiniteSentry()
+    v = s.observe("allreduce", 7, _fp([0, 0, 1, 0]), _fp([1, 1, 1, 1]),
+                  arm="native")
+    assert (v["rank"], v["step"], v["op"]) == (2, 7, "allreduce")
+    assert v["origin"] == "input"
+    assert v["origin_ranks"] == [2]
+    assert v["received_ranks"] == [0, 1, 3]
+
+
+def test_nonfinite_reduction_origin(plane):
+    # every input clean, output dirty: the reduction itself overflowed
+    s = NonfiniteSentry()
+    v = s.observe("allreduce", 1, _fp([0, 0]), _fp([1, 1]))
+    assert v["origin"] == "reduction" and v["rank"] == -1
+
+
+def test_nonfinite_episode_semantics(plane):
+    s = NonfiniteSentry()
+    assert s.observe("allreduce", 1, _fp([1]), _fp([1])) is not None
+    # the SAME persisting NaN is one episode, not one trip per step
+    assert s.observe("allreduce", 2, _fp([1]), _fp([1])) is None
+    assert s.trips() == 1
+    # a fully finite sample closes the episode and re-arms
+    assert s.observe("allreduce", 3, _fp([0]), _fp([0])) is None
+    assert s.observe("allreduce", 4, _fp([1]), _fp([1])) is not None
+    assert s.trips() == 2
+    # episodes are per-op: a different collective trips independently
+    assert s.observe("allgather", 5, _fp([1]), None) is not None
+
+
+def test_nonfinite_trace_instant(plane):
+    trace.enable()
+    s = NonfiniteSentry()
+    s.observe("allreduce", 3, _fp([0, 1]), _fp([1, 1]), arm="quant")
+    ev = [e for e in trace.events()
+          if e.get("name") == "numerics_nonfinite"]
+    assert len(ev) == 1
+    assert ev[0]["args"]["rank"] == 1 and ev[0]["args"]["arm"] == "quant"
+
+
+# ---------------------------------------------------------------------------
+# quant-SNR sentry: baseline + perf trip grammar
+# ---------------------------------------------------------------------------
+
+def test_snr_sentry_default_baseline_trip(plane):
+    s = SnrSentry()
+    # default baseline 40 dB, ratio 0.75, sustain 3: 20 dB is bad
+    assert s.observe("allreduce", 20.0, block=256) is None
+    assert s.observe("allreduce", 20.0, block=256) is None
+    v = s.observe("allreduce", 20.0, block=256)
+    assert v is not None and v["kind"] == "quant_snr"
+    assert v["baseline_p50"] == 40.0 and v["sustained"] == 3
+    # one trip per episode
+    assert s.observe("allreduce", 20.0, block=256) is None
+    assert s.trips() == 1
+    # a good sample re-arms
+    assert s.observe("allreduce", 41.0) is None
+    for _ in range(3):
+        last = s.observe("allreduce", 20.0)
+    assert last is not None and s.trips() == 2
+
+
+def test_snr_sentry_good_samples_never_trip(plane):
+    s = SnrSentry()
+    for _ in range(16):
+        assert s.observe("allreduce", 39.0) is None
+    assert s.trips() == 0
+    assert s.last_db() == 39.0
+
+
+def test_snr_sentry_zero_baseline_disables(plane):
+    plane(numerics_snr_baseline_db="0")
+    s = SnrSentry()
+    for _ in range(8):
+        assert s.observe("allreduce", 1.0) is None
+    assert s.trips() == 0
+
+
+def test_snr_sentry_loaded_baseline_z_test(plane):
+    s = SnrSentry()
+    assert s.load_baseline([40.0, 40.5, 39.5, 40.2, 39.8] * 4) == 1
+    # 38 dB clears the ratio test (0.75 * p50 = 30) but its z-score vs
+    # the tight loaded distribution exceeds 3
+    for _ in range(2):
+        assert s.observe("allreduce", 38.0) is None
+    v = s.observe("allreduce", 38.0)
+    assert v is not None and v["z"] > 3
+
+
+# ---------------------------------------------------------------------------
+# divergence auditor
+# ---------------------------------------------------------------------------
+
+def test_bucket_summary_fields():
+    b = consistency.bucket_summary(np.ones(512, np.float32))
+    assert set(b) == {"digest", "arm", "l2", "absmax", "nonfinite"}
+    assert b["arm"] == "native" and b["nonfinite"] == 0
+
+
+def test_audit_majority_names_corrupt_rank(plane):
+    def fn(ctx):
+        buf = np.arange(256, dtype=np.float32)
+        if ctx.rank == 2:
+            buf.view(np.uint32)[7] ^= 1
+        return consistency.audit(
+            ctx, 11, [consistency.bucket_summary(buf)])
+
+    outs = runtime.run_ranks(4, fn)
+    for a in outs:
+        assert a["first"] == {"step": 11, "bucket": 0, "rank": 2}
+        assert a["divergent"][0]["majority_digest"] is not None
+        assert not a["missing"]
+    # the human rendering names the corrupt replica
+    assert "rank 2 bucket 0" in consistency.format_verdict(outs[0])
+
+
+def test_audit_two_replicas_no_quorum(plane):
+    def fn(ctx):
+        buf = np.arange(64, dtype=np.float32) + ctx.rank  # both differ
+        return consistency.audit(
+            ctx, 3, [consistency.bucket_summary(buf)])
+
+    outs = runtime.run_ranks(2, fn)
+    for a in outs:
+        assert a["divergent"] and a["divergent"][0]["rank"] == -1
+        assert a["first"]["rank"] == -1
+
+
+def test_audit_agreement_is_clean(plane):
+    def fn(ctx):
+        buf = np.arange(64, dtype=np.float32)
+        return consistency.audit(
+            ctx, 5, [consistency.bucket_summary(buf)])
+
+    for a in runtime.run_ranks(3, fn):
+        assert a["divergent"] == [] and a["first"] is None
+    assert "every replica agrees" in consistency.format_verdict(
+        {"rank": 0, "step": 5, "compared": [0, 1, 2], "divergent": []})
+
+
+def test_audit_quant_arm_tolerance():
+    base = consistency.bucket_summary(np.ones(512, np.float32),
+                                      arm="quant")
+    near = dict(base, digest="different", l2=base["l2"] * (1 + 1e-6))
+    far = dict(base, l2=base["l2"] * 1.5)
+    assert not consistency._mismatch(base, near)   # stats within tol
+    assert consistency._mismatch(base, far)
+    # native arms compare bitwise: same stats, different digest => diverged
+    nat = consistency.bucket_summary(np.ones(512, np.float32))
+    assert consistency._mismatch(nat, dict(nat, digest="deadbeef0000"))
+
+
+def test_audit_replicas_counts_trips(plane):
+    trace.enable()
+
+    def fn(ctx):
+        buf = np.arange(128, dtype=np.float32)
+        if ctx.rank == 1:
+            buf.view(np.uint32)[0] ^= 1
+        return numerics.audit_replicas(
+            ctx, 2, [consistency.bucket_summary(buf)])
+
+    runtime.run_ranks(3, fn)
+    assert numerics.pvar_value("numerics_divergence_trips") == 3.0
+    assert [e for e in trace.events()
+            if e.get("name") == "numerics_divergence"]
+
+
+# ---------------------------------------------------------------------------
+# end-to-end through the coll dispatch wrapper
+# ---------------------------------------------------------------------------
+
+def test_probed_coll_attributes_injected_nan(plane):
+    plane(numerics_enabled="true")
+    trace.enable()
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        for step in range(3):
+            numerics.begin_step(step)
+            rows = [np.full(256, float(r + 1), np.float32)
+                    for r in range(N)]
+            if step == 1:
+                rows[3][0] = np.nan
+            c.coll.allreduce(c, d.from_ranks(rows))
+        return ctx.spc.snapshot()["numerics_samples"]
+
+    samples = runtime.run_ranks(1, fn)[0]
+    assert samples >= 3
+    vs = numerics.nonfinite.verdicts()
+    assert len(vs) == 1
+    v = vs[0]
+    assert (v["rank"], v["step"], v["op"]) == (3, 1, "allreduce")
+    assert v["origin"] == "input"
+    assert v["arm"]                     # xla audit annotated the arm
+    assert [e for e in trace.events()
+            if e.get("name") == "numerics_nonfinite"]
+
+
+def test_sample_interval_gates_fingerprints(plane):
+    plane(numerics_enabled="true", numerics_sample_interval="4")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        x = d.from_ranks([np.ones(64, np.float32)] * N)
+        for _ in range(8):
+            c.coll.allreduce(c, x)
+        return True
+
+    assert runtime.run_ranks(1, fn)[0]
+    assert numerics.pvar_value("numerics_samples") == 2.0
+
+
+def test_observe_quant_snr_samples(plane):
+    plane(numerics_enabled="true")
+    x = np.random.default_rng(1).standard_normal(4096).astype(np.float32)
+    db = numerics.observe_quant_snr("allreduce", jnp.asarray(x), 256)
+    assert db is not None and 35.0 < db < 50.0
+    assert numerics.snr.samples()
+    assert numerics.pvar_value("numerics_snr_db") == pytest.approx(db)
+
+
+def test_observe_grad_sync_bucket_attribution(plane):
+    from ompi_tpu.parallel import overlap
+    plane(numerics_enabled="true")
+    leaves = [np.ones(1024, np.float32) for _ in range(4)]
+    plan = overlap.bucket_plan(leaves, 2 * 1024 * 4)  # 2 leaves/bucket
+    leaves[0][5] = np.inf          # reverse order: leaf 0 = LAST bucket
+    arms = tuple("native" for _ in plan.buckets)
+    v = numerics.observe_grad_sync(leaves, "bucketed", 4,
+                                   plan=plan, arms=arms)
+    assert v is not None and v["op"] == "grad_sync"
+    bi = next(i for i, b in enumerate(plan.buckets) if 0 in b.indices)
+    assert v["bucket"] == bi
+    row = numerics.report()["steps"][-1]
+    assert row["grad_nonfinite"] == 1 and row["grad_norm"] > 0
+
+
+def test_record_step_rows_and_ledger_roundtrip(plane, tmp_path):
+    plane(numerics_enabled="true")
+    numerics.begin_step(0)
+    numerics.record_step(loss=2.5)
+    numerics.record_step(loss=2.25)
+    numerics.snr.observe("allreduce", 41.0)
+    rep = numerics.report()
+    assert [r["step"] for r in rep["steps"]] == [0, 1]
+    assert rep["steps"][0]["loss"] == 2.5
+    path = str(tmp_path / "NUMERICS_cpu.json")
+    numerics.save_ledger(path, platform="cpu")
+    numerics.reset()
+    out = numerics.load_ledger(path)
+    assert out["steps"] == 2 and out["baseline_keys"] == 1
+    assert numerics.report()["steps"][0]["loss"] == 2.5
+
+
+# ---------------------------------------------------------------------------
+# satellite: health registry payload-digest mode
+# ---------------------------------------------------------------------------
+
+def test_signature_payload_extends_hash():
+    base = hreg.signature_of("allreduce", "float32", 64, "sum", "native")
+    with_p = hreg.signature_of("allreduce", "float32", 64, "sum",
+                               "native", payload="abcd")
+    assert base != with_p
+    # empty payload keeps the metadata-only hash stable (pre-PR-9 sigs)
+    assert base == hreg.signature_of("allreduce", "float32", 64, "sum",
+                                     "native", payload="")
+
+
+def test_note_payload_splits_same_metadata_heads(plane):
+    # two ranks, same (op, dtype, count, seq) but DIFFERENT payloads:
+    # metadata-only signatures collide; payload mode hashes them apart
+    toks = {}
+    for rank, digest in ((0, "aaaa"), (1, "bbbb")):
+        toks[rank] = hreg.begin(rank, 9, op="allreduce", dtype="float32",
+                                count=64, reduction="sum")
+        hreg.note_payload(digest)
+        hreg.end(toks[rank])
+    h0, h1 = hreg.heads(0)["9"], hreg.heads(1)["9"]
+    assert h0["seq"] == h1["seq"] == 1
+    assert h0["sig"] != h1["sig"]
+
+
+def test_probed_coll_feeds_payload_digest(plane):
+    plane(numerics_enabled="true", health_enabled="true",
+          health_payload_digest="true")
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        c.coll.allreduce(c, d.from_ranks([np.ones(64, np.float32)] * N))
+        return hreg.heads(0)
+
+    heads = runtime.run_ranks(1, fn)[0]
+    sig = next(iter(heads.values()))["sig"]
+    # the same call WITHOUT payload mode hashes differently
+    health.reset()
+    var.registry.clear_cli("health_payload_digest")
+    var.registry.reset_cache()
+    heads2 = runtime.run_ranks(1, fn)[0]
+    assert next(iter(heads2.values()))["sig"] != sig
+
+
+# ---------------------------------------------------------------------------
+# satellite: checkpoint shard checksums
+# ---------------------------------------------------------------------------
+
+def _fake_ckpt(tmp_path):
+    d = tmp_path / "step_0000000001"
+    (d / "shard_a").mkdir(parents=True)
+    (d / "shard_a" / "data.bin").write_bytes(os.urandom(4096))
+    (d / "manifest.txt").write_text("ok")
+    return str(d)
+
+
+def test_ckpt_checksum_roundtrip(tmp_path):
+    from ompi_tpu import ckpt
+    path = _fake_ckpt(tmp_path)
+    digests = ckpt.write_checksums(path)
+    assert set(digests) == {os.path.join("shard_a", "data.bin"),
+                            "manifest.txt"}
+    assert ckpt.verify_checksums(path, rank=3) == 2
+
+
+def test_ckpt_checksum_names_bad_shard(tmp_path):
+    from ompi_tpu import ckpt
+    path = _fake_ckpt(tmp_path)
+    ckpt.write_checksums(path)
+    bad = os.path.join(path, "shard_a", "data.bin")
+    blob = bytearray(open(bad, "rb").read())
+    blob[100] ^= 0x40                   # the silent bit flip
+    open(bad, "wb").write(bytes(blob))
+    with pytest.raises(ckpt.CheckpointCorruptionError) as ei:
+        ckpt.verify_checksums(path, rank=5)
+    msg = str(ei.value)
+    assert os.path.join("shard_a", "data.bin") in msg
+    assert "rank 5" in msg
+
+
+def test_ckpt_missing_manifest_verifies_trivially(tmp_path):
+    from ompi_tpu import ckpt
+    path = _fake_ckpt(tmp_path)          # no manifest written
+    assert ckpt.verify_checksums(path) == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: disabled path — plain bool, zero events, zero state
+# ---------------------------------------------------------------------------
+
+def test_disabled_path_zero_state(plane):
+    # ONE attribute read per call site: a plain module bool, not a
+    # property/descriptor (the PR 5/6/7 bar extended to this plane)
+    assert numerics.enabled is False
+    assert isinstance(vars(numerics)["enabled"], bool)
+    trace.enable()
+
+    def fn(ctx):
+        c = ctx.comm_world
+        attach_mesh(c, make_mesh({"x": N}), "x")
+        d = c.device_comm
+        x = d.from_ranks([np.ones(64, np.float32)] * N)
+        c.coll.allreduce(c, x)
+        d.quant.allreduce(x)
+        return True
+
+    assert runtime.run_ranks(1, fn)[0]
+    assert numerics.pvar_value("numerics_samples") == 0.0
+    assert numerics.nonfinite.trips() == 0
+    assert numerics.snr.samples() == []
+    assert not [e for e in trace.events()
+                if str(e.get("name", "")).startswith("numerics_")]
+
+
+def test_enable_via_var_watcher(plane):
+    plane(numerics_enabled="true")
+    assert numerics.enabled is True
+    var.registry.clear_cli("numerics_enabled")
+    var.registry.reset_cache()
+    assert numerics.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# pvars + doctor arm
+# ---------------------------------------------------------------------------
+
+def test_pvars_in_spc_snapshot_and_prometheus(plane):
+    numerics.nonfinite.observe("allreduce", 0, _fp([1]), _fp([1]))
+    c = spc.Counters()
+    snap = c.snapshot()
+    for name in numerics.PVARS:
+        assert name in snap
+    assert snap["numerics_nonfinite_trips"] == 1
+    assert c.get("numerics_nonfinite_trips") == 1.0
+    text = c.export_prometheus()
+    assert 'ompi_tpu_numerics_nonfinite_trips{rank="0",comm="world"} 1' \
+        in text
+    with pytest.raises(KeyError):
+        numerics.pvar_value("numerics_nope")
+
+
+def test_doctor_numerics_report_live_and_banked(plane, tmp_path, capsys):
+    from ompi_tpu.tools.comm_doctor import build_numerics_report, main
+    numerics.nonfinite.observe("allreduce", 4, _fp([0, 1]), _fp([1, 1]),
+                               arm="native")
+    text, data = build_numerics_report()
+    assert "NON-FINITE" in text and "rank 1" in text
+    assert data["nonfinite"]["trips"] == 1
+    path = str(tmp_path / "NUMERICS_cpu.json")
+    numerics.save_ledger(path, platform="cpu")
+    numerics.reset()
+    text2, data2 = build_numerics_report(path)
+    assert "rank 1" in text2
+    assert data2["nonfinite"]["verdicts"][0]["step"] == 4
+    # --numerics PATH --json round-trips through the CLI
+    rc = main(["--numerics", path, "--json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["schema_version"] >= 4
+    assert out["numerics"]["nonfinite"]["trips"] == 1

@@ -564,6 +564,10 @@ def test_doctor_schema_version_all_modes(plane, capsys, tmp_path):
     rc, d = _doctor_json(capsys, ["--traffic", "--json"])
     assert rc == 0 and d["schema_version"] == SCHEMA_VERSION
     assert "traffic" in d
+    # --numerics mode (live, empty plane)
+    rc, d = _doctor_json(capsys, ["--numerics", "--json"])
+    assert rc == 0 and d["schema_version"] == SCHEMA_VERSION
+    assert "numerics" in d
 
 
 def test_doctor_traffic_report_heatmap(plane, capsys):
