@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
-	bench-compare
+	reshard-tests bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -22,8 +22,12 @@ SHELL := /bin/bash
 # wall-clock while moving exactly 1/n_inner of the bytes on the slow
 # plane; the numerics gate watches the payload itself — its probe
 # injects a NaN and a bit flip the plane must attribute to the exact
-# (rank, step, op) / (step, bucket, rank)
-tier1: health-tests perf-tests traffic-tests hier-tests numerics-tests
+# (rank, step, op) / (step, bucket, rank); the reshard gate closes the
+# sequence — its probe times a 4-transition layout-conversion suite
+# against the host round-trip it replaces and fails unless the device
+# plans win with every step decision-audited and conservation held
+tier1: health-tests perf-tests traffic-tests hier-tests numerics-tests \
+	reshard-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -101,6 +105,18 @@ numerics-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_numerics.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --numerics
+
+# the redistribution tier: plan compiler + executable cache + audit
+# suite, then the end-to-end probe (8 devices; a 4-transition 32 MiB
+# layout-conversion suite timed against the staged host round-trip it
+# replaces; exits nonzero unless the device plans win wall-clock, every
+# plan stays within its peak-bytes bound, every step emitted exactly
+# one decide:reshard event, and the traffic matrix's reshard bytes
+# equal the audited wire bytes; banks RESHARD_<platform>.json)
+reshard-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_reshard.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --reshard
 
 # regression gate over the banked trajectory artifact: non-zero exit
 # names every phase whose busbw/goodput/MFU column lost >10% (run it

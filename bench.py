@@ -2158,6 +2158,253 @@ def run_numerics_probe(platform: str) -> None:
         trace.disable()
 
 
+def _bank_reshard_baseline(doc: dict) -> None:
+    """Maintain the auto-measured reshard row in BASELINE.md between
+    RESHARD markers (replace-or-append — re-runs update in place)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BASELINE.md")
+    begin, end = "<!-- RESHARD:BEGIN -->", "<!-- RESHARD:END -->"
+    row = (
+        f"{begin}\n"
+        "### Device-native reshard (auto-measured: `python bench.py "
+        "--reshard`)\n\n"
+        "| platform | ndev | case | device ms | host ms | speedup | "
+        "busbw GB/s | plan steps | peak/bound bytes |\n"
+        "|---|---|---|---|---|---|---|---|---|\n"
+        f"| {doc['platform']} | {doc['ndev']} | `{doc['case']}` "
+        f"| {doc['device_ms']:.2f} | {doc['host_ms']:.2f} "
+        f"| {doc['value']:.2f}x | {doc['busbw_GBps']:.2f} "
+        f"| {doc['plan_steps']} | {doc['peak_bytes']}/"
+        f"{doc['bound_bytes']} |\n"
+        f"{end}")
+    try:
+        with open(path) as f:
+            txt = f.read()
+    except FileNotFoundError:
+        txt = ""
+    if begin in txt and end in txt:
+        txt = txt.split(begin)[0] + row + txt.split(end, 1)[1]
+    else:
+        txt = txt.rstrip("\n") + "\n\n" + row + "\n"
+    with open(path, "w") as f:
+        f.write(txt)
+
+
+def run_reshard_probe(platform: str) -> None:
+    """--reshard: end-to-end acceptance for the redistribution engine.
+    On the 8 devices, runs a 4-transition layout-conversion suite over
+    a 32 MiB array (axis move, tighten, untighten, identity — the mix
+    a train->decode parameter conversion sees) through the compiled
+    plan engine and through the host round-trip each one replaces (the
+    to_ranks/from_ranks idiom: stage every shard to host, reassemble,
+    re-place on the new layout), best-of-5 each.  The probe fails
+    unless the device plans win the suite wall-clock, every cached plan's peak-bytes accounting stays within
+    its declared bound, every executed step emitted exactly one
+    decide:reshard audit event, and the traffic matrix's reshard
+    attribution equals the audited wire bytes byte-for-byte (edge sums
+    == coll_wire_bytes, zero unattributed).  Banks busbw, plan-step
+    count and peak bytes to RESHARD_<platform>.json and maintains the
+    BASELINE.md row between the RESHARD markers."""
+    import jax
+
+    from ompi_tpu import perf, runtime, trace, traffic
+    from ompi_tpu.core import var
+    from ompi_tpu.parallel import attach_mesh, make_mesh
+    from ompi_tpu.parallel.reshard import (report as reshard_report,
+                                           reset as reshard_reset)
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"reshard probe: needs 8 devices, have {ndev}")
+
+    var.registry.set_cli("traffic_enabled", "true")
+    var.registry.set_cli("perf_enabled", "true")
+    # pin native so the audited wire model is the one traffic charges
+    var.registry.set_cli("coll_xla_mode", "native")
+    var.registry.reset_cache()
+    traffic.reset()
+    traffic.enable()
+    perf.reset()
+    perf.enable()
+    reshard_reset()
+    trace.enable()
+    SHAPE = (4096, 2048)                 # 32 MiB f32
+    CASE = "f32[4096,2048] 4-transition suite @ 8 dev"
+    ITERS = 5
+    try:
+        def fn(ctx):
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            c = ctx.comm_world
+            mesh = make_mesh({"x": 8})
+            attach_mesh(c, mesh, "x")
+            d = c.device_comm
+            mesh2 = make_mesh({"p": 4, "q": 2})
+            host = np.arange(SHAPE[0] * SHAPE[1],
+                             dtype=np.float32).reshape(SHAPE)
+
+            def host_path(x, dst):
+                # the round-trip reshard replaces (the to_ranks ->
+                # from_ranks idiom): stage every shard to host,
+                # reassemble, re-place on the new layout
+                h = np.empty(x.shape, x.dtype)
+                for s in x.addressable_shards:
+                    h[s.index] = np.asarray(s.data)
+                return jax.device_put(h, dst)
+
+            from ompi_tpu.parallel import reshard as reshard_fn
+
+            suite = [
+                (mesh, P("x", None), P(None, "x")),        # axis move
+                (mesh2, P("p", None), P("p", "q")),        # tighten
+                (mesh2, P(("p", "q"), None), P("p", None)),  # untighten
+                (mesh, P("x", None), P("x", None)),        # identity
+            ]
+            dev_s = host_s = 0.0
+            timings = []
+            for m, s_spec, d_spec in suite:
+                src = NamedSharding(m, s_spec)
+                dst = NamedSharding(m, d_spec)
+                # DeviceComm.reshard for the attached mesh; the free
+                # function (same engine) for its 2-D factoring
+                dev = (d.reshard if m is mesh else
+                       lambda v, t: reshard_fn(v, t, spc=ctx.spc))
+                x = jax.device_put(host, src)
+                jax.block_until_ready(x)
+                y_dev = dev(x, dst)            # warm: compiles cached
+                jax.block_until_ready(y_dev)
+                y_host = host_path(x, dst)
+                jax.block_until_ready(y_host)
+                if not np.array_equal(np.asarray(y_dev),
+                                      np.asarray(y_host)):
+                    raise SystemExit(
+                        "reshard probe: device plan and host "
+                        f"round-trip disagree bitwise on "
+                        f"{s_spec}->{d_spec}")
+                cd = ch = float("inf")
+                for _ in range(ITERS):
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(dev(x, dst))
+                    cd = min(cd, time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    jax.block_until_ready(host_path(x, dst))
+                    ch = min(ch, time.perf_counter() - t0)
+                dev_s += cd
+                host_s += ch
+                timings.append({"case": f"{s_spec}->{d_spec}",
+                                "device_ms": cd * 1e3,
+                                "host_ms": ch * 1e3})
+            # a multi-step composite on the 2-D factoring of the same
+            # devices: gather+slice+move plans, exercising every op row
+            a = jax.device_put(host[:512],
+                               NamedSharding(mesh2, P("p", "q")))
+            for spec in (P(("p", "q"), None), P(None, ("p", "q")),
+                         P("p", None), P(None, None), P("q", "p")):
+                a = reshard_fn(a, NamedSharding(mesh2, spec),
+                               spc=ctx.spc)
+            jax.block_until_ready(a)
+            if not np.array_equal(np.asarray(a), host[:512]):
+                raise SystemExit("reshard probe: composite chain "
+                                 "corrupted the array")
+            snap = ctx.spc.snapshot()
+            decides = [e for e in trace.events()
+                       if e.get("name") == "decide:reshard"]
+            return {
+                "device_s": dev_s, "host_s": host_s,
+                "timings": timings,
+                "decide_events": len(decides),
+                "pvars": {k: int(snap[k]) for k in
+                          ("reshard_plans", "reshard_steps",
+                           "reshard_bytes", "coll_wire_bytes",
+                           "traffic_attributed_bytes",
+                           "traffic_unattributed_bytes")},
+            }
+
+        res = runtime.run_ranks(1, fn)[0]
+        rep = reshard_report()
+        trep = traffic.report()
+        edge_sum = sum(e["bytes"] for e in trep["edges"])
+        host_plane = int(trep["planes"].get("host", 0))
+        pv = res["pvars"]
+        plans = rep["plans"]
+        # wire actually moved by the timed suite (its plans carry the
+        # probe SHAPE; the composite-chain plans are a smaller slab)
+        suite_wire = sum(p["wire_bytes"] for p in plans
+                         if p["plan"].endswith(str(list(SHAPE))))
+        busbw = suite_wire / res["device_s"] / 1e9
+        doc = {
+            "metric": "reshard_device_vs_host",
+            "value": round(res["host_s"] / res["device_s"], 3),
+            "unit": "x host round-trip wall-clock (must be > 1)",
+            "platform": platform, "ndev": ndev, "case": CASE,
+            "device_ms": res["device_s"] * 1e3,
+            "host_ms": res["host_s"] * 1e3,
+            "timings": res["timings"],
+            "busbw_GBps": busbw,
+            "plan_steps": int(sum(len(p["steps"]) for p in plans)),
+            "plan_count": len(plans),
+            "peak_bytes": int(max(p["peak_bytes"] for p in plans)),
+            "bound_bytes": int(max(p["bound_bytes"] for p in plans)),
+            "decide_events": res["decide_events"],
+            "conservation": {
+                "coll_wire_bytes": pv["coll_wire_bytes"],
+                "reshard_bytes": pv["reshard_bytes"],
+                "attributed_bytes": pv["traffic_attributed_bytes"],
+                "edge_bytes_sum": edge_sum,
+                "host_plane_bytes": host_plane,
+                "unattributed_bytes": pv["traffic_unattributed_bytes"],
+            },
+            "pvars": pv,
+            "report": rep,
+        }
+        with open(os.path.join(here, f"RESHARD_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k != "report"}), flush=True)
+
+        if res["device_s"] >= res["host_s"]:
+            raise SystemExit(
+                "reshard probe: device plans "
+                f"({res['device_s'] * 1e3:.2f} ms) did not beat the "
+                f"host round-trips ({res['host_s'] * 1e3:.2f} ms) "
+                f"over the suite: {res['timings']}")
+        over = [p for p in plans if p["peak_bytes"] > p["bound_bytes"]]
+        if over:
+            raise SystemExit(
+                "reshard probe: peak-bytes bound breached by "
+                f"{[p['plan'] for p in over]}")
+        if res["decide_events"] != pv["reshard_steps"]:
+            raise SystemExit(
+                "reshard probe: decision audit incomplete — "
+                f"{pv['reshard_steps']} step(s) executed but "
+                f"{res['decide_events']} decide:reshard event(s)")
+        if pv["traffic_unattributed_bytes"] != 0:
+            raise SystemExit(
+                "reshard probe: conservation breach — "
+                f"{pv['traffic_unattributed_bytes']} unattributed "
+                "byte(s)")
+        if edge_sum + host_plane != pv["coll_wire_bytes"]:
+            raise SystemExit(
+                "reshard probe: conservation breach — edge sum "
+                f"{edge_sum} (+{host_plane} host) != coll_wire_bytes "
+                f"{pv['coll_wire_bytes']}")
+        if int(trep["per_coll"].get("reshard", 0)) != pv["reshard_bytes"]:
+            raise SystemExit(
+                "reshard probe: traffic reshard attribution "
+                f"{trep['per_coll'].get('reshard', 0)} B != audited "
+                f"reshard wire bytes {pv['reshard_bytes']} B")
+        _bank_reshard_baseline(doc)
+    finally:
+        var.registry.clear_cli("traffic_enabled")
+        var.registry.clear_cli("perf_enabled")
+        var.registry.clear_cli("coll_xla_mode")
+        var.registry.reset_cache()
+        traffic.disable()
+        perf.disable()
+        trace.disable()
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--compare" in argv:
@@ -2203,6 +2450,9 @@ def main() -> None:
             return
         if "--numerics" in sys.argv[1:]:
             run_numerics_probe(platform)
+            return
+        if "--reshard" in sys.argv[1:]:
+            run_reshard_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

@@ -49,6 +49,17 @@ class CheckpointCorruptionError(RuntimeError):
     catch, one step after the rebuild."""
 
 
+class CheckpointShapeError(RuntimeError):
+    """restore() asked for a GLOBAL array shape different from the one
+    saved.
+
+    Mesh and sharding differences are fine — that's what shrink
+    recovery and train→serve conversion are — and restore reshards them
+    on device.  A different *global* shape is a different model/step;
+    reinterpreting the saved bytes onto it would be corruption with
+    extra steps, so it fails loudly naming the leaf and both shapes."""
+
+
 def _file_digest(path: str) -> str:
     h = hashlib.blake2s(digest_size=16)
     with open(path, "rb") as fh:
@@ -152,19 +163,78 @@ class AsyncSave:
                 write_checksums(self._path)
 
 
-def restore(path: str, like: Any, rank: int = 0) -> Any:
+def _check_global_shapes(path: str, like: Any, rank: int = 0) -> None:
+    """Best-effort pre-restore check of the saved GLOBAL shapes against
+    ``like``'s.  Metadata that cannot be read or matched keeps the old
+    behavior (orbax's own restore errors stand); a definite mismatch
+    raises :class:`CheckpointShapeError` naming the leaf."""
+    tu = jax.tree_util
+    mismatched = []
+    try:
+        meta = _ocp().StandardCheckpointer().metadata(path)
+        want = {tu.keystr(kp): tuple(x.shape)
+                for kp, x in tu.tree_leaves_with_path(like)
+                if hasattr(x, "shape")}
+        for kp, m in tu.tree_leaves_with_path(meta):
+            saved = tuple(getattr(m, "shape", ()) or ())
+            w = want.get(tu.keystr(kp))
+            if w is not None and saved and w != saved:
+                mismatched.append((tu.keystr(kp), saved, w))
+    except Exception:
+        return
+    if mismatched:
+        detail = "; ".join(f"{k}: saved {s} vs requested {w}"
+                           for k, s, w in mismatched[:8])
+        raise CheckpointShapeError(
+            f"checkpoint {path} global-shape mismatch on rank {rank}: "
+            f"{detail} — mesh/sharding changes reshard on device, but a "
+            "different global shape is a different model; refusing to "
+            "reinterpret the saved bytes")
+
+
+def restore(path: str, like: Any, rank: int = 0,
+            source_sharding: Any = None) -> Any:
     """Restore onto the shardings/dtypes/shapes of ``like`` (an abstract or
     concrete pytree). ``like`` may live on a DIFFERENT mesh than the save —
-    orbax reshards on read, which is what shrink-recovery needs.  Shard
+    restore reshards, which is what shrink-recovery needs.  Shard
     files are verified against the save-time checksum manifest first; a
     mismatch raises :class:`CheckpointCorruptionError` naming the bad
-    shard and rank."""
+    shard and rank, and a genuine global-shape mismatch raises
+    :class:`CheckpointShapeError` before any bytes move.
+
+    With ``source_sharding`` (one ``Sharding``, or a pytree of them
+    matching ``like``) the shards are read onto the SAVE-TIME layout
+    and then redistributed on device through the compiled
+    minimal-collective plan engine (``parallel/reshard``) — no host
+    round-trip, every step decision-audited and traffic-attributed.
+    Without it, the read itself targets ``like``'s layout (orbax
+    reshards on read through host IO)."""
     verify_checksums(path, rank=rank)
-    abstract = jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=_shard(x))
-        if hasattr(x, "shape") else x, like)
+    path = os.path.abspath(path)
+    _check_global_shapes(path, like, rank=rank)
     ckptr = _ocp().StandardCheckpointer()
-    return ckptr.restore(os.path.abspath(path), abstract)
+    if source_sharding is None:
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=_shard(x))
+            if hasattr(x, "shape") else x, like)
+        return ckptr.restore(path, abstract)
+    if isinstance(source_sharding, jax.sharding.Sharding):
+        src_tree = jax.tree.map(lambda x: source_sharding, like)
+    else:
+        src_tree = source_sharding
+    abstract = jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s)
+        if hasattr(x, "shape") else x, like, src_tree)
+    got = ckptr.restore(path, abstract)
+    from .parallel.reshard import reshard as _reshard
+
+    def _relayout(g, ref):
+        dst = _shard(ref)
+        if dst is None or not hasattr(g, "shape"):
+            return g
+        return _reshard(g, dst)
+    return jax.tree.map(_relayout, got, like)
 
 
 def _shard(x):
@@ -234,13 +304,16 @@ class CheckpointManager:
         for s in steps[:-self.keep]:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
-    def restore(self, step: int, like: Any) -> Any:
+    def restore(self, step: int, like: Any,
+                source_sharding: Any = None) -> Any:
         self.wait()
-        return restore(self._step_dir(step), like)
+        return restore(self._step_dir(step), like,
+                       source_sharding=source_sharding)
 
-    def restore_latest(self, like: Any) -> Any:
+    def restore_latest(self, like: Any,
+                       source_sharding: Any = None) -> Any:
         step = self.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
-        return self.restore(step, like)
+        return self.restore(step, like, source_sharding=source_sharding)

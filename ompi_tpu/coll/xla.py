@@ -103,6 +103,13 @@ _var.register("coll", "xla", "collmm_mode", "", type=str, level=3,
                    "(native = unidirectional ring | bidir = two "
                    "half-rings on both ICI directions; empty = auto "
                    "via DEVICE_RULES collmm rows).")
+_var.register("coll", "xla", "reshard_mode", "", type=str, level=3,
+              help="Force the reshard plan-step arm (native; empty = "
+                   "auto via DEVICE_RULES reshard rows / the learned "
+                   "ledger). Plan steps are layout-pure single "
+                   "collectives, so native is the only executable arm "
+                   "today; the var exists so the decision chain stays "
+                   "uniform and future staged/quant step arms slot in.")
 _var.register("coll", "xla", "rules", "", type=str, level=3,
               help="Arm-selection source: empty/'static' = platform "
                    "default + DEVICE_RULES rows; 'learned' = consult "

@@ -198,6 +198,61 @@ def shard_params(params: Dict, mesh: Mesh, cfg: Config) -> Dict:
         params, specs, is_leaf=lambda x: isinstance(x, P))
 
 
+def decode_param_specs(cfg: Config) -> Dict:
+    """Decode/serving layout: weight-stationary column-parallel.  Train's
+    row-parallel weights (wo, w_down) flip to sharding their OUTPUT
+    features over `tp` — decode is a latency-bound GEMV stream, so every
+    matmul keeps the per-token activation sharded over tp and defers the
+    combine instead of paying a psum mid-layer — and the embedding flips
+    from vocab- to model-dim sharding so the logits matmul streams vocab
+    columns without an all-gather of the hidden state."""
+    layer = {
+        "attn_norm": P(),
+        "wqkv": P(None, "tp"),
+        "wo": P(None, "tp"),
+        "mlp_norm": P(),
+    }
+    if cfg.mlp == "moe":
+        from .moe import moe_param_specs
+        layer["moe"] = moe_param_specs()
+    else:
+        layer.update({
+            "w_gate": P(None, "tp"),
+            "w_up": P(None, "tp"),
+            "w_down": P(None, "tp"),
+        })
+    return {
+        "embed": P(None, "tp"),
+        "final_norm": P(),
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+    }
+
+
+def convert_params(params: Dict, mesh: Mesh, cfg: Config,
+                   to: str = "decode") -> Dict:
+    """Switch a sharded parameter tree between the train and decode
+    layouts entirely on device: each leaf whose spec differs moves
+    through the compiled minimal-collective reshard engine
+    (parallel/reshard) — no host round-trip, every plan step
+    decision-audited and traffic-attributed under coll ``reshard``.
+    Leaves already in the target layout compile to the empty plan and
+    are returned untouched."""
+    if to == "decode":
+        specs = decode_param_specs(cfg)
+    elif to == "train":
+        specs = param_specs(cfg)
+    else:
+        raise ValueError(f"convert_params: to={to!r} (want train|decode)")
+    from ..parallel.reshard import reshard as _reshard
+
+    def fit(s: P) -> P:
+        return P(*(a if a in mesh.axis_names else None for a in s))
+
+    return jax.tree.map(
+        lambda x, s: _reshard(x, NamedSharding(mesh, fit(s)), mesh=mesh),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
 # -- model ------------------------------------------------------------------
 
 def _rms_norm(x, w):

@@ -35,6 +35,14 @@ from .collectives import (  # noqa: F401
     reduce_scatter_axis,
     ring_shift,
 )
+from .reshard import (  # noqa: F401
+    ReshardError,
+    ReshardPlan,
+    Resharder,
+    compile_plan,
+    reshard,
+    resharder,
+)
 
 
 def attach_mesh(comm, mesh, axis) -> None:

@@ -122,6 +122,26 @@ def reduce_scatter_axis(x, axis: str):
 
 
 def all_to_all_axis(x, axis: str, split_dim: int = 0, concat_dim: int = 0):
+    """Tiled all_to_all over a named axis (or tuple of axes): the local
+    ``split_dim`` is scattered across the axis while each peer's block
+    concatenates along ``concat_dim``.
+
+    A ``split_dim`` that does not divide by the axis size is handled
+    exactly with the zero-pad trick hierarchical_psum uses: the dim is
+    padded to the next multiple of the axis size, so every peer receives
+    an equal ceil-sized block.  The result follows the padded-block
+    convention — position p along the axis holds rows
+    ``[p*ceil, (p+1)*ceil)`` of the true extent, zeros past the end — so
+    the inverse (``all_gather`` on the same dim + a ``[:L]`` slice)
+    reconstructs the original bit-exactly.  Reshard plans lean on this
+    to keep ragged exchanges on device instead of bouncing through host.
+    """
+    n = int(lax.psum(1, axis))     # static axis size under shard_map
+    L = x.shape[split_dim]
+    if L % n:
+        pad = [(0, 0)] * x.ndim
+        pad[split_dim] = (0, -(-L // n) * n - L)
+        x = jnp.pad(x, pad)
     return lax.all_to_all(x, axis, split_axis=split_dim,
                           concat_axis=concat_dim, tiled=True)
 
@@ -130,11 +150,28 @@ def ppermute(x, axis: str, perm: Sequence[Tuple[int, int]]):
     return lax.ppermute(x, axis, perm=list(perm))
 
 
-def ring_shift(x, axis: str, n: int, shift: int = 1):
+def ring_shift(x, axis: str, n: int, shift: int = 1, steps: int = 1):
     """Neighbor exchange on a ring — the schedule ring attention and the
-    ring/segmented-ring collectives share (coll_base_allreduce.c:344,621)."""
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis, perm=perm)
+    ring/segmented-ring collectives share (coll_base_allreduce.c:344,621).
+
+    ``steps > 1`` is the strided variant: the rotation decomposes into
+    ``steps`` sequential hops of stride ``shift/steps`` (which must
+    divide), the segmented-ring shape that bounds per-hop link pressure
+    and gives the overlap tier ``steps`` interleaving points instead of
+    one monolithic permute."""
+    steps = int(steps)
+    if steps <= 1:
+        perm = [(i, (i + shift) % n) for i in range(n)]
+        return lax.ppermute(x, axis, perm=perm)
+    if shift % steps:
+        raise ValueError(
+            f"ring_shift: shift {shift} does not decompose into "
+            f"{steps} equal strides (shift % steps must be 0)")
+    stride = shift // steps
+    perm = [(i, (i + stride) % n) for i in range(n)]
+    for _ in range(steps):
+        x = lax.ppermute(x, axis, perm=perm)
+    return x
 
 
 def pbcast(x, axis: str, root: int = 0):
@@ -207,6 +244,17 @@ class DeviceComm:
     def to_ranks(self, x: jax.Array) -> list:
         host = np.asarray(jax.device_get(x))
         return [host[i] for i in range(host.shape[0])]
+
+    def reshard(self, x: jax.Array, dst) -> jax.Array:
+        """Device-native relayout of ``x`` onto ``dst`` (a NamedSharding
+        or PartitionSpec over this comm's mesh) through the compiled
+        minimal-collective plan engine (parallel/reshard) — the
+        replacement for ``to_ranks()``/``from_ranks()`` round-trips:
+        no host copy, peak live bytes bounded by ``reshard_peak_factor
+        × max(src_shard, dst_shard)``, every plan step decision-audited
+        and traffic-attributed under coll name ``reshard``."""
+        from .reshard import reshard as _reshard
+        return _reshard(x, dst, mesh=self.mesh, spc=self.spc)
 
     # -- multi-process (rank-per-chip) layout helpers -----------------------
     # In the device-plane model (parallel/device_plane.py) each process owns
@@ -433,9 +481,25 @@ class DeviceComm:
 
         return self._compiled(key, build)(x)
 
-    def ring_shift(self, x: jax.Array, shift: int = 1) -> jax.Array:
+    def ring_shift(self, x: jax.Array, shift: int = 1,
+                   steps: int = 1) -> jax.Array:
         """(R,*e) → (R,*e) with row i moved to row (i+shift)%R — the ppermute
-        ring primitive (context-parallel neighbor exchange)."""
+        ring primitive (context-parallel neighbor exchange).
+
+        ``steps > 1`` runs the strided decomposition: ``steps``
+        sequential hops of stride ``shift/steps`` (must divide), each a
+        cached one-hop executable with its own traffic attribution — the
+        segmented-ring schedule whose intermediate rows an overlap tier
+        can consume between hops."""
+        if int(steps) > 1:
+            if shift % int(steps):
+                raise ValueError(
+                    f"ring_shift: shift {shift} does not decompose into "
+                    f"{steps} equal strides (shift % steps must be 0)")
+            stride = shift // int(steps)
+            for _ in range(int(steps)):
+                x = self.ring_shift(x, stride)
+            return x
         R = x.shape[0]
         r = R // self.n
         key = ("ring", int(shift), x.shape, str(x.dtype))

@@ -181,6 +181,25 @@ def note_planes(planes: Dict[str, int]) -> None:
         st[-1]["planes"] = split
 
 
+def note_sample(coll: str, arm: str, nbytes: int, dur_s: float,
+                ndev: int, planes: Optional[Dict[str, int]] = None) -> None:
+    """Bank one already-measured collective sample from outside the
+    dispatch wrapper — the reshard executor times each plan step itself
+    (plan steps never pass through timed_coll).  Grows the same flat
+    and ``<coll>@<plane>`` cells the dispatch path feeds, so
+    ``coll_xla_rules=learned`` reads reshard history like any other
+    coll's."""
+    if not enabled or not arm or int(ndev) < 2 or not nbytes:
+        return
+    dur = max(float(dur_s), 0.0)
+    model.record(coll, str(arm), int(nbytes), dur, int(ndev))
+    sentry.observe_coll(coll, str(arm), int(nbytes), dur, int(ndev))
+    for plane, pb in (planes or {}).items():
+        if plane != "host" and int(pb) > 0:
+            model.record(f"{coll}@{plane}", str(arm), int(pb), dur,
+                         int(ndev))
+
+
 # ---- sample source 2: the trace span sink ----------------------------
 
 def _ingest_span(name: str, cat: str, t_begin: float, t_end: float,

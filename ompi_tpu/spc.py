@@ -78,6 +78,10 @@ COUNTERS = [
      "hot-link sentry trips (one directed edge carrying "
      "disproportionate bytes)"),
     ("traffic_edge_count", "directed mesh edges holding attributed bytes"),
+    # redistribution engine (fed by ompi_tpu/parallel/reshard; process-wide)
+    ("reshard_plans", "reshard plans compiled (plan-cache misses)"),
+    ("reshard_steps", "reshard plan steps executed"),
+    ("reshard_bytes", "modeled per-rank wire bytes moved by reshard steps"),
     # numerics plane (fed by ompi_tpu/numerics; process-wide)
     ("numerics_samples",
      "payload fingerprints taken at collective / grad-sync boundaries"),
@@ -134,6 +138,14 @@ class Counters:
             from . import numerics
             if name in numerics.PVARS:
                 return numerics.pvar_value(name)
+        if name.startswith("reshard_"):
+            # direct submodule import: the package re-exports the
+            # reshard() function under the same name, shadowing the
+            # module attribute
+            from .parallel.reshard import PVARS as _rpv, \
+                pvar_value as _rpval
+            if name in _rpv:
+                return _rpval(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -151,6 +163,9 @@ class Counters:
             out[name] = traffic.pvar_value(name)
         for name in numerics.PVARS:
             out[name] = numerics.pvar_value(name)
+        from .parallel.reshard import PVARS as _rpv, pvar_value as _rpval
+        for name in _rpv:
+            out[name] = _rpval(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

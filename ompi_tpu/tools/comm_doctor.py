@@ -30,10 +30,11 @@ from ..trace import analyze as _an
 from ..trace import merge as _merge
 
 # bumped whenever any --json report mode changes shape; every mode
-# (default merge, --health-dump, --perf, --traffic, --numerics, --live)
-# emits it so downstream tooling can detect drift (ISSUE 7 satellite;
-# 4 = the numerics plane section, ISSUE 9)
-SCHEMA_VERSION = 4
+# (default merge, --health-dump, --perf, --traffic, --numerics,
+# --reshard, --live) emits it so downstream tooling can detect drift
+# (ISSUE 7 satellite; 4 = the numerics plane section, ISSUE 9;
+# 5 = the reshard plan-cache/last-plan section, ISSUE 10)
+SCHEMA_VERSION = 5
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -383,6 +384,58 @@ def build_numerics_report(
     return "\n".join(lines), rep
 
 
+def build_reshard_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the redistribution engine:
+    plan/step/byte counters, the compiled-plan cache (op sequence, wire
+    bytes, peak-vs-bound accounting, device_put fallback reasons) and
+    the last executed plan's per-step decision audit. ``path`` loads a
+    banked RESHARD json (bench.py --reshard); default reads the live
+    in-process engine."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from ..parallel.reshard import report as _rs_report
+        rep = _rs_report()
+    lines: List[str] = []
+    w = lines.append
+    c = rep.get("counters") or {}
+    src = f" (from {path})" if path else ""
+    w(f"reshard engine: {int(c.get('reshard_plans', 0))} plan(s) "
+      f"compiled, {int(c.get('reshard_steps', 0))} step(s) executed, "
+      f"{int(c.get('reshard_bytes', 0))} modeled wire byte(s){src}")
+    plans = rep.get("plans") or []
+    if plans:
+        w("  plan cache:")
+        for p in plans[-12:]:
+            steps = p.get("steps") or []
+            w(f"    {p.get('plan')}: "
+              + (" -> ".join(steps) if steps else "(identity)"))
+            w(f"      wire {int(p.get('wire_bytes', 0))} B, peak "
+              f"{int(p.get('peak_bytes', 0))} B within bound "
+              f"{int(p.get('bound_bytes', 0))} B"
+              + (f"  [fallback: {p['fallback_reason']}]"
+                 if p.get("fallback_reason") else ""))
+    else:
+        w("  plan cache empty (no reshard compiled yet)")
+    last = rep.get("last")
+    if last:
+        w(f"  last plan: {last.get('plan')} — "
+          f"{len(last.get('steps') or [])} step(s), "
+          f"{int(last.get('wire_bytes', 0))} B wire, peak "
+          f"{int(last.get('peak_bytes', 0))}/"
+          f"{int(last.get('bound_bytes', 0))} B")
+        for s in (last.get("steps") or [])[:12]:
+            dur = s.get("dur_us")
+            w(f"    step {s.get('step')}: {s.get('op')} -> "
+              f"{s.get('arm')} ({s.get('reason')}), "
+              f"{int(s.get('wire_bytes', 0))} B"
+              + (f", {dur} us" if dur is not None else ""))
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -437,6 +490,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "step telemetry. With a path, loads a banked "
                          "NUMERICS json (bench.py --numerics); bare "
                          "flag reads the live in-process plane")
+    ap.add_argument("--reshard", nargs="?", const="", default=None,
+                    metavar="RESHARD.json",
+                    help="render the redistribution-engine section: "
+                         "plan cache (op sequences, wire/peak "
+                         "accounting), last-plan per-step decision "
+                         "audit. With a path, loads a banked RESHARD "
+                         "json (bench.py --reshard); bare flag reads "
+                         "the live in-process engine")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -472,8 +533,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         tl = _merge.merge(_merge.load_chrome(traces)) if traces else None
         return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
-        if ns.perf or ns.traffic is not None or ns.numerics is not None:
-            # perf/traffic/numerics section standalone
+        if (ns.perf or ns.traffic is not None or ns.numerics is not None
+                or ns.reshard is not None):
+            # perf/traffic/numerics/reshard section standalone
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
@@ -506,6 +568,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         ntext, ndata = build_numerics_report(ns.numerics or None)
         text = (text + "\n" + ntext) if text else ntext
         data["numerics"] = ndata
+    if getattr(ns, "reshard", None) is not None:
+        rtext, rdata = build_reshard_report(ns.reshard or None)
+        text = (text + "\n" + rtext) if text else rtext
+        data["reshard"] = rdata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

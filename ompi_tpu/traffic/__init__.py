@@ -193,6 +193,44 @@ def note_ring(mesh, axis: str, nbytes: int, coll: str,
     _charge(mesh, coll, nbytes, ring_edges(mesh, axis, direction))
 
 
+def note_reshard_step(mesh, kind: str, axes, wire: int,
+                      pairs: Optional[Sequence[Tuple[int, int]]] = None,
+                      coll: str = "reshard") -> Dict[str, int]:
+    """Attribute one reshard plan step's wire bytes to its real edge
+    set and return the per-plane split (plan steps carry their own
+    timing, so the reshard executor banks the split into the perf
+    ledger itself instead of riding timed_coll's in-flight entry).
+
+    kind: 'ring' — all_gather's forward chunk ring over the axis;
+    'a2a' — all_to_all / device_put full bipartite exchange over the
+    (possibly joint) axis group; 'perm' — ppermute's explicit
+    (src, dst) pairs over the joint axis space.  ``spread`` is exact
+    (largest-remainder), so edge sums equal ``wire`` byte-for-byte and
+    the conservation invariant covers resharding traffic."""
+    wire = int(wire)
+    ax = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+    axis: Any = ax[0] if len(ax) == 1 else ax
+    if wire <= 0:
+        return {}
+    if kind == "ring":
+        edges = ring_edges(mesh, axis, "fwd")
+    elif kind == "a2a":
+        edges = bipartite_edges(mesh, axis)
+    elif kind == "perm":
+        edges = perm_edges(mesh, axis, pairs or ())
+    else:
+        raise ValueError(f"note_reshard_step: unknown kind {kind!r} "
+                         "(want ring|a2a|perm)")
+    if not edges:
+        matrix.charge_unattributed(coll, wire)
+        return {}
+    pf = plane_fn(mesh)
+    parts = spread(wire, edges)
+    matrix.charge(coll, wire, parts, pf)
+    sentry.check(matrix.snapshot_edges())
+    return plane_split(parts, pf)
+
+
 # hierarchical split ledger (comm_doctor --traffic verdict line): the
 # accumulated inner (ICI RS+AG) vs outer (DCN allreduce) attribution
 # plus the native-outer expectation — outer bytes above the expectation
