@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
-	reshard-tests bench-compare
+	reshard-tests analysis-tests comm-lint bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -25,9 +25,13 @@ SHELL := /bin/bash
 # (rank, step, op) / (step, bucket, rank); the reshard gate closes the
 # sequence — its probe times a 4-transition layout-conversion suite
 # against the host round-trip it replaces and fails unless the device
-# plans win with every step decision-audited and conservation held
-tier1: health-tests perf-tests traffic-tests hier-tests numerics-tests \
-	reshard-tests
+# plans win with every step decision-audited and conservation held;
+# the analysis gate runs before any of it — the static verifier and
+# comm-lint are pure CPU/AST work that catches a malformed collective
+# program or an unaudited dispatch path without spending a single
+# measured second
+tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
+	numerics-tests reshard-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -117,6 +121,24 @@ reshard-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_reshard.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --reshard
+
+# the static-analysis tier: jaxpr collective extraction + SPMD checks
+# + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe
+# (extracts the flagship train step's and a reshard plan's collective
+# programs on the 8-dev mesh and exits nonzero unless the static wire
+# prediction equals the runtime traffic attribution byte-for-byte;
+# banks ANALYZE_<platform>.json) — plus the lint gate itself
+analysis-tests: comm-lint
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --analyze
+
+# repo-invariant comm-lint (rules CL001-CL006, justified waivers only)
+# plus the DEVICE_RULES grammar validator; nonzero on any unwaived
+# finding — cheap enough to run on every edit
+comm-lint:
+	python -m ompi_tpu.analysis.lint ompi_tpu
+	python -m ompi_tpu.analysis.rules DEVICE_RULES.txt
 
 # regression gate over the banked trajectory artifact: non-zero exit
 # names every phase whose busbw/goodput/MFU column lost >10% (run it

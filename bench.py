@@ -2190,6 +2190,104 @@ def _bank_reshard_baseline(doc: dict) -> None:
         f.write(txt)
 
 
+def run_analyze_probe(platform: str) -> None:
+    """--analyze: end-to-end acceptance for the static communication
+    verifier.  Extracts the collective program of (a) the flagship
+    train step with the perleaf grad-sync scheduler and (b) a compiled
+    reshard plan with a real all_to_all step, runs the SPMD
+    well-formedness checks, and executes the equivalent eager
+    attributed paths under the traffic plane — the probe fails unless
+    the static wire prediction equals the runtime per-coll attribution
+    **byte-for-byte** on both programs and no check raises an error
+    issue.  Banks both reports to ANALYZE_<platform>.json."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ompi_tpu import traffic
+    from ompi_tpu.analysis import commgraph
+    from ompi_tpu.core import var
+    from ompi_tpu.models.transformer import (Config, init_params, loss_fn,
+                                             make_train_step)
+    from ompi_tpu.parallel import make_mesh, overlap
+    from ompi_tpu.parallel.reshard import Resharder, compile_plan
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"analyze probe: needs 8 devices, have {ndev}")
+
+    var.registry.set_cli("traffic_enabled", "true")
+    var.registry.reset_cache()
+    traffic.reset()
+    traffic.enable()
+    try:
+        # (a) flagship-shaped train step: the jitted program is the
+        # static side; the runtime side replays the identical perleaf
+        # grad-sync eagerly (inside the jit the note models see
+        # tracers and stay silent by design)
+        mesh = make_mesh({"dp": 8})
+        cfg = Config(grad_sync="perleaf")
+        params = init_params(jax.random.key(0), cfg)
+        init_opt, step = make_train_step(cfg, mesh)
+        opt_state = init_opt(params)
+        tokens = jnp.zeros((8, cfg.seq + 1), jnp.int32)
+        vg = overlap.make_grad_sync(
+            "perleaf", mesh, lambda p, t: loss_fn(p, t, cfg, None))
+        rep_step = commgraph.verify(
+            step, (params, opt_state, tokens), mesh,
+            coll_map={"grad_sync": "psum_ring"},
+            runner=lambda: jax.block_until_ready(vg(params, tokens)),
+            source="flagship-train-step")
+        print(rep_step.summary(), flush=True)
+
+        # (b) a reshard plan with a real collective step (the axis-move
+        # transition compiles to one tiled all_to_all, never a blanket
+        # gather): plan-lifted graph vs the executor's audited charges
+        mesh_x = make_mesh({"x": 8})
+        plan = compile_plan((64, 8), jnp.float32, P("x", None),
+                            P(None, "x"), mesh_x)
+        g = commgraph.from_reshard_plan(plan)
+        rs = Resharder(mesh_x)
+        x = jax.device_put(
+            np.arange(64 * 8, dtype=np.float32).reshape(64, 8),
+            NamedSharding(mesh_x, P("x", None)))
+        rep_plan = commgraph.verify(
+            lambda: None, (), mesh_x, graph=g,
+            coll_map={"reshard": "reshard"},
+            runner=lambda: jax.block_until_ready(rs.run(x, P(None, "x"))))
+        print(rep_plan.summary(), flush=True)
+
+        doc = {
+            "metric": "static_vs_runtime_wire_bytes",
+            "value": int(rep_step.ok and rep_plan.ok),
+            "unit": "1 = byte-for-byte agreement on both programs",
+            "platform": platform, "ndev": ndev,
+            "train_step": rep_step.to_json(),
+            "reshard_plan": rep_plan.to_json(),
+        }
+        with open(os.path.join(here, f"ANALYZE_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k not in ("train_step", "reshard_plan")}),
+              flush=True)
+
+        if not rep_step.rows or not rep_plan.rows:
+            raise SystemExit(
+                "analyze probe: a program produced no comparable wire "
+                f"rows (step: {rep_step.rows}, plan: {rep_plan.rows})")
+        for rep in (rep_step, rep_plan):
+            if not rep.ok:
+                raise SystemExit(
+                    f"analyze probe: static/runtime disagreement or "
+                    f"check failure —\n{rep.summary()}")
+    finally:
+        var.registry.clear_cli("traffic_enabled")
+        var.registry.reset_cache()
+        traffic.disable()
+
+
 def run_reshard_probe(platform: str) -> None:
     """--reshard: end-to-end acceptance for the redistribution engine.
     On the 8 devices, runs a 4-transition layout-conversion suite over
@@ -2453,6 +2551,9 @@ def main() -> None:
             return
         if "--reshard" in sys.argv[1:]:
             run_reshard_probe(platform)
+            return
+        if "--analyze" in sys.argv[1:]:
+            run_analyze_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

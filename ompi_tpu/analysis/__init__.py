@@ -1,0 +1,44 @@
+"""Static communication verifier (MPI-Checker/MUST discipline at the
+jaxpr level) plus the repo-invariant comm-lint.
+
+Three modules:
+
+* ``commgraph`` — extract the collective program of any jitted
+  function (or a compiled reshard plan) into a ``CommGraph``, run the
+  SPMD well-formedness checks (sequence matching, ppermute bijections,
+  hier axis cover, device->host transfers), and predict per-collective
+  wire bytes with the same busbw-factor models ``perf/model.py`` and
+  the traffic plane charge — ``verify()`` cross-checks the static
+  figure against the runtime attribution byte-for-byte.
+* ``lint`` — AST comm-lint over the tree: rules CL001–CL006 encode the
+  plane contracts (decision-audited dispatch, exception-safe spans,
+  pvar read-through, one-attribute-read disabled paths, the decision
+  reason grammar, osc epoch discipline).
+* ``rules`` — the DEVICE_RULES grammar authority shared by the
+  dispatch-time loader and CI.
+
+``rules`` and ``lint`` are import-light (no jax); ``commgraph`` pulls
+jax and is loaded lazily so ``coll/xla -> analysis.rules`` stays a
+cheap import edge.
+"""
+
+from __future__ import annotations
+
+_COMMGRAPH_NAMES = (
+    "CollRecord", "CommGraph", "Issue", "VerifyReport",
+    "extract", "from_reshard_plan", "verify",
+)
+_LINT_NAMES = ("Finding", "lint_paths", "lint_sources", "RULES")
+
+__all__ = list(_COMMGRAPH_NAMES) + list(_LINT_NAMES) + ["rules"]
+
+
+def __getattr__(name: str):
+    import importlib
+    if name in ("rules", "lint", "commgraph"):
+        return importlib.import_module(f".{name}", __name__)
+    if name in _COMMGRAPH_NAMES:
+        return getattr(importlib.import_module(".commgraph", __name__), name)
+    if name in _LINT_NAMES:
+        return getattr(importlib.import_module(".lint", __name__), name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

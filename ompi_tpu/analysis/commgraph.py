@@ -1,0 +1,570 @@
+"""Static collective-program extraction + SPMD verification.
+
+MPI's static collective-matching verifiers (MPI-Checker, MUST) prove a
+communication program well-formed before it runs: every rank issues
+the same (op, communicator, dtype, count) sequence, point-to-point
+patterns pair up, nothing escapes the accounted path.  The jaxpr is
+this repo's communication program: one traced SPMD program whose
+collective eqns (psum / ppermute / all_to_all / all_gather /
+reduce_scatter) carry axis names, dtypes and per-shard shapes — so the
+same discipline applies *before dispatch*, which is exactly the proof
+obligation the observe->decide->act loop (ROADMAP item 5) needs under
+it: a policy layer may only rewrite arms live over a program that is
+statically known to be well-formed.
+
+Three consumers:
+
+* ``extract(fn, *args)`` — walk the closed jaxpr of any jittable
+  callable into a ``CommGraph`` of ``CollRecord``s (recursing through
+  pjit / shard_map / scan / while / cond / remat / custom-vjp bodies,
+  multiplying scan trip counts through).
+* ``from_reshard_plan(plan)`` — the reshard plan compiler's step list
+  is already a static collective program; lift it into the same
+  representation so bijection/axis checks and wire prediction apply.
+* ``verify(fn, args, mesh)`` — checks + static wire prediction + a
+  live run under the traffic plane, comparing the static figure with
+  the runtime per-coll attribution **byte-for-byte** (same integer
+  expressions as the runtime note models, same 2(r-1)/r-style factors
+  as ``perf/model.py`` — ``tests/test_analysis.py`` pins the factor
+  agreement against ``perf.model._FACTOR``).
+
+What the extractor can and cannot see: explicit collectives (shard_map
+programs, pmean/psum under vmap-style axes) appear as eqns; the psums
+GSPMD *inserts* during SPMD partitioning of an auto-sharded jit do
+not exist at trace time and are invisible here — consistently with
+the runtime side, which never attributes them either (the traffic
+plane charges through wrapper-level note models and the audited
+dispatch layer, both of which run outside XLA's partitioner).  Both
+ledgers therefore cover the same program: the explicitly-dispatched
+collectives.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# collective primitives -> canonical op name (jax names reduce_scatter's
+# primitive "reduce_scatter"; lax.psum_scatter builds it)
+_COLL_PRIMS = {
+    "psum": "psum",
+    "pmin": "pmin",
+    "pmax": "pmax",
+    "ppermute": "ppermute",
+    "all_to_all": "all_to_all",
+    "all_gather": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "psum_scatter": "reduce_scatter",
+}
+
+# primitives that move device data through the host inside a traced
+# program — a device->host round-trip hiding in a device path
+_HOST_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "infeed", "outfeed", "host_local_array_to_global_array",
+})
+
+# eqn params that hold subjaxprs we recurse into (plus 'branches' for
+# cond/switch, handled specially for divergence detection)
+_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
+                    "fun_jaxpr", "fwd_jaxpr_thunk")
+
+
+@dataclass(frozen=True)
+class CollRecord:
+    """One collective eqn operand in program order."""
+    op: str                          # canonical op name
+    axes: Tuple[str, ...]            # mesh axis names the eqn reduces over
+    dtype: str
+    shape: Tuple[int, ...]           # per-shard payload shape (inside
+    #                                  shard_map avals are per-device)
+    nbytes: int                      # payload bytes per executed call
+    trips: int = 1                   # enclosing-scan length product
+    perm: Tuple[Tuple[int, int], ...] = ()   # ppermute (src, dst) pairs
+    path: str = ""                   # eqn nesting, e.g. pjit/shard_map/scan
+    bounded: bool = True             # False under a data-dependent while
+
+    @property
+    def total_bytes(self) -> int:
+        return self.nbytes * self.trips
+
+    @property
+    def control(self) -> bool:
+        """Scalar payloads are control-plane figures (loss means, flags):
+        the runtime note models exclude them from wire attribution, so
+        the static wire models do too (they ride the same wire in O(1)
+        bytes)."""
+        return self.shape == ()
+
+    def signature(self) -> Tuple[str, Tuple[str, ...], str, int]:
+        """The MPI-Checker matching tuple: (op, axes, dtype, count)."""
+        count = int(np.prod(self.shape)) if self.shape else 1
+        return (self.op, self.axes, self.dtype, count * self.trips)
+
+
+@dataclass(frozen=True)
+class Issue:
+    kind: str        # bijection|mismatch|hier-cover|host-transfer|
+    #                  unknown-axis|unbounded
+    msg: str
+    severity: str = "error"          # error | warn
+
+
+@dataclass
+class CommGraph:
+    """The extracted collective program."""
+    records: List[CollRecord] = field(default_factory=list)
+    host_transfers: List[str] = field(default_factory=list)
+    divergent_conds: List[str] = field(default_factory=list)
+    source: str = ""
+
+    # -- extraction helpers -------------------------------------------
+
+    def signatures(self) -> List[Tuple]:
+        return [r.signature() for r in self.records]
+
+    def by_op(self) -> Dict[str, List[CollRecord]]:
+        out: Dict[str, List[CollRecord]] = {}
+        for r in self.records:
+            out.setdefault(r.op, []).append(r)
+        return out
+
+    # -- SPMD well-formedness checks ----------------------------------
+
+    def check(self, mesh=None) -> List[Issue]:
+        """All static checks; ``mesh`` (a jax Mesh or {axis: size}
+        mapping) enables the axis-existence / permutation-range /
+        hier-cover checks."""
+        sizes = _axis_sizes(mesh)
+        issues: List[Issue] = []
+        issues += self._check_bijections(sizes)
+        issues += self._check_axes(sizes)
+        issues += self._check_hier_cover(sizes)
+        for p in self.divergent_conds:
+            issues.append(Issue(
+                "mismatch",
+                f"collective sequence differs across cond branches at "
+                f"{p}: ranks taking different branches would issue "
+                "different (op, axes, dtype, count) sequences "
+                "(MPI-Checker's matching violation)"))
+        for p in self.host_transfers:
+            issues.append(Issue(
+                "host-transfer",
+                f"device->host transfer inside a device path at {p}: "
+                "a callback serializes the program against the host "
+                "and escapes every plane's accounting"))
+        for r in self.records:
+            if not r.bounded:
+                issues.append(Issue(
+                    "unbounded",
+                    f"{r.op} over {r.axes} at {r.path} executes under "
+                    "a data-dependent while: trip count (and wire "
+                    "bytes) are not statically bounded", "warn"))
+        return issues
+
+    def _check_bijections(self, sizes) -> List[Issue]:
+        issues = []
+        for r in self.records:
+            if r.op != "ppermute" or not r.perm:
+                continue
+            srcs = [s for s, _ in r.perm]
+            dsts = [d for _, d in r.perm]
+            if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+                issues.append(Issue(
+                    "bijection",
+                    f"ppermute over {r.axes} at {r.path} is not a "
+                    f"bijection: perm {r.perm} repeats a "
+                    f"{'source' if len(set(srcs)) != len(srcs) else 'destination'}"
+                    " (two ranks would send to / receive from the same "
+                    "peer in one step)"))
+                continue
+            if sizes and all(a in sizes for a in r.axes):
+                dom = int(np.prod([sizes[a] for a in r.axes]))
+                bad = [p for p in r.perm
+                       if not (0 <= p[0] < dom and 0 <= p[1] < dom)]
+                if bad:
+                    issues.append(Issue(
+                        "bijection",
+                        f"ppermute over {r.axes} at {r.path}: pairs "
+                        f"{bad} fall outside the axis domain [0, {dom})"))
+        return issues
+
+    def _check_axes(self, sizes) -> List[Issue]:
+        if not sizes:
+            return []
+        issues = []
+        for r in self.records:
+            missing = [a for a in r.axes if a not in sizes]
+            if missing:
+                issues.append(Issue(
+                    "unknown-axis",
+                    f"{r.op} at {r.path} names axis "
+                    f"{missing[0]!r} not on the mesh "
+                    f"({tuple(sizes)})"))
+        return issues
+
+    def _check_hier_cover(self, sizes) -> List[Issue]:
+        """The hier arm's shape is reduce_scatter(inner) ->
+        reduce(outer) -> all_gather(inner); the two stages must cover
+        the comm's axis product — an outer stage reusing an inner axis
+        reduces twice over one plane and never over the other."""
+        issues = []
+        recs = [r for r in self.records if not r.control]
+        for i, r in enumerate(recs):
+            if r.op != "reduce_scatter":
+                continue
+            outer = next((x for x in recs[i + 1:]
+                          if x.op in ("psum", "pmin", "pmax")), None)
+            gather = next((x for x in recs[i + 1:]
+                           if x.op == "all_gather"), None)
+            if outer is None or gather is None:
+                continue
+            if gather.axes != r.axes:
+                continue          # not the hier shape
+            if set(outer.axes) & set(r.axes):
+                issues.append(Issue(
+                    "hier-cover",
+                    f"hier split at {r.path}: outer stage reduces over "
+                    f"{outer.axes} which reuses inner axis(es) "
+                    f"{tuple(set(outer.axes) & set(r.axes))} — the "
+                    "split does not cover the axis product (one plane "
+                    "reduced twice, the other never)"))
+            elif sizes:
+                uncovered = [a for a in sizes
+                             if a not in r.axes and a not in outer.axes
+                             and sizes[a] > 1]
+                # axes genuinely outside the comm (e.g. tp during a dp
+                # sync) are legitimate; only warn so two-tier meshes
+                # with a typo'd outer axis surface
+                if uncovered:
+                    issues.append(Issue(
+                        "hier-cover",
+                        f"hier split at {r.path} covers "
+                        f"{r.axes + outer.axes}; mesh axes "
+                        f"{tuple(uncovered)} are outside the split "
+                        "(fine for a partial-mesh comm, wrong for a "
+                        "full allreduce)", "warn"))
+        return issues
+
+    def match(self, other: "CommGraph") -> List[Issue]:
+        """Cross-program matching (MPMD-style: one extracted program
+        per rank group).  SPMD single-program repos hit this through
+        tests and through cond-divergence above."""
+        a, b = self.signatures(), other.signatures()
+        issues = []
+        for i, (sa, sb) in enumerate(zip(a, b)):
+            if sa != sb:
+                issues.append(Issue(
+                    "mismatch",
+                    f"collective #{i} differs: {sa} vs {sb}"))
+                break
+        if not issues and len(a) != len(b):
+            issues.append(Issue(
+                "mismatch",
+                f"collective count differs: {len(a)} vs {len(b)} "
+                f"(first extra: "
+                f"{(a + b)[min(len(a), len(b))]})"))
+        return issues
+
+    # -- static wire prediction ---------------------------------------
+
+    def psum_ring_bytes(self, mesh, axes: Optional[Tuple[str, ...]] = None
+                        ) -> int:
+        """Ring-allreduce wire model over the non-control psum records:
+        2(n-1)/n x payload bytes per rank — the same expression
+        ``perf/model._FACTOR['allreduce']`` prices and
+        ``overlap._note_traffic`` charges (one floor-division over the
+        summed payload, so the figures agree byte-for-byte)."""
+        sizes = _axis_sizes(mesh)
+        groups: Dict[Tuple[str, ...], int] = {}
+        for r in self.records:
+            if r.op == "psum" and not r.control:
+                if axes is None or r.axes == tuple(axes):
+                    groups[r.axes] = groups.get(r.axes, 0) + r.total_bytes
+        total = 0
+        for ax, payload in groups.items():
+            n = int(np.prod([sizes.get(a, 1) for a in ax])) if sizes else 1
+            if n > 1:
+                total += 2 * (n - 1) * payload // n
+        return total
+
+    def ppermute_bytes(self) -> int:
+        """ppermute moves the full payload once per trip (factor 1 —
+        the traffic plane's note_ring/note_ppermute convention)."""
+        return sum(r.total_bytes for r in self.records
+                   if r.op == "ppermute" and not r.control)
+
+    def all_to_all_bytes(self) -> int:
+        """all_to_all wire = the per-rank shard payload (factor 1 —
+        the audited dispatch convention: the (n-1)/n on-wire discount
+        lives in the busbw factor table, not the byte ledger)."""
+        return sum(r.total_bytes for r in self.records
+                   if r.op == "all_to_all" and not r.control)
+
+    def gather_scatter_bytes(self, mesh) -> int:
+        """all_gather / reduce_scatter: (n-1)/n x the gathered (full)
+        buffer == (n-1) x the per-shard payload for all_gather, and
+        (n-1)/n x the per-rank buffer for reduce_scatter — the
+        ``perf/model._FACTOR`` (r-1)/r family."""
+        sizes = _axis_sizes(mesh)
+        total = 0
+        for r in self.records:
+            if r.control:
+                continue
+            n = int(np.prod([sizes.get(a, 1) for a in r.axes])) \
+                if sizes else 1
+            if n <= 1:
+                continue
+            if r.op == "all_gather":
+                total += (n - 1) * r.total_bytes
+            elif r.op == "reduce_scatter":
+                total += (n - 1) * r.total_bytes // n
+        return total
+
+    def reshard_bytes(self) -> int:
+        """Plan-lifted graphs: the step wire figures the plan compiler
+        modeled (and the reshard executor charges verbatim)."""
+        return sum(r.total_bytes for r in self.records
+                   if r.path.startswith("reshard-plan"))
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def _axis_sizes(mesh) -> Dict[str, int]:
+    if mesh is None:
+        return {}
+    if isinstance(mesh, dict):
+        return {str(k): int(v) for k, v in mesh.items()}
+    return {str(a): int(mesh.shape[a]) for a in mesh.axis_names}
+
+
+def _axes_of(params: Dict[str, Any]) -> Tuple[str, ...]:
+    ax = params.get("axes", params.get("axis_name", ()))
+    if isinstance(ax, (tuple, list)):
+        return tuple(str(a) for a in ax)
+    return (str(ax),)
+
+
+def _subjaxprs(v) -> List[Any]:
+    """Jaxpr-like values inside one eqn param value."""
+    if hasattr(v, "eqns"):
+        return [v]
+    if hasattr(v, "jaxpr"):
+        return [v.jaxpr]
+    if isinstance(v, (tuple, list)):
+        out = []
+        for x in v:
+            if hasattr(x, "eqns"):
+                out.append(x)
+            elif hasattr(x, "jaxpr"):
+                out.append(x.jaxpr)
+        return out
+    return []
+
+
+def _walk(jaxpr, g: CommGraph, trips: int, path: str, bounded: bool
+          ) -> None:
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _COLL_PRIMS:
+            op = _COLL_PRIMS[name]
+            axes = _axes_of(eqn.params)
+            perm = tuple(tuple(int(x) for x in p)
+                         for p in eqn.params.get("perm", ()))
+            for iv in eqn.invars:
+                aval = getattr(iv, "aval", None)
+                if aval is None or not hasattr(aval, "shape"):
+                    continue
+                shape = tuple(int(s) for s in aval.shape)
+                dt = np.dtype(aval.dtype)
+                g.records.append(CollRecord(
+                    op=op, axes=axes, dtype=dt.name, shape=shape,
+                    nbytes=int(np.prod(shape)) * dt.itemsize if shape
+                    else dt.itemsize,
+                    trips=trips, perm=perm, path=path or "<top>",
+                    bounded=bounded))
+            continue
+        if name in _HOST_PRIMS:
+            g.host_transfers.append(f"{path or '<top>'}/{name}")
+            # fall through: callbacks can still carry subjaxprs
+        sub_path = f"{path}/{name}" if path else name
+        if name in ("cond", "switch"):
+            branches = eqn.params.get("branches", ())
+            sub_sigs = []
+            for br in branches:
+                bg = CommGraph()
+                for bj in _subjaxprs(br):
+                    _walk(bj, bg, trips, sub_path, bounded)
+                sub_sigs.append((bg, bg.signatures()))
+            if sub_sigs:
+                first_g, first_sig = sub_sigs[0]
+                if any(sig != first_sig for _, sig in sub_sigs[1:]):
+                    g.divergent_conds.append(sub_path)
+                # merge the first branch so prediction sees one arm;
+                # divergence itself is already a matching error
+                g.records.extend(first_g.records)
+                g.host_transfers.extend(
+                    h for bg, _ in sub_sigs for h in bg.host_transfers)
+            continue
+        sub_trips = trips
+        sub_bounded = bounded
+        if name == "scan":
+            sub_trips = trips * int(eqn.params.get("length", 1))
+        elif name == "while":
+            sub_bounded = False
+        for key, v in eqn.params.items():
+            if key == "branches":
+                continue
+            for sj in _subjaxprs(v):
+                _walk(sj, g, sub_trips, sub_path, sub_bounded)
+
+
+def extract(fn: Callable, *args, source: str = "", **kwargs) -> CommGraph:
+    """Trace ``fn(*args, **kwargs)`` (jitted or plain) and extract its
+    collective program."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    g = CommGraph(source=source or getattr(fn, "__name__", "<fn>"))
+    _walk(closed.jaxpr, g, 1, "", True)
+    return g
+
+
+def from_reshard_plan(plan) -> CommGraph:
+    """Lift a compiled ``ReshardPlan`` into a CommGraph: the plan's
+    step list is a static collective program whose wire figures the
+    executor charges verbatim, so the plan-side record carries
+    ``step.wire_bytes`` and the usual checks (bijection, axis
+    existence) apply to its ppermute steps."""
+    g = CommGraph(source=f"reshard-plan:{plan.label}")
+    step_ops = {"all_to_all": "all_to_all", "all_gather": "all_gather",
+                "ppermute": "ppermute", "device_put": "device_put",
+                "slice": "slice"}
+    for i, step in enumerate(plan.steps):
+        op = step_ops.get(step.op, step.op)
+        if op == "slice":
+            continue              # local, no wire
+        g.records.append(CollRecord(
+            op=op, axes=tuple(step.axes), dtype=plan.dtype,
+            shape=(), nbytes=int(step.wire_bytes), trips=1,
+            perm=tuple(tuple(int(x) for x in p) for p in step.perm),
+            path=f"reshard-plan/step{i}:{step.describe()}"))
+    return g
+
+
+# ---------------------------------------------------------------------------
+# verify: static prediction vs runtime attribution
+# ---------------------------------------------------------------------------
+
+# runtime per-coll ledger key -> static wire model.  The traffic plane
+# files its charges under wrapper-chosen coll names; each maps to the
+# static model that reproduces the wrapper's byte expression exactly.
+_DEFAULT_COLL_MAP = {
+    "grad_sync": "psum_ring",
+    "ring_attention": "ppermute",
+    "ulysses": "all_to_all",
+    "reshard": "reshard",
+}
+
+
+@dataclass
+class VerifyReport:
+    """``verify()``'s typed result."""
+    source: str
+    n_records: int
+    issues: List[Issue]
+    rows: List[Dict[str, Any]]       # coll / static / runtime / ok
+    host_transfers: List[str]
+
+    @property
+    def ok(self) -> bool:
+        return (all(r["ok"] for r in self.rows)
+                and not any(i.severity == "error" for i in self.issues))
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "source": self.source, "ok": self.ok,
+            "n_records": self.n_records,
+            "issues": [{"kind": i.kind, "msg": i.msg,
+                        "severity": i.severity} for i in self.issues],
+            "rows": self.rows,
+            "host_transfers": list(self.host_transfers),
+        }
+
+    def summary(self) -> str:
+        lines = [f"commgraph: {self.source}: {self.n_records} collective "
+                 f"record(s), {len(self.issues)} issue(s), "
+                 f"{'OK' if self.ok else 'FAIL'}"]
+        for r in self.rows:
+            lines.append(
+                f"  {r['coll']}: static {r['static']} B vs runtime "
+                f"{r['runtime']} B {'==' if r['ok'] else '!='}")
+        for i in self.issues:
+            lines.append(f"  [{i.severity}] {i.kind}: {i.msg}")
+        return "\n".join(lines)
+
+
+def _static_bytes(g: CommGraph, mesh, model: str) -> int:
+    if model == "psum_ring":
+        return g.psum_ring_bytes(mesh)
+    if model == "ppermute":
+        return g.ppermute_bytes()
+    if model == "all_to_all":
+        return g.all_to_all_bytes()
+    if model == "gather_scatter":
+        return g.gather_scatter_bytes(mesh)
+    if model == "reshard":
+        return g.reshard_bytes()
+    raise ValueError(f"unknown static wire model {model!r}")
+
+
+def verify(fn: Callable, args: Sequence[Any], mesh,
+           coll_map: Optional[Dict[str, str]] = None,
+           graph: Optional[CommGraph] = None,
+           runner: Optional[Callable[[], Any]] = None,
+           source: str = "") -> VerifyReport:
+    """Static checks + byte-for-byte static-vs-runtime wire agreement.
+
+    Extracts ``fn``'s collective program (or takes a pre-built
+    ``graph``, e.g. a plan-lifted one), runs the well-formedness
+    checks, then executes ``runner()`` (default: ``fn(*args)`` blocked
+    to completion) under the traffic plane and compares the runtime
+    per-coll byte deltas against the static models named by
+    ``coll_map`` (default ``_DEFAULT_COLL_MAP``).  The traffic plane's
+    prior enabled state is restored."""
+    import jax
+
+    from .. import traffic
+
+    g = graph if graph is not None else extract(
+        fn, *args, source=source or getattr(fn, "__name__", "<fn>"))
+    issues = g.check(mesh)
+    cmap = dict(_DEFAULT_COLL_MAP if coll_map is None else coll_map)
+
+    was_enabled = traffic.enabled
+    if not was_enabled:
+        traffic.enable()
+    try:
+        before = traffic.matrix.per_coll()
+        out = runner() if runner is not None else fn(*args)
+        jax.block_until_ready(out)
+        after = traffic.matrix.per_coll()
+    finally:
+        if not was_enabled:
+            traffic.disable()
+
+    rows: List[Dict[str, Any]] = []
+    for coll, model in cmap.items():
+        static = _static_bytes(g, mesh, model)
+        runtime = int(after.get(coll, 0)) - int(before.get(coll, 0))
+        if static == 0 and runtime == 0:
+            continue
+        rows.append({"coll": coll, "model": model, "static": int(static),
+                     "runtime": runtime, "ok": static == runtime})
+    return VerifyReport(source=g.source, n_records=len(g.records),
+                        issues=issues, rows=rows,
+                        host_transfers=list(g.host_transfers))

@@ -122,11 +122,16 @@ _var.register("coll", "xla", "rules", "", type=str, level=3,
 # every mode any decision point can name (rules-file vocabulary);
 # "hier" = the two-tier HAN arm (reduce_scatter ICI -> allreduce DCN on
 # the scattered 1/n_inner -> allgather ICI), "hier+quant" the same shape
-# with ONLY the outer (DCN) stage on the EQuARX quantized tier
-_MODES = ("native", "staged", "quant", "bidir", "hier", "hier+quant")
-# plane vocabulary for '<coll>@<plane>' rule rows (parallel/hierarchy's
-# classify_axes split, incl. the topo_sim_dcn_axes override)
-_PLANES = ("ici", "dcn")
+# with ONLY the outer (DCN) stage on the EQuARX quantized tier.
+# The authoritative copies live in analysis/rules.py (the grammar
+# module CI shares); the asserts keep the two import paths in lockstep.
+from ..analysis import rules as _rules_grammar
+
+_MODES = _rules_grammar.MODES
+_PLANES = _rules_grammar.PLANES
+assert _MODES == ("native", "staged", "quant", "bidir", "hier",
+                  "hier+quant")
+assert _PLANES == ("ici", "dcn")
 
 
 def _load_device_rules(path: Optional[str] = None):
@@ -141,43 +146,16 @@ def _load_device_rules(path: Optional[str] = None):
     {ici, dcn}) rows apply only to communicators whose axes include
     that plane and BEAT plain rows for the same coll at decision time
     (decide_mode's two-lane rule walk).  An unknown plane is a loud
-    ValueError — a typo must not silently deactivate a row."""
+    ValueError — a typo must not silently deactivate a row.  Parsing
+    is delegated to ``analysis.rules`` (the grammar module CI shares),
+    which also rejects an exactly-duplicated
+    ``(coll[@plane], min_ndev, min_bytes)`` key naming both lines —
+    before that validator the later row silently won the rule walk."""
     if path is None:
         path = _var.get("coll_xla_dynamic_rules", "")
-    rules = []
-    if path and not os.path.exists(path):
-        # misconfiguration must be distinguishable from no configuration
-        # (the reference's dynamic-file loader reports a missing file,
-        # coll_tuned_dynamic_file.c:58)
-        raise ValueError(
-            f"coll_xla_dynamic_rules names a missing file: {path!r}")
-    if path:
-        with open(path) as fh:
-            for lineno, line in enumerate(fh, 1):
-                line = line.strip()
-                if not line or line.startswith("#"):
-                    continue
-                try:
-                    coll, min_ndev, min_bytes, mode = line.split()
-                    min_ndev, min_bytes = int(min_ndev), int(min_bytes)
-                except ValueError as exc:
-                    raise ValueError(
-                        f"{path}:{lineno}: bad device rule {line!r} "
-                        "(want '<coll>[@<plane>] <min_ndev> <min_bytes> "
-                        f"<native|staged>'): {exc}") from None
-                if "@" in coll:
-                    base, plane = coll.split("@", 1)
-                    if not base or plane not in _PLANES:
-                        raise ValueError(
-                            f"{path}:{lineno}: unknown plane in "
-                            f"{coll!r} (want '<coll>@<plane>' with "
-                            f"plane one of {', '.join(_PLANES)})")
-                if mode not in _MODES:
-                    raise ValueError(
-                        f"{path}:{lineno}: unknown device mode {mode!r} "
-                        f"(want one of {', '.join(_MODES)})")
-                rules.append((coll, min_ndev, min_bytes, mode))
-    return rules
+    if not path:
+        return []
+    return _rules_grammar.parse_file(path)
 
 
 def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,

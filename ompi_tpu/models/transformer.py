@@ -542,8 +542,10 @@ def _quant_grad_sync(cfg: Config, mesh: Mesh):
         grads = jax.tree.map(
             lambda g: psum_quant(g, "dp", n, avg=True,
                                  block=cfg.grad_sync_block), grads)
+        # comm-lint: disable=CL001 scalar loss average (control plane, excluded from wire models); the payload sync is the audited psum_quant above
         return lax.pmean(loss, "dp"), grads
 
+    # comm-lint: disable=CL001 the quant grad-sync tier: its comm is psum_quant (coll/quant engine) plus the waived scalar pmean
     return shard_map(local, mesh=mesh, in_specs=(P(), data_spec),
                      out_specs=(P(), P()))
 

@@ -305,10 +305,19 @@ class DeviceWindow:
             return
         t0 = time.perf_counter()
         n_in = len(ops)
-        if mode == "staged":
-            self._execute_staged(ops)
-        else:
-            self._execute_native(ops)
+        try:
+            if mode == "staged":
+                self._execute_staged(ops)
+            else:
+                self._execute_native(ops)
+        except BaseException:
+            trace.record_span("rma:epoch", "osc", t0,
+                              time.perf_counter(),
+                              args={"mode": mode, "ops": n_in,
+                                    "window": self.name,
+                                    "nranks": self.nranks,
+                                    "status": "error"})
+            raise
         trace.record_span("rma:epoch", "osc", t0, time.perf_counter(),
                           args={"mode": mode, "ops": n_in,
                                 "window": self.name,

@@ -470,13 +470,20 @@ class Window:
             self.flush_all()
         except Exception as exc:
             err = exc
-        self.comm.barrier()
+        try:
+            self.comm.barrier()
+        except BaseException as exc:
+            # a failed barrier still closes the fence span: the epoch
+            # ended (abnormally) and the trace must say so
+            err = err or exc
         if trace.enabled:
+            args = {"outstanding": outstanding,
+                    "win": self.win_id, "mode": "host"}
+            if err is not None:
+                args["status"] = "error"
             trace.record_span(
                 "rma:fence", "osc", t0, _time.perf_counter(),
-                rank=self.comm.ctx.rank,
-                args={"outstanding": outstanding,
-                      "win": self.win_id, "mode": "host"})
+                rank=self.comm.ctx.rank, args=args)
         if err is not None:
             raise err
 

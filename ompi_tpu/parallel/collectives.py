@@ -287,7 +287,14 @@ class DeviceComm:
                 # lazily, so the first-execution compile lands inside
                 # whatever execution span surrounds the miss
                 t0 = time.perf_counter()
-                fn = build()
+                try:
+                    fn = build()
+                except BaseException:
+                    trace.record_span(f"build:{key[0]}", "compile", t0,
+                                      time.perf_counter(),
+                                      args={"key": repr(key),
+                                            "status": "error"})
+                    raise
                 trace.record_span(f"build:{key[0]}", "compile", t0,
                                   time.perf_counter(),
                                   args={"key": repr(key)})

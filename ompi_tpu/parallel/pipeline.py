@@ -76,7 +76,15 @@ def _traced_run(jitted: Callable, stage_params, microbatches,
         # schedule inlines into the caller's program
         return jitted(stage_params, microbatches)
     t0 = time.perf_counter()
-    out = jax.block_until_ready(jitted(stage_params, microbatches))
+    try:
+        out = jax.block_until_ready(jitted(stage_params, microbatches))
+    except BaseException:
+        trace.record_span("pipeline:run", "pipeline", t0,
+                          time.perf_counter(),
+                          args={"stages": n_stages,
+                                "microbatches": m_count,
+                                "axis": axis, "status": "error"})
+        raise
     t1 = time.perf_counter()
     ticks = m_count + n_stages - 1
     trace.record_span(
@@ -156,6 +164,7 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
                     outs, y, jnp.maximum(out_idx, 0), 0),
                 lambda: outs)
             # shift every stage's output one stage forward
+            # comm-lint: disable=CL001 the stage->stage shift IS the 1F1B schedule; traced and span-annotated by _traced_run, not an engine-dispatchable collective
             state = lax.ppermute(
                 y, axis, [(i, (i + 1) % n_stages) for i in range(n_stages)])
             return (state, outs), None
@@ -165,6 +174,7 @@ def pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
             tick, (zero, outs0), jnp.arange(m_count + n_stages - 1))
         # only the last stage holds real outputs; broadcast them to all
         # stages so the result is replicated over pp (psum of a one-hot)
+        # comm-lint: disable=CL001 one-hot broadcast of the last stage's outputs; replication step of the schedule itself, not a tunable reduction
         outs = lax.psum(jnp.where(stage == last, outs, jnp.zeros_like(outs)),
                         axis)
         return outs

@@ -465,7 +465,14 @@ class Resharder:
         if fn is None:
             if trace.enabled:
                 t0 = time.perf_counter()
-                fn = build()
+                try:
+                    fn = build()
+                except BaseException:
+                    trace.record_span(f"build:{key[0]}", "compile", t0,
+                                      time.perf_counter(),
+                                      args={"key": repr(key),
+                                            "status": "error"})
+                    raise
                 trace.record_span(f"build:{key[0]}", "compile", t0,
                                   time.perf_counter(),
                                   args={"key": repr(key)})
@@ -492,7 +499,14 @@ class Resharder:
                               args={"plan": hit.label})
             return hit
         t0 = time.perf_counter()
-        plan = compile_plan(shape, dtype, src_spec, dst_spec, self.mesh)
+        try:
+            plan = compile_plan(shape, dtype, src_spec, dst_spec, self.mesh)
+        except BaseException:
+            if trace.enabled:
+                trace.record_span("reshard:compile_plan", "compile", t0,
+                                  time.perf_counter(),
+                                  args={"status": "error"})
+            raise
         self._plans[key] = plan
         with _lock:
             _counts["reshard_plans"] += 1

@@ -132,8 +132,9 @@ def _build_ring(mesh: Mesh, axis: str, causal: bool, scale: float,
             o, m, l = jax.vmap(_merge)(o, m, l, bo, bm, bl)
             # rotate K/V to the next ring position
             perm = [(j, (j + 1) % n) for j in range(n)]
+            # comm-lint: disable=CL001 the ring hop IS the algorithm (not a reducible collective the engine could re-plan); attributed at the eager boundary via traffic.note_ring
             kf = lax.ppermute(kf, axis, perm)
-            vf = lax.ppermute(vf, axis, perm)
+            vf = lax.ppermute(vf, axis, perm)  # comm-lint: disable=CL001 same ring hop, V plane
             return o, m, l, kf, vf
 
         # mark the accumulators device-varying over exactly the mesh axes
@@ -159,6 +160,7 @@ def _build_ring(mesh: Mesh, axis: str, causal: bool, scale: float,
     # check_vma off for the pallas block: the interpret-mode pallas_call
     # lowering can't yet propagate varying-manual-axes through its internal
     # dynamic_slice (jax suggests this exact workaround).
+    # comm-lint: disable=CL001 ring attention is a leaf SPMD kernel: its only comm is the waived ppermute ring above, verified statically by analysis.commgraph
     return jax.jit(shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec,
                              check_vma=(block_impl != "pallas")))

@@ -31,10 +31,11 @@ from ..trace import merge as _merge
 
 # bumped whenever any --json report mode changes shape; every mode
 # (default merge, --health-dump, --perf, --traffic, --numerics,
-# --reshard, --live) emits it so downstream tooling can detect drift
-# (ISSUE 7 satellite; 4 = the numerics plane section, ISSUE 9;
-# 5 = the reshard plan-cache/last-plan section, ISSUE 10)
-SCHEMA_VERSION = 5
+# --reshard, --analyze, --live) emits it so downstream tooling can
+# detect drift (ISSUE 7 satellite; 4 = the numerics plane section,
+# ISSUE 9; 5 = the reshard plan-cache/last-plan section, ISSUE 10;
+# 6 = the static-verifier section, ISSUE 11)
+SCHEMA_VERSION = 6
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -436,6 +437,46 @@ def build_reshard_report(
     return "\n".join(lines), rep
 
 
+def build_analyze_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the static communication
+    verifier: per-program static-vs-runtime wire rows and SPMD check
+    issues from a banked ANALYZE json (bench.py --analyze).  The
+    verifier has no live in-process state (it runs whole programs),
+    so the default picks the newest banked artifact."""
+    if not path:
+        hits = sorted(glob.glob("ANALYZE_*.json"))
+        if not hits:
+            return ("static verifier: no ANALYZE_*.json banked yet "
+                    "(run bench.py --analyze)"), {}
+        path = hits[-1]
+    with open(path) as fh:
+        doc = json.load(fh)
+    lines: List[str] = []
+    w = lines.append
+    ok = bool(doc.get("value"))
+    w(f"static verifier: {'byte-for-byte OK' if ok else 'DISAGREEMENT'}"
+      f" on {doc.get('ndev')} device(s) (from {path})")
+    for key in ("train_step", "reshard_plan"):
+        rep = doc.get(key) or {}
+        if not rep:
+            continue
+        w(f"  {rep.get('source')}: {int(rep.get('n_records', 0))} "
+          f"collective record(s), "
+          f"{'OK' if rep.get('ok') else 'FAIL'}")
+        for r in rep.get("rows") or []:
+            w(f"    {r.get('coll')} [{r.get('model')}]: static "
+              f"{int(r.get('static', 0))} B vs runtime "
+              f"{int(r.get('runtime', 0))} B "
+              f"{'==' if r.get('ok') else '!='}")
+        for i in rep.get("issues") or []:
+            w(f"    [{i.get('severity')}] {i.get('kind')}: "
+              f"{i.get('msg')}")
+        for h in rep.get("host_transfers") or []:
+            w(f"    host transfer: {h}")
+    return "\n".join(lines), doc
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -498,6 +539,13 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "audit. With a path, loads a banked RESHARD "
                          "json (bench.py --reshard); bare flag reads "
                          "the live in-process engine")
+    ap.add_argument("--analyze", nargs="?", const="", default=None,
+                    metavar="ANALYZE.json",
+                    help="render the static-verifier section: "
+                         "per-program static-vs-runtime wire rows and "
+                         "SPMD check issues from a banked ANALYZE "
+                         "json (bench.py --analyze); bare flag picks "
+                         "the newest ANALYZE_*.json")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -534,8 +582,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
         if (ns.perf or ns.traffic is not None or ns.numerics is not None
-                or ns.reshard is not None):
-            # perf/traffic/numerics/reshard section standalone
+                or ns.reshard is not None or ns.analyze is not None):
+            # perf/traffic/numerics/reshard/analyze section standalone
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
@@ -572,6 +620,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         rtext, rdata = build_reshard_report(ns.reshard or None)
         text = (text + "\n" + rtext) if text else rtext
         data["reshard"] = rdata
+    if getattr(ns, "analyze", None) is not None:
+        atext, adata = build_analyze_report(ns.analyze or None)
+        text = (text + "\n" + atext) if text else atext
+        data["analyze"] = adata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

@@ -193,6 +193,20 @@ def note_ring(mesh, axis: str, nbytes: int, coll: str,
     _charge(mesh, coll, nbytes, ring_edges(mesh, axis, direction))
 
 
+def note_a2a(mesh, axis: str, nbytes: int, coll: str) -> None:
+    """Charge ``nbytes`` per-rank all_to_all wire bytes over the axis'
+    full bipartite edge set (the audited dispatch convention: wire =
+    the per-rank shard payload, factor 1 — the (n-1)/n on-wire
+    discount lives in the busbw factor table, not the byte ledger).
+    The eager ulysses wrapper is the first caller; the static verifier
+    (``analysis/commgraph``) reproduces the same figure from the
+    traced all_to_all eqns' per-shard avals."""
+    nbytes = int(nbytes)
+    if nbytes <= 0:
+        return
+    _charge(mesh, coll, nbytes, bipartite_edges(mesh, axis))
+
+
 def note_reshard_step(mesh, kind: str, axes, wire: int,
                       pairs: Optional[Sequence[Tuple[int, int]]] = None,
                       coll: str = "reshard") -> Dict[str, int]:
