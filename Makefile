@@ -7,7 +7,8 @@ SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
-	reshard-tests analysis-tests comm-lint bench-compare
+	reshard-tests analysis-tests ft-elastic-tests comm-lint \
+	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -31,7 +32,7 @@ SHELL := /bin/bash
 # program or an unaudited dispatch path without spending a single
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
-	numerics-tests reshard-tests
+	numerics-tests reshard-tests ft-elastic-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -121,6 +122,20 @@ reshard-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_reshard.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --reshard
+
+# the elastic fault-tolerance tier: cross-mesh reshard planner +
+# peer-shadow ring + ElasticTrainer recovery loop + chaos injector
+# suite, then the end-to-end probe (8 devices; a deterministic kill of
+# mesh position 3 at step 7 the trainer must survive by shrinking to
+# the 4-device mesh and re-laying state from the peer shadows with ZERO
+# checkpoint reads; exits nonzero unless the injected rank is named by
+# exactly one audited ft_recovery decision, recovery lands within the
+# steps-lost budget, the losses track an uninterrupted baseline, and
+# traffic conservation holds; banks ELASTIC_<platform>.json)
+ft-elastic-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --elastic
 
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe

@@ -27,12 +27,25 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import threading
 from typing import Any, Dict, Optional
 
 import jax
 
 CHECKSUM_FILE = "ompi_tpu_checksums.json"
 _HASH_CHUNK = 1 << 20
+
+# restore-call odometer: elastic recovery (ft/elastic) asserts its
+# peer-shadow path moved state with ZERO filesystem round-trips, which
+# is only checkable if every restore entry point ticks one counter
+_restore_lock = threading.Lock()
+_restore_calls = 0
+
+
+def restore_count() -> int:
+    """How many times :func:`restore` has run in this process."""
+    with _restore_lock:
+        return _restore_calls
 
 
 def _ocp():
@@ -209,6 +222,9 @@ def restore(path: str, like: Any, rank: int = 0,
     round-trip, every step decision-audited and traffic-attributed.
     Without it, the read itself targets ``like``'s layout (orbax
     reshards on read through host IO)."""
+    global _restore_calls
+    with _restore_lock:
+        _restore_calls += 1
     verify_checksums(path, rank=rank)
     path = os.path.abspath(path)
     _check_global_shapes(path, like, rank=rank)
@@ -312,8 +328,32 @@ class CheckpointManager:
 
     def restore_latest(self, like: Any,
                        source_sharding: Any = None) -> Any:
-        step = self.latest_step()
-        if step is None:
+        """Restore the newest step that VERIFIES.  A corrupt newest step
+        (flipped bit, truncated shard, missing file) is logged and
+        skipped — retention keeps older steps around precisely so one
+        bad write doesn't strand the job — and
+        :class:`CheckpointCorruptionError` is raised only when no clean
+        step remains."""
+        from .core.output import output
+        steps = [self.latest_step()]          # waits the pending save
+        if steps[0] is None:
             raise FileNotFoundError(
                 f"no checkpoints under {self.directory}")
-        return self.restore(step, like, source_sharding=source_sharding)
+        steps = self.steps()
+        last_err: Optional[CheckpointCorruptionError] = None
+        for step in reversed(steps):
+            try:
+                verify_checksums(self._step_dir(step))
+            except CheckpointCorruptionError as err:
+                output.verbose(
+                    1, "ckpt",
+                    f"step {step} failed verification, falling back to "
+                    f"the next-newest clean step: {err}")
+                last_err = err
+                continue
+            return self.restore(step, like,
+                                source_sharding=source_sharding)
+        raise CheckpointCorruptionError(
+            f"all {len(steps)} checkpoint step(s) under {self.directory} "
+            "failed verification — no clean step to fall back to"
+        ) from last_err

@@ -28,10 +28,23 @@ from .ulfm import (  # noqa: F401
     shrink,
     simulate_failure,
 )
+from .ulfm import WatchdogTimeoutError  # noqa: F401
 from .detector import FailureDetector  # noqa: F401
+from .chaos import ChaosMonkey  # noqa: F401
+from .elastic import (  # noqa: F401
+    ElasticTrainer,
+    ShadowStore,
+    comm_recover,
+    run_elastic,
+    survivor_mesh,
+    trip_verdict,
+)
 
 __all__ = [
     "ProcFailedError", "ProcFailedPendingError", "RevokedError",
+    "WatchdogTimeoutError",
     "FailureDetector", "enable", "revoke", "shrink", "agree", "failed_ranks",
     "failure_ack", "failure_get_acked", "simulate_failure",
+    "ChaosMonkey", "ElasticTrainer", "ShadowStore", "comm_recover",
+    "run_elastic", "survivor_mesh", "trip_verdict",
 ]

@@ -160,4 +160,21 @@ class FailureDetector:
         from .ulfm import _fail_pending_recvs
         _fail_pending_recvs(self.ctx, rank)
         for cb in self._on_failure:
-            cb(rank)
+            # a raising callback must not kill the progress loop — the
+            # detector IS the recovery path's eyes; swallow with
+            # attribution (callback name + failed rank) instead
+            try:
+                cb(rank)
+            except Exception as err:
+                name = getattr(cb, "__qualname__",
+                               getattr(cb, "__name__", repr(cb)))
+                output.verbose(
+                    1, "ft",
+                    f"rank {self.rank}: failure callback {name} raised "
+                    f"{type(err).__name__} for failed rank {rank}: {err}")
+                from .. import trace
+                if trace.enabled:
+                    trace.instant(
+                        "ft_callback_error", "ft", rank=self.rank,
+                        args={"callback": name, "failed_rank": int(rank),
+                              "error": f"{type(err).__name__}: {err}"})

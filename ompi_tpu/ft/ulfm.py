@@ -52,11 +52,15 @@ class WatchdogTimeoutError(RuntimeError):
     control-plane event the way the failure detector announces deaths."""
 
     def __init__(self, msg: str, *, cid: int = -1, seq: int = -1,
-                 op: str = "") -> None:
+                 op: str = "", suspect: int = -1) -> None:
         super().__init__(msg)
         self.cid = int(cid)
         self.seq = int(seq)
         self.op = str(op)
+        # suspect rank when the trip evidence names one (detector-
+        # declared failure, else the desync sentinel's laggard); -1 =
+        # unattributed — ft/elastic.trip_verdict consumes this
+        self.suspect = int(suspect)
 
 
 def enable(ctx) -> "FailureDetector":

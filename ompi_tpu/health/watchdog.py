@@ -239,8 +239,21 @@ def _escalate(ctx, report: Dict[str, Any], allow_raise: bool) -> None:
         pass
     if action == "raise":
         from ..ft.ulfm import WatchdogTimeoutError
+        # attribute a suspect rank when the evidence names one: a
+        # detector-declared failure outranks the desync sentinel's
+        # furthest-behind rank; -1 = no attribution.  ft/elastic's
+        # trip_verdict reads this to target the shrink.
+        suspect = -1
+        ft_failed = report.get("ft_failed") or []
+        v = report.get("verdict") or {}
+        if ft_failed:
+            suspect = int(ft_failed[0])
+        elif v.get("desync"):
+            d0 = v["desync"][0]
+            suspect = (int(d0.get("rank", -1)) if isinstance(d0, dict)
+                       else int(d0))
         exc = WatchdogTimeoutError(msg, cid=e["cid"], seq=e["seq"],
-                                   op=e["op"])
+                                   op=e["op"], suspect=suspect)
         if allow_raise:
             raise exc
         _pending[ctx.rank] = exc     # thrown by the progress cb if polled

@@ -36,10 +36,13 @@ from .collectives import (  # noqa: F401
     ring_shift,
 )
 from .reshard import (  # noqa: F401
+    CrossMeshPlan,
     ReshardError,
     ReshardPlan,
     Resharder,
+    compile_cross_plan,
     compile_plan,
+    cross_reshard,
     reshard,
     resharder,
 )

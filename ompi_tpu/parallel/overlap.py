@@ -69,6 +69,44 @@ def pvar_value(name: str) -> int:
     return _PVARS[name]
 
 
+# -- post-sync hooks ---------------------------------------------------------
+# callables(grads) invoked after every eager grad sync, right before the
+# (loss, grads) return — the piggyback point low-rate maintenance work
+# rides on the sync cadence (ft/elastic's peer-shadow ring_shift refresh
+# is the canonical rider).  Hooks run on the host, outside any trace; a
+# raising hook is logged with attribution and dropped for the step
+# rather than poisoning the training loop.
+
+_post_sync_hooks: List[Callable] = []
+
+
+def add_post_sync_hook(fn: Callable) -> Callable:
+    _post_sync_hooks.append(fn)
+    return fn
+
+
+def remove_post_sync_hook(fn: Callable) -> None:
+    try:
+        _post_sync_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
+def _run_post_sync(grads) -> None:
+    if not _post_sync_hooks:
+        return
+    from ..core.output import output
+    for fn in list(_post_sync_hooks):
+        try:
+            fn(grads)
+        except Exception as err:
+            name = getattr(fn, "__qualname__",
+                           getattr(fn, "__name__", repr(fn)))
+            output.verbose(1, "overlap",
+                           f"post-sync hook {name} raised "
+                           f"{type(err).__name__}: {err}")
+
+
 # -- bucket planning ---------------------------------------------------------
 
 @dataclass(frozen=True)
@@ -398,6 +436,7 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
             _note_traffic(grads)
             if numerics.enabled:
                 _note_numerics(grads)
+            _run_post_sync(grads)
             return loss, grads
         t0 = time.perf_counter()
         try:
@@ -435,6 +474,7 @@ def make_grad_sync(mode: str, mesh: Mesh, local_loss: Callable,
         _note_traffic(grads)
         if numerics.enabled:
             _note_numerics(grads)
+        _run_post_sync(grads)
         return loss, grads
 
     return vg

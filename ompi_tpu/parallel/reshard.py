@@ -712,6 +712,464 @@ def reshard(x, dst, mesh: Optional[Mesh] = None, spc=None) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# cross-mesh planning mode (source mesh ⊃ dest mesh)
+# ---------------------------------------------------------------------------
+#
+# compile_plan above assumes ONE fixed mesh: every step is a collective
+# over axes both layouts share.  Elastic recovery (ft/elastic) needs the
+# other shape: the array lives on the FULL mesh, some of whose devices
+# are dead, and must land on a survivor mesh that is a strict subset of
+# the original devices.  The cross plan decomposes that transition into
+# per-destination-device piece moves — each destination shard is tiled
+# by whole source shards (or crops of replicas), moved point-to-point
+# and assembled in place with donated dynamic_update_slice programs so
+# the per-device live set stays within the same peak contract as the
+# single-mesh planner: resident src shard + assembled dst shard + one
+# in-flight piece <= reshard_peak_factor * max(src_shard, dst_shard)
+# when the factor is the default 2.  Pieces whose source device is dead
+# are sourced from caller-provided REPLACEMENTS (ft/elastic's in-memory
+# peer shadows) — the dead device's buffers are never read, and no
+# filesystem round-trip happens.
+
+@dataclass(frozen=True)
+class CrossPiece:
+    """One source block of a destination shard."""
+    dst_pos: int                  # flat position in the SOURCE mesh
+    src_pos: int                  # flat position in the SOURCE mesh
+    start: Tuple[int, ...]        # piece origin in global index space
+    sizes: Tuple[int, ...]        # piece extent per dim
+    nbytes: int
+    from_shadow: bool             # sourced from a replacement, not x
+
+
+@dataclass(frozen=True)
+class CrossMeshPlan:
+    key: tuple
+    shape: Tuple[int, ...]
+    dtype: str
+    src: Placement
+    dst: Placement
+    pieces: Tuple[CrossPiece, ...]
+    src_shard_bytes: int
+    dst_shard_bytes: int
+    peak_bytes: int               # modeled per-device live-set maximum
+    wire_bytes: int               # modeled cross-device piece bytes
+    bound_bytes: int
+    n_src: int
+    n_dst: int
+    fallback_reason: str = ""     # non-empty when device_put replaced pieces
+
+    @property
+    def label(self) -> str:
+        return (f"{_fmt_placement(self.src)}x{self.n_src}->"
+                f"{_fmt_placement(self.dst)}x{self.n_dst}"
+                f"/{self.dtype}{list(self.shape)}")
+
+    def describe(self) -> List[str]:
+        if self.fallback_reason:
+            return ["device_put"]
+        return [f"cross_migrate[{len(self.pieces)} piece(s), "
+                f"{sum(1 for p in self.pieces if p.from_shadow)} shadow]"]
+
+
+def _region(idx, shape) -> Tuple[Tuple[int, int], ...]:
+    """A devices_indices_map entry -> ((start, stop), ...) per dim."""
+    out = []
+    for d, s in enumerate(idx):
+        start = 0 if s.start is None else int(s.start)
+        stop = int(shape[d]) if s.stop is None else int(s.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _contains(outer, inner) -> bool:
+    return all(o0 <= i0 and i1 <= o1
+               for (o0, o1), (i0, i1) in zip(outer, inner))
+
+
+def _rsize(reg) -> int:
+    n = 1
+    for a, b in reg:
+        n *= max(b - a, 0)
+    return n
+
+
+def compile_cross_plan(shape: Sequence[int], dtype, src_spec, dst_spec,
+                       src_mesh: Mesh, dst_mesh: Mesh,
+                       dead: Sequence[int] = (),
+                       peak_factor: Optional[float] = None
+                       ) -> CrossMeshPlan:
+    """Compile a source-mesh ⊃ dest-mesh transition into per-device piece
+    moves.  ``dead`` holds flat positions (into ``src_mesh.devices``) of
+    devices whose shards must never be read — those pieces are marked
+    ``from_shadow`` and the executor sources them from caller
+    replacements.  Pure host math, like :func:`compile_plan`."""
+    shape = tuple(int(s) for s in shape)
+    dt = np.dtype(jnp.dtype(dtype).name) if not isinstance(dtype, np.dtype) \
+        else dtype
+    itemsize = dt.itemsize
+    src = _norm(src_spec, len(shape))
+    dst = _norm(dst_spec, len(shape))
+    src_devs = list(np.asarray(src_mesh.devices).flat)
+    dst_devs = list(np.asarray(dst_mesh.devices).flat)
+    pos_of = {d: i for i, d in enumerate(src_devs)}
+    dead_set = frozenset(int(p) for p in dead)
+    missing = [d for d in dst_devs if d not in pos_of]
+    if missing:
+        raise ReshardError(
+            "cross_reshard: dest mesh is not a subset of the source mesh "
+            f"(devices {missing} not on the source mesh)")
+    bad = [pos_of[d] for d in dst_devs if pos_of[d] in dead_set]
+    if bad:
+        raise ReshardError(
+            f"cross_reshard: dest mesh includes dead device position(s) "
+            f"{sorted(bad)} — shrink to survivors first")
+    src_sh = NamedSharding(src_mesh, _spec_of(src))
+    dst_sh = NamedSharding(dst_mesh, _spec_of(dst))
+    src_map = {pos_of[d]: _region(idx, shape)
+               for d, idx in src_sh.devices_indices_map(shape).items()}
+    dst_map = {pos_of[d]: _region(idx, shape)
+               for d, idx in dst_sh.devices_indices_map(shape).items()}
+    total = itemsize * int(np.prod(shape)) if shape else itemsize
+    src_b = max(max((_rsize(r) for r in src_map.values()), default=1)
+                * itemsize, itemsize)
+    dst_b = max(max((_rsize(r) for r in dst_map.values()), default=1)
+                * itemsize, itemsize)
+    factor = float(peak_factor if peak_factor is not None
+                   else _var.get("reshard_peak_factor", 2.0))
+    bound = int(factor * max(src_b, dst_b))
+    key = (src, dst, shape, dt.name,
+           tuple(id(d) for d in src_devs), tuple(id(d) for d in dst_devs),
+           dead_set)
+
+    def _fallback(why: str) -> CrossMeshPlan:
+        if dead_set:
+            raise ReshardError(
+                f"cross_reshard: {why} — and dead position(s) "
+                f"{sorted(dead_set)} rule out the whole-array device_put "
+                "fallback (it would read their shards)")
+        return CrossMeshPlan(
+            key=key, shape=shape, dtype=dt.name, src=src, dst=dst,
+            pieces=(), src_shard_bytes=src_b, dst_shard_bytes=dst_b,
+            peak_bytes=src_b + dst_b, wire_bytes=dst_b, bound_bytes=bound,
+            n_src=len(src_devs), n_dst=len(dst_devs), fallback_reason=why)
+
+    # group source holders by region (partial replication: several
+    # devices may hold identical blocks)
+    holders: Dict[Tuple, List[int]] = {}
+    for p, reg in src_map.items():
+        holders.setdefault(reg, []).append(p)
+
+    pieces: List[CrossPiece] = []
+    peak = 0
+    wire = 0
+    for dpos, R in sorted(dst_map.items()):
+        cand = [(reg, ps) for reg, ps in holders.items()
+                if _contains(R, reg)]
+        if sum(_rsize(reg) for reg, _ in cand) != _rsize(R):
+            return _fallback(
+                "irregular tiling: a source shard straddles a dest shard "
+                "boundary (cross plans need dest shards tiled by whole "
+                "source blocks)")
+        dev_pieces: List[CrossPiece] = []
+        for reg, ps in sorted(cand):
+            alive = [p for p in sorted(ps) if p not in dead_set]
+            shadow = not alive
+            if shadow:
+                p = min(ps)                     # replacement keyed here
+            elif dpos in alive:
+                p = dpos                        # local copy: zero wire
+            else:
+                p = alive[0]
+            nb = _rsize(reg) * itemsize
+            dev_pieces.append(CrossPiece(
+                dst_pos=dpos, src_pos=p,
+                start=tuple(a for a, _ in reg),
+                sizes=tuple(b - a for a, b in reg),
+                nbytes=nb, from_shadow=shadow))
+            if shadow or p != dpos:
+                wire += nb
+        pieces.extend(dev_pieces)
+        # live-set model per dest device: resident src shard + the
+        # assembled dst shard + one in-flight piece (assembly is
+        # sequential donated update_slice, never a full concat)
+        max_piece = max((pc.nbytes for pc in dev_pieces), default=0)
+        if len(dev_pieces) == 1 and dev_pieces[0].src_pos == dpos \
+                and not dev_pieces[0].from_shadow \
+                and dev_pieces[0].nbytes == _rsize(src_map[dpos]) * itemsize:
+            live = src_b                        # pure alias, no assembly
+        else:
+            live = src_b + _rsize(R) * itemsize + max_piece
+        peak = max(peak, live)
+    if peak > bound:
+        return _fallback(
+            f"peak {peak}B over bound {bound}B "
+            f"(reshard_peak_factor={factor:g})")
+    return CrossMeshPlan(
+        key=key, shape=shape, dtype=dt.name, src=src, dst=dst,
+        pieces=tuple(pieces), src_shard_bytes=src_b, dst_shard_bytes=dst_b,
+        peak_bytes=peak, wire_bytes=wire, bound_bytes=bound,
+        n_src=len(src_devs), n_dst=len(dst_devs))
+
+
+_cross_plans: Dict[tuple, CrossMeshPlan] = {}
+_cross_exe: Dict[tuple, Callable] = {}
+_CROSS_CAP = 256
+
+
+def _cross_compiled(key: tuple, build: Callable, spc=None) -> Callable:
+    """Executable-cache discipline for cross-plan piece programs (same
+    build:*/cache_hit:* spans and pvars as Resharder._compiled)."""
+    fn = _cross_exe.get(key)
+    if fn is None:
+        if len(_cross_exe) >= _CROSS_CAP:
+            _cross_exe.pop(next(iter(_cross_exe)))
+        if trace.enabled:
+            t0 = time.perf_counter()
+            try:
+                fn = build()
+            except BaseException:
+                trace.record_span(f"build:{key[0]}", "compile", t0,
+                                  time.perf_counter(),
+                                  args={"key": repr(key),
+                                        "status": "error"})
+                raise
+            trace.record_span(f"build:{key[0]}", "compile", t0,
+                              time.perf_counter(), args={"key": repr(key)})
+        else:
+            fn = build()
+        _cross_exe[key] = fn
+        if spc is not None:
+            spc.inc("device_cache_misses")
+            spc.inc("cache_miss_count")
+    elif trace.enabled:
+        trace.instant(f"cache_hit:{key[0]}", "cache",
+                      args={"key": repr(key)})
+    return fn
+
+
+def _cross_plan(shape, dtype, src_spec, dst_spec, src_mesh, dst_mesh,
+                dead) -> CrossMeshPlan:
+    dt = jnp.dtype(dtype).name
+    key = (_norm(src_spec, len(shape)), _norm(dst_spec, len(shape)),
+           tuple(int(s) for s in shape), dt,
+           tuple(id(d) for d in np.asarray(src_mesh.devices).flat),
+           tuple(id(d) for d in np.asarray(dst_mesh.devices).flat),
+           frozenset(int(p) for p in dead))
+    hit = _cross_plans.get(key)
+    if hit is not None:
+        if trace.enabled:
+            trace.instant("cache_hit:reshard_cross_plan", "cache",
+                          args={"plan": hit.label})
+        return hit
+    if len(_cross_plans) >= _CROSS_CAP:
+        _cross_plans.pop(next(iter(_cross_plans)))
+    t0 = time.perf_counter()
+    try:
+        plan = compile_cross_plan(shape, dtype, src_spec, dst_spec,
+                                  src_mesh, dst_mesh, dead=dead)
+    except BaseException:
+        if trace.enabled:
+            trace.record_span("reshard:compile_cross_plan", "compile", t0,
+                              time.perf_counter(),
+                              args={"status": "error"})
+        raise
+    if trace.enabled:
+        trace.record_span("reshard:compile_cross_plan", "compile", t0,
+                          time.perf_counter(),
+                          args={"plan": plan.label,
+                                "pieces": len(plan.pieces),
+                                "peak_bytes": plan.peak_bytes,
+                                "wire_bytes": plan.wire_bytes})
+    _cross_plans[key] = plan
+    with _lock:
+        _counts["reshard_plans"] += 1
+        _plan_log.append({
+            "plan": plan.label, "steps": plan.describe(),
+            "wire_bytes": plan.wire_bytes, "peak_bytes": plan.peak_bytes,
+            "bound_bytes": plan.bound_bytes,
+            "src_shard_bytes": plan.src_shard_bytes,
+            "dst_shard_bytes": plan.dst_shard_bytes,
+            "fallback_reason": plan.fallback_reason,
+            "cross": True, "dead": sorted(int(p) for p in dead),
+            "mesh": {"src": dict(src_mesh.shape),
+                     "dst": dict(dst_mesh.shape)}})
+    return plan
+
+
+def cross_reshard(x: jax.Array, dst: NamedSharding, *,
+                  dead: Sequence[int] = (), replacements=None,
+                  spc=None) -> jax.Array:
+    """Redistribute ``x`` from its (larger) source mesh onto ``dst``'s
+    survivor mesh.  ``dead`` flat source positions are never read; each
+    of their blocks must be covered by ``replacements[pos]`` — a
+    device-resident array equal to that position's lost shard (the
+    peer-shadow copy ft/elastic maintains).  Audited exactly like a
+    single-mesh plan: one decide:reshard event for the migrate step,
+    per-pair traffic attribution on the source mesh's edge space, and
+    the reshard_* pvars."""
+    global _last_run
+    if not isinstance(dst, NamedSharding):
+        raise TypeError("cross_reshard: dst must be a NamedSharding "
+                        f"(got {type(dst).__name__})")
+    s = getattr(x, "sharding", None)
+    if not (isinstance(x, jax.Array) and isinstance(s, NamedSharding)):
+        raise ReshardError("cross_reshard: x must be a mesh-sharded "
+                           "jax.Array (got an uncommitted input)")
+    src_mesh = s.mesh
+    if src_mesh == dst.mesh and not dead:
+        return resharder(src_mesh, spc=spc).run(x, dst.spec)
+    replacements = dict(replacements or {})
+    plan = _cross_plan(x.shape, x.dtype, s.spec, dst.spec,
+                       src_mesh, dst.mesh, dead)
+    from .. import perf
+    from ..coll import xla as _xla
+    src_devs = list(np.asarray(src_mesh.devices).flat)
+    itemsize = np.dtype(plan.dtype).itemsize
+    t0 = time.perf_counter()
+    if plan.fallback_reason:
+        out = jax.device_put(x, dst)
+        pair_bytes: Dict[Tuple[int, int], int] = {}
+        wire = plan.wire_bytes
+    else:
+        shards = {}
+        for sh in x.addressable_shards:
+            shards[src_devs.index(sh.device)] = sh.data
+        by_dst: Dict[int, List[CrossPiece]] = {}
+        for pc in plan.pieces:
+            by_dst.setdefault(pc.dst_pos, []).append(pc)
+        pair_bytes = {}
+        wire = 0
+        blocks = []
+        order = []
+        src_sh_map = {src_devs.index(d): _region(idx, x.shape)
+                      for d, idx in
+                      NamedSharding(src_mesh, s.spec)
+                      .devices_indices_map(x.shape).items()}
+        for dev, idx in dst.devices_indices_map(x.shape).items():
+            dpos = src_devs.index(dev)
+            R = _region(idx, x.shape)
+            pcs = by_dst[dpos]
+            whole = (len(pcs) == 1 and not pcs[0].from_shadow
+                     and pcs[0].src_pos == dpos
+                     and pcs[0].sizes == tuple(b - a for a, b in
+                                               src_sh_map[dpos]))
+            if whole:
+                blocks.append(shards[dpos])
+                order.append(dev)
+                continue
+            rshape = tuple(b - a for a, b in R)
+            zkey = ("reshard_cross_zeros", rshape, plan.dtype, id(dev))
+            zfn = _cross_compiled(
+                zkey,
+                lambda rs=rshape, dv=dev: jax.jit(
+                    lambda: jnp.zeros(rs, plan.dtype),
+                    out_shardings=jax.sharding.SingleDeviceSharding(dv)),
+                spc=spc)
+            block = zfn()
+            for pc in pcs:
+                if pc.from_shadow:
+                    repl = replacements.get(pc.src_pos)
+                    if repl is None:
+                        raise ReshardError(
+                            f"cross_reshard: dead position {pc.src_pos} "
+                            "has no replacement shard (peer shadow "
+                            "missing) — cannot recover its block")
+                    base = src_sh_map[pc.src_pos]
+                    arr = repl
+                    holder = next(iter(arr.devices())) \
+                        if hasattr(arr, "devices") else dev
+                    src_pos_real = (src_devs.index(holder)
+                                    if holder in src_devs else pc.src_pos)
+                else:
+                    base = src_sh_map[pc.src_pos]
+                    arr = shards[pc.src_pos]
+                    src_pos_real = pc.src_pos
+                crop = tuple(
+                    slice(st - b0, st - b0 + sz)
+                    for st, sz, (b0, _b1) in zip(pc.start, pc.sizes, base))
+                if any(c != slice(0, sh) for c, sh in zip(crop, arr.shape)):
+                    arr = arr[crop]
+                moved = jax.device_put(arr, dev)
+                if src_pos_real != dpos:
+                    nb = int(np.prod(pc.sizes)) * itemsize
+                    wire += nb
+                    pair_bytes[(src_pos_real, dpos)] = \
+                        pair_bytes.get((src_pos_real, dpos), 0) + nb
+                offs = tuple(st - a for st, (a, _b) in zip(pc.start, R))
+                ukey = ("reshard_cross_update", rshape, moved.shape, offs,
+                        plan.dtype, id(dev))
+                ufn = _cross_compiled(
+                    ukey,
+                    lambda o=offs, dv=dev: jax.jit(
+                        lambda b, p: lax.dynamic_update_slice(
+                            b, p, o),
+                        donate_argnums=(0,),
+                        out_shardings=jax.sharding.SingleDeviceSharding(
+                            dv)),
+                    spc=spc)
+                block = ufn(block, moved)
+            blocks.append(block)
+            order.append(dev)
+        out = jax.make_array_from_single_device_arrays(
+            x.shape, dst, blocks)
+    dur = None
+    if perf.enabled:
+        jax.block_until_ready(out)
+        dur = time.perf_counter() - t0
+    # -- audit: one decision + counters + per-pair traffic ---------------
+    plane = ("dcn" if any(classify_axes(src_mesh).get(a) == "dcn"
+                          for a in src_mesh.axis_names) else "ici")
+    arm, reason, chain = _xla.decide_mode(
+        "reshard", wire, plan.n_src, jax.devices()[0].platform,
+        _xla._load_device_rules(), allowed=("native",), quant_ok=False,
+        dtype=None, op=None, plane=plane, hier_ok=False,
+        hier_why="cross-mesh migrate is a fixed point-to-point schedule")
+    with _lock:
+        _counts["reshard_steps"] += 1
+        _counts["reshard_bytes"] += int(wire)
+    if spc is not None:
+        spc.inc(f"coll_arm_{arm}_count")
+        if wire:
+            spc.inc("coll_wire_bytes", int(wire))
+    planes: Dict[str, int] = {}
+    from .. import traffic
+    if traffic.enabled and wire:
+        if pair_bytes:
+            axes = tuple(src_mesh.axis_names)
+            for (sp, dp), nb in sorted(pair_bytes.items()):
+                part = traffic.note_reshard_step(
+                    src_mesh, "perm", axes, nb, pairs=[(sp, dp)])
+                for k, v in part.items():
+                    planes[k] = planes.get(k, 0) + v
+        else:       # device_put fallback: full exchange on the dst mesh
+            planes = traffic.note_reshard_step(
+                dst.mesh, "a2a", tuple(dst.mesh.axis_names), wire)
+    if perf.enabled and dur is not None and wire and plan.n_src >= 2:
+        perf.note_sample("reshard", arm, wire, dur, plan.n_src,
+                         planes=planes)
+    step_op = plan.describe()[0]
+    if trace.enabled:
+        trace.decision(
+            "reshard", arm=arm, reason=reason, nbytes=int(wire),
+            step=0, step_op=step_op, plan=plan.label, plan_steps=1,
+            peak_bytes=plan.peak_bytes, bound_bytes=plan.bound_bytes,
+            ndev=plan.n_src, wire_bytes=int(wire), chain=chain,
+            cross=True, dead=sorted(int(p) for p in dead))
+    with _lock:
+        _last_run = {"plan": plan.label,
+                     "steps": [{"step": 0, "op": step_op, "arm": arm,
+                                "reason": reason, "wire_bytes": int(wire),
+                                "dur_us": (round(dur * 1e6, 1)
+                                           if dur is not None else None)}],
+                     "wire_bytes": int(wire),
+                     "peak_bytes": plan.peak_bytes,
+                     "bound_bytes": plan.bound_bytes,
+                     "fallback_reason": plan.fallback_reason}
+    return out
+
+
+# ---------------------------------------------------------------------------
 # pvars + report
 # ---------------------------------------------------------------------------
 
@@ -738,3 +1196,5 @@ def reset() -> None:
         _plan_log.clear()
         _last_run = None
     _resharders.clear()
+    _cross_plans.clear()
+    _cross_exe.clear()

@@ -93,6 +93,13 @@ COUNTERS = [
     ("numerics_snr_db", "most recent sampled quantization SNR, dB"),
     ("numerics_divergence_trips",
      "cross-replica divergence audits that found replicas disagreeing"),
+    # elastic recovery plane (fed by ompi_tpu/ft/elastic; process-wide)
+    ("ft_recoveries",
+     "completed elastic recoveries (trip -> shrink -> reshard -> resume)"),
+    ("ft_steps_lost",
+     "training steps rolled back to the shadow epoch across recoveries"),
+    ("ft_shadow_refreshes",
+     "peer-shadow ring_shift refreshes of the training state"),
 ]
 
 
@@ -146,6 +153,10 @@ class Counters:
                 pvar_value as _rpval
             if name in _rpv:
                 return _rpval(name)
+        if name.startswith("ft_"):
+            from .ft import elastic
+            if name in elastic.PVARS:
+                return elastic.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -166,6 +177,9 @@ class Counters:
         from .parallel.reshard import PVARS as _rpv, pvar_value as _rpval
         for name in _rpv:
             out[name] = _rpval(name)
+        from .ft import elastic
+        for name in elastic.PVARS:
+            out[name] = elastic.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

@@ -34,8 +34,9 @@ from ..trace import merge as _merge
 # --reshard, --analyze, --live) emits it so downstream tooling can
 # detect drift (ISSUE 7 satellite; 4 = the numerics plane section,
 # ISSUE 9; 5 = the reshard plan-cache/last-plan section, ISSUE 10;
-# 6 = the static-verifier section, ISSUE 11)
-SCHEMA_VERSION = 6
+# 6 = the static-verifier section, ISSUE 11;
+# 7 = the ft/elastic recovery section, ISSUE 13)
+SCHEMA_VERSION = 7
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -477,6 +478,53 @@ def build_analyze_report(
     return "\n".join(lines), doc
 
 
+def build_ft_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the elastic-recovery plane:
+    recovery/steps-lost/shadow-refresh counters and, per recovery, the
+    full choreography timeline (trip verdict -> shrink epoch -> reshard
+    plan -> resume step) with wall-clock milestones.  ``path`` loads a
+    banked ELASTIC json (bench.py --elastic); default reads the live
+    in-process plane."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from ..ft.elastic import report as _ft_report
+        rep = _ft_report()
+    lines: List[str] = []
+    w = lines.append
+    c = rep.get("counters") or {}
+    src = f" (from {path})" if path else ""
+    w(f"elastic recovery: {int(c.get('ft_recoveries', 0))} recovery(ies), "
+      f"{int(c.get('ft_steps_lost', 0))} step(s) lost, "
+      f"{int(c.get('ft_shadow_refreshes', 0))} shadow refresh(es){src}")
+    recs = rep.get("recoveries") or []
+    if not recs:
+        w("  no recoveries recorded (no rank death survived yet)")
+    for r in recs[-6:]:
+        w(f"  recovery: rank {r.get('dead_rank')} died ({r.get('kind')}) "
+          f"at step {r.get('trip_step')}, mesh "
+          f"{r.get('mesh_before')} -> {r.get('mesh_after')} device(s)")
+        w(f"    trip    +{float(r.get('t_trip_ms', 0.0)):.1f} ms  "
+          f"verdict={r.get('kind')} dead={r.get('dead')}")
+        shrink = r.get("shrink") or {}
+        w(f"    shrink  +{float(r.get('t_shrink_ms', 0.0)):.1f} ms  "
+          + (f"cid {shrink.get('old_cid')} -> {shrink.get('cid')} "
+             f"({shrink.get('name')})" if shrink
+             else "single-controller (no comm)"))
+        w(f"    reshard +{float(r.get('t_reshard_ms', 0.0)):.1f} ms  "
+          f"{int(r.get('leaves', 0))} leaf/leaves, "
+          f"{int(r.get('wire_bytes', 0))} B wire, "
+          f"{int(r.get('ckpt_reads', 0))} checkpoint read(s)")
+        w(f"    resume  +{float(r.get('t_resume_ms', 0.0)):.1f} ms  "
+          f"step {r.get('resume_step')} "
+          f"({r.get('steps_lost')} step(s) lost, budget "
+          f"{r.get('budget_steps')})")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -546,6 +594,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "SPMD check issues from a banked ANALYZE "
                          "json (bench.py --analyze); bare flag picks "
                          "the newest ANALYZE_*.json")
+    ap.add_argument("--ft", nargs="?", const="", default=None,
+                    metavar="ELASTIC.json",
+                    help="render the elastic-recovery section: the "
+                         "trip -> shrink -> reshard -> resume timeline "
+                         "per survived rank death, counters, shadow "
+                         "refreshes. With a path, loads a banked "
+                         "ELASTIC json (bench.py --elastic); bare "
+                         "flag reads the live in-process plane")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -582,8 +638,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _report(tl, ns, health=(htext, hdata))
     if not ns.dumps:
         if (ns.perf or ns.traffic is not None or ns.numerics is not None
-                or ns.reshard is not None or ns.analyze is not None):
-            # perf/traffic/numerics/reshard/analyze section standalone
+                or ns.reshard is not None or ns.analyze is not None
+                or ns.ft is not None):
+            # perf/traffic/numerics/reshard/analyze/ft section standalone
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
@@ -624,6 +681,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         atext, adata = build_analyze_report(ns.analyze or None)
         text = (text + "\n" + atext) if text else atext
         data["analyze"] = adata
+    if getattr(ns, "ft", None) is not None:
+        ftext, fdata = build_ft_report(ns.ft or None)
+        text = (text + "\n" + ftext) if text else ftext
+        data["ft"] = fdata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

@@ -76,3 +76,87 @@ def test_manager_empty_raises(tmp_path):
     mgr = ckpt.CheckpointManager(str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError):
         mgr.restore_latest(like={})
+
+
+def _corrupt_step(mgr, step):
+    """Flip bytes in one payload shard file of a finalized step dir (the
+    checksum manifest itself is left intact, so verification sees a
+    save-time digest the on-disk bytes no longer match)."""
+    import os
+    root = mgr._step_dir(step)
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if name == ckpt.CHECKSUM_FILE or name.endswith(".tmp"):
+                continue
+            full = os.path.join(dirpath, name)
+            if os.path.getsize(full) == 0:
+                continue
+            with open(full, "r+b") as fh:
+                b = fh.read(1)
+                fh.seek(0)
+                fh.write(bytes([b[0] ^ 0xFF]))
+            return full
+    raise AssertionError(f"no payload file to corrupt under {root}")
+
+
+def test_restore_latest_falls_back_past_corrupt_newest(tmp_path):
+    """Graceful degradation: a corrupt newest step is logged and skipped;
+    restore_latest lands on the next-newest CLEAN step instead of
+    stranding the job — and the fallback still goes through restore(),
+    ticking the odometer elastic recovery audits against."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"), every=1, keep=3)
+    states = {}
+    for step in (0, 1, 2):
+        states[step] = _state(step)
+        mgr.save(step, states[step], blocking=True)
+    mgr.wait()
+    _corrupt_step(mgr, 2)
+
+    before = ckpt.restore_count()
+    out = mgr.restore_latest(like=states[1])
+    _eq(out, states[1])                       # step 2 skipped, 1 is clean
+    assert ckpt.restore_count() == before + 1
+
+    # the corrupt step STILL fails loudly when addressed directly
+    with pytest.raises(ckpt.CheckpointCorruptionError):
+        mgr.restore(2, like=states[2])
+
+
+def test_restore_latest_all_corrupt_raises(tmp_path):
+    """When retention left NO clean step, degradation ends: the manager
+    raises CheckpointCorruptionError naming the exhausted fallback
+    chain rather than restoring poisoned state."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"), every=1, keep=2)
+    for step in (0, 1):
+        mgr.save(step, _state(step), blocking=True)
+    mgr.wait()
+    for step in mgr.steps():
+        _corrupt_step(mgr, step)
+    with pytest.raises(ckpt.CheckpointCorruptionError,
+                       match="no clean step to fall back to"):
+        mgr.restore_latest(like=_state(0))
+
+
+def test_restore_latest_missing_shard_falls_back(tmp_path):
+    """A truncation/unlink (not just a bit flip) is the other real-world
+    corruption shape — a DELETED shard file must also route restore to
+    the older clean step."""
+    import os
+    mgr = ckpt.CheckpointManager(str(tmp_path / "run"), every=1, keep=2)
+    states = {}
+    for step in (0, 1):
+        states[step] = _state(step)
+        mgr.save(step, states[step], blocking=True)
+    mgr.wait()
+    root = mgr._step_dir(1)
+    victim = None
+    for dirpath, _dirs, files in os.walk(root):
+        for name in sorted(files):
+            if name != ckpt.CHECKSUM_FILE and not name.endswith(".tmp"):
+                victim = os.path.join(dirpath, name)
+                break
+        if victim:
+            break
+    os.unlink(victim)
+    out = mgr.restore_latest(like=states[0])
+    _eq(out, states[0])

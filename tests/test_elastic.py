@@ -1,0 +1,547 @@
+"""Elastic fault-tolerant training (ft/elastic + ft/chaos + the
+cross-mesh arm of parallel/reshard): survive a rank death end-to-end.
+
+The reference recovers from a dead rank by restoring a checkpoint onto
+the shrunken job; the elastic loop here replaces the filesystem
+round-trip with in-memory peer-replicated shadows (a +1 ring hop of
+every dp-sharded leaf), so the choreography under test is
+
+    trip verdict -> ULFM revoke+shrink -> cross-mesh reshard -> resume
+
+with deterministic chaos injection standing in for mpirun-killed
+processes on the 8-dev CPU mesh."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ompi_tpu import ft, runtime, trace
+from ompi_tpu.core import var
+from ompi_tpu.ft import elastic
+from ompi_tpu.models.transformer import Config
+from ompi_tpu.parallel import make_mesh
+from ompi_tpu.parallel.reshard import (ReshardError, compile_cross_plan,
+                                       cross_reshard)
+
+pytestmark = pytest.mark.elastic
+
+
+@pytest.fixture(autouse=True)
+def fast_detector():
+    var.registry.set_cli("ft_detector_period", "0.02")
+    var.registry.set_cli("ft_detector_timeout", "0.3")
+    var.registry.reset_cache()
+    yield
+    var.registry.clear_cli("ft_detector_period")
+    var.registry.clear_cli("ft_detector_timeout")
+    var.registry.reset_cache()
+
+
+def _tiny_cfg():
+    return Config(vocab=64, d_model=32, n_layers=1, n_heads=2, head_dim=8,
+                  d_ff=64, seq=16, dtype=jnp.float32, grad_sync="native")
+
+
+# ---------------------------------------------------------------------------
+# survivor math + the elastic layout rule
+# ---------------------------------------------------------------------------
+
+def test_survivor_positions_divisor_prefix():
+    assert elastic.survivor_positions(8, [3]) == [0, 1, 2, 4]
+    assert elastic.survivor_positions(8, [0, 4]) == [1, 2, 3, 5]
+    assert elastic.survivor_positions(8, []) == list(range(8))
+    # 6 alive but 8's divisors are 1/2/4/8 -> a 4-wide prefix
+    assert len(elastic.survivor_positions(8, [1, 6])) == 4
+    with pytest.raises(ft.ProcFailedError):
+        elastic.survivor_positions(2, [0, 1])
+
+
+def test_survivor_mesh_shrinks_to_divisor():
+    mesh = make_mesh({"dp": 8})
+    small = elastic.survivor_mesh(mesh, [3])
+    assert small.devices.size == 4
+    devs = list(np.asarray(mesh.devices).flat)
+    assert list(np.asarray(small.devices).flat) == \
+        [devs[0], devs[1], devs[2], devs[4]]
+
+
+def test_elastic_spec_dim0_rule():
+    mesh = make_mesh({"dp": 8})
+    w = jnp.zeros((16, 4))
+    assert elastic.elastic_spec(w, 8) == P("dp")
+    assert elastic.elastic_spec(jnp.zeros(()), 8) == P()
+    assert elastic.elastic_spec(jnp.zeros((6, 4)), 8) == P()
+    tree = elastic.elastic_shard({"w": w, "c": jnp.zeros(())}, mesh)
+    assert tree["w"].sharding.spec == P("dp")
+    # the divisor guarantee: any survivor mesh re-hosts the same rule
+    for m in (4, 2, 1):
+        assert int(w.shape[0]) % m == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-mesh plan compiler: accounting + failure modes
+# ---------------------------------------------------------------------------
+
+def test_cross_plan_accounting_8_to_4():
+    mesh8 = make_mesh({"dp": 8})
+    mesh4 = elastic.survivor_mesh(mesh8, [3])
+    plan = compile_cross_plan((16, 4), jnp.float32, P("dp", None),
+                              P("dp", None), mesh8, mesh4, dead=[3])
+    assert not plan.fallback_reason
+    assert plan.n_src == 8 and plan.n_dst == 4
+    # every dst device assembles 2 src blocks; one comes from a shadow
+    assert sum(1 for p in plan.pieces if p.from_shadow) == 1
+    assert plan.wire_bytes > 0
+    assert plan.peak_bytes <= plan.bound_bytes
+    # the modeled peak is src shard + dst shard + one staged piece
+    itemsize = 4
+    src_b, dst_b = 16 * 4 * itemsize // 8, 16 * 4 * itemsize // 4
+    assert plan.peak_bytes == src_b + dst_b + src_b
+    assert plan.bound_bytes == 2 * max(src_b, dst_b)
+
+
+def test_cross_plan_replicated_is_wireless():
+    mesh8 = make_mesh({"dp": 8})
+    mesh4 = elastic.survivor_mesh(mesh8, [3])
+    plan = compile_cross_plan((3, 3), jnp.float32, P(), P(),
+                              mesh8, mesh4, dead=[3])
+    # every dst device already holds a replica: pure alias, zero wire
+    assert plan.wire_bytes == 0
+    assert all(not p.from_shadow for p in plan.pieces)
+
+
+def test_cross_plan_dead_rank_in_dst_rejected():
+    mesh8 = make_mesh({"dp": 8})
+    devs = list(np.asarray(mesh8.devices).flat)
+    bad = make_mesh({"dp": 4}, devices=devs[:4])     # contains position 3
+    with pytest.raises(ReshardError):
+        compile_cross_plan((16, 4), jnp.float32, P("dp", None),
+                           P("dp", None), mesh8, bad, dead=[3])
+
+
+def test_cross_plan_irregular_with_dead_is_loud():
+    """A tiling the piece model can't assemble falls back to device_put
+    — but device_put reads the dead device, so with dead ranks it must
+    refuse loudly instead."""
+    mesh8 = make_mesh({"dp": 8})
+    mesh4 = elastic.survivor_mesh(mesh8, [3])
+    # an axis move (dim-1 blocks -> dim-0 blocks): no src block is
+    # contained in a dst block, so the piece model can't tile it
+    plan = compile_cross_plan((16, 8), jnp.float32, P(None, "dp"),
+                              P("dp", None), mesh8, mesh4, dead=())
+    assert plan.fallback_reason
+    with pytest.raises(ReshardError):
+        compile_cross_plan((16, 8), jnp.float32, P(None, "dp"),
+                           P("dp", None), mesh8, mesh4, dead=[3])
+
+
+def test_cross_reshard_values_with_shadow_replacement():
+    mesh8 = make_mesh({"dp": 8})
+    mesh4 = elastic.survivor_mesh(mesh8, [3])
+    host = np.arange(64, dtype=np.float32).reshape(16, 4)
+    x = jax.device_put(host, NamedSharding(mesh8, P("dp", None)))
+    devs = list(np.asarray(mesh8.devices).flat)
+    # the dead position's block, as the ring shadow would hold it (on
+    # the +1 neighbor, position 4)
+    repl = jax.device_put(jnp.asarray(host[6:8]), devs[4])
+    out = cross_reshard(x, NamedSharding(mesh4, P("dp", None)),
+                        dead=[3], replacements={3: repl})
+    np.testing.assert_array_equal(np.asarray(out), host)
+    assert set(out.devices()) == set(np.asarray(mesh4.devices).flat)
+    # without a replacement for the dead shard the engine must refuse
+    with pytest.raises(ReshardError):
+        cross_reshard(x, NamedSharding(mesh4, P("dp", None)), dead=[3])
+
+
+# ---------------------------------------------------------------------------
+# the peer-shadow ring
+# ---------------------------------------------------------------------------
+
+def test_shadow_ring_holds_neighbor_block():
+    mesh = make_mesh({"dp": 8})
+    host = np.arange(32, dtype=np.float32).reshape(8, 4)
+    w = jax.device_put(host, NamedSharding(mesh, P("dp", None)))
+    store = elastic.ShadowStore(mesh)
+    store.refresh({"w": w}, step=5)
+    assert store.epoch == 5
+    shifted = store.shifted["w"]
+    devs = list(np.asarray(mesh.devices).flat)
+    for sh in shifted.addressable_shards:
+        j = devs.index(sh.device)
+        np.testing.assert_array_equal(np.asarray(sh.data),
+                                      host[(j - 1) % 8][None])
+    # dead position p's block is served from (p+1) % n
+    rep = store.replacement(shifted, 3)
+    np.testing.assert_array_equal(np.asarray(rep), host[3][None])
+
+
+def test_shadow_snap_is_a_real_copy():
+    """make_train_step donates params/opt — a shadow holding references
+    into the live tree would dangle after the next step."""
+    mesh = make_mesh({"dp": 8})
+    w = jax.device_put(np.ones((8, 4), np.float32),
+                       NamedSharding(mesh, P("dp", None)))
+    store = elastic.ShadowStore(mesh)
+    store.refresh({"w": w}, step=0)
+    donate = jax.jit(lambda v: v * 0.0, donate_argnums=(0,))
+    donate(w)                       # invalidates w's buffers
+    np.testing.assert_array_equal(np.asarray(store.snap["w"]),
+                                  np.ones((8, 4), np.float32))
+
+
+# ---------------------------------------------------------------------------
+# trip classification + the watchdog -> ULFM seam
+# ---------------------------------------------------------------------------
+
+def test_trip_verdict_shapes():
+    v = elastic.trip_verdict(ft.ProcFailedError(3, "chaos"))
+    assert (v["kind"], v["rank"]) == ("proc_failed", 3)
+    exc = ft.WatchdogTimeoutError("stuck", cid=7, seq=2, op="allreduce",
+                                  suspect=5)
+    v = elastic.trip_verdict(exc)
+    assert v == {"kind": "watchdog", "rank": 5, "cid": 7, "seq": 2,
+                 "op": "allreduce", "msg": "stuck"}
+    assert elastic.trip_verdict(RuntimeError("x"))["kind"] == "unknown"
+    assert isinstance(exc, elastic.ElasticTrainer.ERRORS)
+
+
+def test_watchdog_escalate_attributes_suspect():
+    """The raise arm feeds the detector-declared failure (first) or the
+    desync sentinel's verdict into WatchdogTimeoutError.suspect — the
+    field trip_verdict targets the shrink with."""
+    from ompi_tpu.health import watchdog
+
+    class _Boot:
+        def publish_event(self, ev):
+            pass
+
+    class _Ctx:
+        rank = 0
+        bootstrap = _Boot()
+
+    entry = {"op": "allreduce", "comm": "world", "cid": 1, "seq": 4,
+             "nbytes": 64}
+    var.registry.set_cli("health_watchdog_action", "raise")
+    var.registry.reset_cache()
+    try:
+        with pytest.raises(ft.WatchdogTimeoutError) as ei:
+            watchdog._escalate(
+                _Ctx(), {"tripped": [entry], "ft_failed": [2]},
+                allow_raise=True)
+        assert (ei.value.cid, ei.value.seq, ei.value.op) == \
+            (1, 4, "allreduce")
+        assert ei.value.suspect == 2
+        with pytest.raises(ft.WatchdogTimeoutError) as ei:
+            watchdog._escalate(
+                _Ctx(),
+                {"tripped": [entry],
+                 "verdict": {"desync": [{"rank": 3, "op": "allgather"}]}},
+                allow_raise=True)
+        assert ei.value.suspect == 3
+    finally:
+        var.registry.clear_cli("health_watchdog_action")
+        var.registry.reset_cache()
+
+
+@pytest.mark.parametrize("nranks", [4, 8])
+def test_watchdog_trip_from_blocked_wait_is_elastic_signal(nranks):
+    """A rank going silent mid-collective trips the watchdog inside the
+    survivors' blocked waits; the raised error carries the blocked op's
+    (cid, seq, op) and classifies as a watchdog trip — the failure
+    signal the elastic loop shrinks on."""
+    from ompi_tpu import health
+
+    health.reset()
+    var.registry.set_cli("health_enabled", "true")
+    var.registry.set_cli("health_watchdog_timeout", "0.5")
+    var.registry.set_cli("health_watchdog_action", "raise")
+    var.registry.set_cli("health_dump_dir", "")
+    var.registry.reset_cache()
+    try:
+        def fn(ctx):
+            c = ctx.comm_world
+            buf = np.ones(4, np.float32)
+            c.coll.allreduce(c, buf)              # seq 1: uniform warmup
+            if ctx.rank == nranks - 1:
+                time.sleep(3.0)                   # silent straggler
+                return None
+            try:
+                c.coll.allreduce(c, buf)          # seq 2: blocks
+            except elastic.ElasticTrainer.ERRORS as exc:
+                return elastic.trip_verdict(exc)
+            return None
+
+        res = runtime.run_ranks(nranks, fn, timeout=60)
+        for v in res[:-1]:
+            assert v is not None, "survivor never tripped"
+            assert v["kind"] == "watchdog"
+            assert v["op"] == "allreduce"
+            assert v["seq"] == 2
+            assert v["cid"] >= 0
+    finally:
+        for name in ("health_enabled", "health_watchdog_timeout",
+                     "health_watchdog_action", "health_dump_dir"):
+            var.registry.clear_cli(name)
+        var.registry.reset_cache()
+        health.reset()
+
+
+# ---------------------------------------------------------------------------
+# host plane: comm_recover + chaos transport faults
+# ---------------------------------------------------------------------------
+
+def test_comm_recover_shrinks_to_survivors():
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        comm.barrier()
+        chaos = ft.ChaosMonkey().kill_at_step(rank=2, step=0)
+        if chaos.maybe_die(ctx, step=0):
+            time.sleep(2.5)
+            return None
+        deadline = time.monotonic() + 10
+        while 2 not in ft.failed_ranks(ctx):
+            ctx.engine.progress()
+            assert time.monotonic() < deadline
+        new, dead, info = elastic.comm_recover(
+            comm, {"kind": "proc_failed", "rank": 2})
+        assert dead == [2]
+        assert info["dead"] == [2] and 2 not in info["survivors"]
+        assert info["verdict"]["rank"] == 2
+        return new.size
+    res = runtime.run_ranks(4, body, timeout=60)
+    assert res[:2] + res[3:] == [3, 3, 3]
+
+
+def test_chaos_dropped_revoke_is_reflooded():
+    """drop_revokes eats the first revoke frame on one rank; the
+    reliable flood (every receiver re-floods) still revokes it."""
+    def body(ctx):
+        ft.enable(ctx)            # detector installs AM_FT first
+        comm = ctx.comm_world
+        chaos = ft.ChaosMonkey()
+        state = chaos.drop_revokes(ctx, count=1) if ctx.rank == 1 else None
+        comm.barrier()
+        if ctx.rank == 0:
+            ft.revoke(comm)
+        deadline = time.monotonic() + 10
+        while not comm.revoked:
+            ctx.engine.progress()
+            assert time.monotonic() < deadline, "revoke never arrived"
+        if ctx.rank == 1:
+            assert state["left"] == 0, "no revoke frame was dropped"
+            assert any(e.get("kind") == "dropped_revoke"
+                       for e in chaos.log)
+        return True
+    assert all(runtime.run_ranks(4, body, timeout=60))
+
+
+def test_chaos_delayed_send_still_delivers():
+    """A delayed control plane (the revoke flood here) slows delivery
+    but must not lose it — the detector/watchdog latency-tolerance
+    scenario."""
+    def body(ctx):
+        ft.enable(ctx)
+        comm = ctx.comm_world
+        chaos = ft.ChaosMonkey()
+        comm.barrier()
+        if ctx.rank == 0:
+            chaos.delay_sends(ctx, 0.05, dst=1)
+            t0 = time.monotonic()
+            ft.revoke(comm)
+            assert time.monotonic() - t0 >= 0.05
+            assert any(e.get("kind") == "delayed_send" for e in chaos.log)
+        deadline = time.monotonic() + 10
+        while not comm.revoked:
+            ctx.engine.progress()
+            assert time.monotonic() < deadline, "revoke never arrived"
+        return True
+    assert all(runtime.run_ranks(2, body, timeout=60))
+
+
+# ---------------------------------------------------------------------------
+# detector callback regression
+# ---------------------------------------------------------------------------
+
+def test_raising_failure_callback_does_not_kill_detection():
+    trace.enable()
+    n0 = len([e for e in trace.events()
+              if e.get("name") == "ft_callback_error"])
+    try:
+        def body(ctx):
+            det = ft.enable(ctx)
+            seen = []
+
+            def bad_cb(rank):
+                raise RuntimeError("callback bug")
+
+            det.add_failure_callback(bad_cb)
+            det.add_failure_callback(seen.append)
+            ctx.comm_world.barrier()
+            if ctx.rank == 2:
+                ft.simulate_failure(ctx)
+                time.sleep(1.5)
+                return True
+            deadline = time.monotonic() + 10
+            while 2 not in ft.failed_ranks(ctx):
+                ctx.engine.progress()
+                assert time.monotonic() < deadline, "detector died"
+            # the callback AFTER the raising one still ran
+            deadline = time.monotonic() + 5
+            while 2 not in seen:
+                ctx.engine.progress()
+                assert time.monotonic() < deadline
+            return True
+
+        assert all(runtime.run_ranks(4, body, timeout=60))
+        errs = [e for e in trace.events()
+                if e.get("name") == "ft_callback_error"]
+        assert len(errs) > n0
+        a = errs[-1].get("args") or {}
+        assert a.get("failed_rank") == 2
+        assert "bad_cb" in str(a.get("callback"))
+        assert "callback bug" in str(a.get("error"))
+    finally:
+        trace.disable()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: chaos kill -> shrink -> peer-shadow reshard -> resume
+# ---------------------------------------------------------------------------
+
+def test_elastic_trainer_survives_injected_kill():
+    from ompi_tpu import ckpt
+
+    elastic.reset()
+    trace.enable()
+    n0 = len([e for e in trace.events()
+              if e.get("name") == "decide:ft_recovery"])
+    reads0 = ckpt.restore_count()
+    try:
+        chaos = ft.ChaosMonkey().kill_at_step(rank=3, step=5)
+        tr = ft.run_elastic(_tiny_cfg(), 8, shadow_interval=2,
+                            chaos=chaos, batch=8)
+        assert tr.step == 8 and tr.n == 4
+        assert len(tr.recoveries) == 1
+        r = tr.recoveries[0]
+        assert r["dead_rank"] == 3 and r["dead"] == [3]
+        assert (r["mesh_before"], r["mesh_after"]) == (8, 4)
+        assert r["kind"] == "proc_failed"
+        assert r["trip_step"] == 5 and r["epoch_step"] == 4
+        assert r["steps_lost"] == 1 <= r["budget_steps"]
+        assert r["ckpt_reads"] == 0, "recovery must not touch the fs"
+        assert ckpt.restore_count() == reads0
+        assert r["wire_bytes"] > 0
+        assert r["survivors"] == [0, 1, 2, 4]
+        # post-recovery state is finite despite the poisoned shards
+        for leaf in jax.tree_util.tree_leaves((tr.params, tr.opt_state)):
+            if leaf.dtype.kind == "f":
+                assert bool(np.isfinite(np.asarray(leaf)).all())
+        # every step has a loss, including the replayed window
+        assert sorted(tr.loss_by_step) == list(range(8))
+        # exactly one audited ft_recovery decision naming the dead rank
+        decides = [e for e in trace.events()
+                   if e.get("name") == "decide:ft_recovery"][n0:]
+        assert len(decides) == 1
+        args = decides[0].get("args") or {}
+        assert args.get("dead_rank") == 3
+        assert args.get("mesh_after") == 4
+        assert "rank3" in str(args.get("reason"))
+        # the instants of the choreography all fired
+        names = {e.get("name") for e in trace.events()}
+        assert {"ft_trip", "ft_shrink", "ft_reshard",
+                "ft_resume"} <= names
+        assert elastic.pvar_value("ft_recoveries") >= 1
+        assert elastic.report()["last"]["dead_rank"] == 3
+    finally:
+        trace.disable()
+        elastic.reset()
+
+
+def test_elastic_kill_before_first_epoch_is_loud():
+    elastic.reset()
+    tr = ft.ElasticTrainer(_tiny_cfg(), shadow_interval=4, batch=8)
+    # the loop refreshes at the top of every step, so a trip can only
+    # precede the first epoch if the failure signal arrives from
+    # outside the step body (e.g. a comm poll) — drive the recovery
+    # path directly with no epoch banked
+    assert tr.shadows.epoch < 0
+    with pytest.raises(ft.ProcFailedError, match="first shadow epoch"):
+        tr._recover(ft.ProcFailedError(2, "chaos"))
+    elastic.reset()
+
+
+def test_elastic_adjacent_double_failure_is_loud(monkeypatch):
+    """Positions 2 and 3 are ring neighbors: 2's +1 shadow lived on 3
+    and died with it — the loop must refuse and point at checkpoint
+    restore instead of resharding from a dead shadow."""
+    elastic.reset()
+    tr = ft.ElasticTrainer(_tiny_cfg(), shadow_interval=2, batch=8)
+    tr.run(3)                      # bank an epoch
+    monkeypatch.setattr(elastic, "comm_recover",
+                        lambda comm, verdict=None: (None, [2, 3], {}))
+    tr.comm = object()             # route _recover through the comm arm
+    with pytest.raises(ft.ProcFailedError, match="adjacent double"):
+        tr._recover(ft.ProcFailedError(2, "chaos"))
+    elastic.reset()
+
+
+# ---------------------------------------------------------------------------
+# doctor arm
+# ---------------------------------------------------------------------------
+
+def test_doctor_ft_report_renders_timeline(tmp_path):
+    import json
+
+    from ompi_tpu.tools import comm_doctor
+
+    assert comm_doctor.SCHEMA_VERSION == 7
+    doc = {"report": {
+        "counters": {"ft_recoveries": 1, "ft_steps_lost": 2,
+                     "ft_shadow_refreshes": 9},
+        "recoveries": [{
+            "dead_rank": 3, "dead": [3], "kind": "proc_failed",
+            "trip_step": 7, "epoch_step": 6, "resume_step": 6,
+            "steps_lost": 2, "budget_steps": 2, "mesh_before": 8,
+            "mesh_after": 4, "leaves": 28, "wire_bytes": 1024,
+            "ckpt_reads": 0, "shrink": {}, "t_trip_ms": 0.0,
+            "t_shrink_ms": 0.1, "t_reshard_ms": 5.0,
+            "t_resume_ms": 6.0}],
+        "last": None}}
+    p = tmp_path / "ELASTIC_cpu.json"
+    p.write_text(json.dumps(doc))
+    text, data = comm_doctor.build_ft_report(str(p))
+    assert "elastic recovery: 1 recovery(ies)" in text
+    assert "rank 3 died (proc_failed) at step 7" in text
+    for stage in ("trip", "shrink", "reshard", "resume"):
+        assert stage in text
+    assert "0 checkpoint read(s)" in text
+    assert data["counters"]["ft_shadow_refreshes"] == 9
+    # live mode reads the in-process plane
+    elastic.reset()
+    text, _ = comm_doctor.build_ft_report()
+    assert "no recoveries recorded" in text
+
+
+# ---------------------------------------------------------------------------
+# spc read-through
+# ---------------------------------------------------------------------------
+
+def test_ft_pvars_read_through_spc():
+    from ompi_tpu import spc
+
+    elastic.reset()
+    names = [n for n, _ in spc.COUNTERS]
+    for n in ("ft_recoveries", "ft_steps_lost", "ft_shadow_refreshes"):
+        assert n in names
+    c = spc.Counters()
+    assert c.get("ft_recoveries") == 0
+    with elastic._lock:
+        elastic._counts["ft_recoveries"] += 2
+    assert c.get("ft_recoveries") == 2
+    assert c.snapshot()["ft_recoveries"] == 2
+    elastic.reset()
