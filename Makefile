@@ -7,7 +7,7 @@ SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
-	reshard-tests analysis-tests ft-elastic-tests comm-lint \
+	reshard-tests analysis-tests ft-elastic-tests moe-tests comm-lint \
 	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
@@ -32,7 +32,7 @@ SHELL := /bin/bash
 # program or an unaudited dispatch path without spending a single
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
-	numerics-tests reshard-tests ft-elastic-tests
+	numerics-tests reshard-tests ft-elastic-tests moe-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -136,6 +136,19 @@ ft-elastic-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --elastic
+
+# the token-proportional MoE tier: ragged dispatch/combine round-trip
+# vs the host oracle + moe_block_ep arm/conservation suite + hot-expert
+# sentry/adaptation loop, then the end-to-end probe (8 devices, einsum
+# vs ragged vs ragged+hier on uniform AND skewed routing; exits nonzero
+# unless the skewed phase trips the hot-expert sentry EXACTLY once, a
+# capacity adaptation rebalances it away within the probe, ragged wire
+# bytes stay token-proportional, and traffic conservation holds; banks
+# MOE_<platform>.json + a BASELINE.md row)
+moe-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_moe_ep.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --moe
 
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe

@@ -110,6 +110,19 @@ _var.register("coll", "xla", "reshard_mode", "", type=str, level=3,
                    "collectives, so native is the only executable arm "
                    "today; the var exists so the decision chain stays "
                    "uniform and future staged/quant step arms slot in.")
+_var.register("coll", "xla", "moe_dispatch_mode", "", type=str, level=3,
+              help="Force the MoE token-dispatch exchange arm (native|"
+                   "hier|hier+quant; empty = auto via DEVICE_RULES "
+                   "moe_dispatch rows). hier splits the ragged exchange "
+                   "into same-outer-group and cross-DCN lanes; dispatch "
+                   "payloads are never quantized (hier+quant decays to "
+                   "hier here — quant applies to the combine only).")
+_var.register("coll", "xla", "moe_combine_mode", "", type=str, level=3,
+              help="Force the MoE expert-output combine exchange arm "
+                   "(native|hier|hier+quant; empty = auto via "
+                   "DEVICE_RULES moe_combine rows). hier+quant sends "
+                   "the cross-DCN lane on the EQuARX int8 block tier; "
+                   "the same-outer-group lane stays full precision.")
 _var.register("coll", "xla", "rules", "", type=str, level=3,
               help="Arm-selection source: empty/'static' = platform "
                    "default + DEVICE_RULES rows; 'learned' = consult "
@@ -391,7 +404,7 @@ class XlaModule(CollModule):
     _ALL_ARMS = ("native", "staged", "quant")
 
     def _mode(self, coll: str, x, op: Op = None,
-              allowed=_ALL_ARMS, weights=None) -> str:
+              allowed=_ALL_ARMS, weights=None, extra=None) -> str:
         """Pick per (collective, PER-RANK bytes, dtype) — the unit the
         sweep measures and the rules file records (a canonical array's
         row 0 is one rank's buffer), so thresholds line up with the
@@ -406,7 +419,8 @@ class XlaModule(CollModule):
         funnels through here exactly once: one decision-audit record per
         collective."""
         pick, reason, chain = self._decide(coll, x, op, allowed)
-        self._audit(coll, x, op, pick, reason, chain, weights=weights)
+        self._audit(coll, x, op, pick, reason, chain, weights=weights,
+                    extra=extra)
         return pick
 
     def _decide(self, coll: str, x, op: Op, allowed) -> tuple:
@@ -442,7 +456,7 @@ class XlaModule(CollModule):
                    "allgather": "allgather"}
 
     def _audit(self, coll: str, x, op: Op, arm: str, reason: str,
-               chain: list, weights=None) -> None:
+               chain: list, weights=None, extra=None) -> None:
         """ONE decision-audit record per device-dispatched collective.
         Always: the arm-count + wire-byte pvars (plain dict adds, same
         cost class as every other SPC site) and the monitoring wire-byte
@@ -539,12 +553,12 @@ class XlaModule(CollModule):
         if trace.enabled:
             bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
             ctx = getattr(self._comm, "ctx", None)
-            extra = {}
+            extra = dict(extra or {})
             if hier_split is not None:
-                extra = {"hier_inner": hier_split[0],
-                         "hier_outer": hier_split[1],
-                         "hier_inner_bytes": 2 * hier_split[2],
-                         "hier_outer_bytes": hier_split[3]}
+                extra.update({"hier_inner": hier_split[0],
+                              "hier_outer": hier_split[1],
+                              "hier_inner_bytes": 2 * hier_split[2],
+                              "hier_outer_bytes": hier_split[3]})
             trace.decision(
                 coll, arm=arm, reason=reason,
                 nbytes=nbytes, rank=getattr(ctx, "rank", 0),
@@ -925,7 +939,11 @@ class XlaModule(CollModule):
             # 3-D shape (L == R, indistinguishable from padded blocks)
             # keeps the block interpretation below.
             self._check_recvcounts(C, recvcounts)
-            if self._mode("alltoallv", sendbuf, weights=C) == "staged":
+            plan = self.dc.a2av_plan(sendbuf.shape, C)
+            if self._mode("alltoallv", sendbuf, weights=C,
+                          extra={"a2av_slice_cap": plan["slice_cap"],
+                                 "a2av_scan_steps": plan["scan_steps"]},
+                          ) == "staged":
                 h = self._stage_out(sendbuf)           # (R, L, *e)
                 out_cap = self.dc._bucket(
                     int(C.sum(axis=0).max()) if C.size else 1)

@@ -18,12 +18,16 @@ per expert, scaled by E) keeps the router from collapsing.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Tuple
+import functools
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
+
+from ..core import var as _var
 
 
 def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
@@ -109,9 +113,12 @@ def moe_block(h: jax.Array, params: Dict, n_experts: int, top_k: int = 2,
                       params["w_down"].astype(compute_dtype))
     out = jnp.einsum("tec,ecd->td", combine.astype(compute_dtype), eout)
 
-    # load-balance aux (Switch eq. 4): E · Σ_e fraction_e · mean_prob_e
+    # load-balance aux (Switch eq. 4): E · Σ_e fraction_e · mean_prob_e.
+    # fraction_e is the share of ALL T·k dispatched slots — averaging the
+    # one-hot over both the token and slot axes; with top_k == 1 the slot
+    # axis is singleton, so this IS the Switch top-1 form.
     frac = jnp.mean(
-        jax.nn.one_hot(expert_idx[:, 0], n_experts), axis=0)
+        jax.nn.one_hot(expert_idx, n_experts), axis=(0, 1))
     aux = n_experts * jnp.sum(frac * jnp.mean(probs, axis=0))
     return out.reshape(b, s, d), aux
 
@@ -177,3 +184,391 @@ def ragged_ep_combine(dc, outputs, ctx):
     rows = np.arange(R)[:, None]
     order[rows, ctx["orders"]] = np.arange(T, dtype=np.int32)[None, :]
     return dc.row_gather(returned, order)
+
+
+# ---------------------------------------------------------------------------
+# moe_block_ep — the capacity-dropping MoE block as a first-class
+# expert-parallel comm workload on the device-native ragged path
+# ---------------------------------------------------------------------------
+# The einsum moe_block above moves a dense (E, C, d) block per rank
+# whether one token routed or all of them did — wire bytes scale with
+# experts x capacity. This path exchanges exactly the routed tokens:
+# router -> host counts matrix -> DeviceComm.row_gather +
+# alltoallv_from_rows under the audited coll names ``moe_dispatch`` /
+# ``moe_combine``. Three decision arms:
+#
+# * native      — one ragged exchange over the full ep axis
+# * hier        — the counts matrix splits into a same-outer-group lane
+#                 and a cross-DCN lane (parallel/hierarchy axis
+#                 classification composed with the ep axis): token
+#                 payloads cross the slow plane ONLY when the owning
+#                 expert lives across it
+# * hier+quant  — the cross-DCN lane of the COMBINE payload travels on
+#                 the EQuARX int8 block tier; dispatch payloads and the
+#                 same-group lane stay full precision (expert inputs are
+#                 not re-quantizable noise-free, expert outputs mix
+#                 through a float gate anyway)
+#
+# Exactly ONE decision-audit event per collective invocation — same
+# vocabulary as coll/xla._audit (arm pvars, wire bytes, simulated-DCN
+# charge, perf sample, traffic edge attribution with the real
+# per-(src,dst) token bytes as weights, trace.decision with the
+# precedence chain + the a2av slice plan). The routing outcome feeds the
+# ompi_tpu.moe plane (hot-expert sentry -> live capacity/aux
+# adaptation), which closes the observe->act loop: the NEXT step's
+# capacity factor reflects this step's skew verdict.
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "n_experts"))
+def _router_fwd(x, router_w, top_k: int, n_experts: int):
+    """Device-side router math on the canonical (R, t, d) layout — the
+    same formulas as moe_block (incl. the all-slots load-balance aux and
+    the raw-top-1-prob Switch gate), so einsum and ragged arms are
+    loss-comparable."""
+    logits = x.astype(jnp.float32) @ router_w            # (R, t, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, top_k)  # (R, t, k)
+    if top_k > 1:
+        gate_vals = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    frac = jnp.mean(jax.nn.one_hot(expert_idx, n_experts), axis=(0, 1, 2))
+    aux = n_experts * jnp.sum(frac * jnp.mean(probs, axis=(0, 1)))
+    return probs, gate_vals, expert_idx, aux
+
+
+@functools.partial(jax.jit, static_argnames=("epr",))
+def _expert_ffn(xbuf, e_local, wg, wu, wd, epr: int):
+    """Per-local-expert silu-gated FFN over a padded recv buffer.
+
+    xbuf: (R, L, d) — row j holds the tokens routed to rank j's experts;
+    e_local: (R, L) int32 local expert id per slot (-1 = padding, which
+    no mask selects, so pads stay exactly zero); w*: (R, epr, ...) —
+    row j holds rank j's expert shard."""
+    cd = xbuf.dtype
+    out = jnp.zeros_like(xbuf)
+    for le in range(epr):
+        m = (e_local == le)[..., None].astype(cd)
+        xin = xbuf * m
+        g = jax.nn.silu(jnp.einsum("rld,rdf->rlf", xin,
+                                   wg[:, le].astype(cd)))
+        u = jnp.einsum("rld,rdf->rlf", xin, wu[:, le].astype(cd))
+        out = out + jnp.einsum("rlf,rfd->rld", g * u,
+                               wd[:, le].astype(cd)) * m
+    return out
+
+
+@jax.jit
+def _gate_combine(slot_out, gate_vals, keep):
+    """(R, t, k, d) slot outputs x normalized gates x keep mask -> the
+    (R, t, d) expert mixture (dropped slots contribute zero — the
+    residual stream handles them upstream, same as the einsum block)."""
+    w = (gate_vals * keep.astype(jnp.float32))[..., None]
+    return jnp.sum(slot_out.astype(jnp.float32) * w,
+                   axis=2).astype(slot_out.dtype)
+
+
+def _outer_groups(dc) -> np.ndarray:
+    """Per-rank DCN-slab group id over the comm axis (rank coords on
+    every DCN-classified axis of the tuple, row-major — the same flat
+    order the canonical layout shards). All-zero on a pure-ICI comm."""
+    from ..parallel.hierarchy import classify_axes
+    axes = dc.axis if isinstance(dc.axis, tuple) else (dc.axis,)
+    sizes = [int(dc.mesh.shape[a]) for a in axes]
+    kinds = classify_axes(dc.mesh)
+    coords = np.stack(np.unravel_index(np.arange(dc.n), sizes), axis=1)
+    g = np.zeros(dc.n, np.int64)
+    for dim, a in enumerate(axes):
+        if kinds.get(a) == "dcn":
+            g = g * sizes[dim] + coords[:, dim]
+    return g
+
+
+def _decide_moe_coll(dc, coll: str, nbytes: int, dtype,
+                     quant_ok: bool) -> Tuple[str, str, List[str]]:
+    """Decision shim over coll/xla.decide_mode for the moe coll names:
+    per-entry/blanket force vars, DEVICE_RULES rows (plane-keyed rows
+    included), learned source — the full precedence chain — with hier
+    eligibility from the comm's own axis classification."""
+    from ..coll.xla import _load_device_rules, decide_mode
+    from ..parallel.hierarchy import classify_axes, hier_axes
+    inner, outer, why = hier_axes(dc.mesh, dc.axis)
+    hier_ok = inner is not None
+    axes = dc.axis if isinstance(dc.axis, tuple) else (dc.axis,)
+    kinds = classify_axes(dc.mesh)
+    plane = ("dcn" if any(kinds.get(a) == "dcn" for a in axes)
+             else "ici")
+    platform = next(iter(dc.mesh.devices.flat)).platform
+    ent = str(_var.get(f"coll_xla_{coll}_mode", "") or "")
+    eff = ent or str(_var.get("coll_xla_mode", "") or "")
+    if coll == "moe_dispatch" and eff == "hier+quant":
+        # dispatch payloads are never quantized (the var's documented
+        # contract): a forced hier+quant decays to hier instead of
+        # silently flattening — but a per-entry force of an impossible
+        # hier still fails loud, matching decide_mode's discipline
+        if hier_ok:
+            src = f"coll_xla_{coll}_mode" if ent else "coll_xla_mode"
+            return ("hier",
+                    f"force:{src}=hier+quant (dispatch has no "
+                    "quantized lane; took hier)", [])
+        if ent:
+            raise ValueError(
+                f"coll_xla_{coll}_mode forces hier+quant but the comm "
+                f"is ineligible: {why}")
+    return decide_mode(coll, int(nbytes), dc.n, platform,
+                       _load_device_rules(), ("native",),
+                       quant_ok=quant_ok, dtype=dtype, op=None,
+                       plane=plane, hier_ok=hier_ok,
+                       hier_why=why or "")
+
+
+def _audit_moe_coll(dc, coll: str, arm: str, reason: str, chain: List,
+                    wire: int, W: np.ndarray, cross_bytes: int,
+                    nbytes: int, dtype, dur_s: float,
+                    extra: Dict[str, Any]) -> None:
+    """ONE decision-audit record per moe collective — the same fan-out
+    as coll/xla._audit: arm + wire pvars, simulated-DCN charge for the
+    cross-slab lane, an externally-timed perf sample, traffic edge
+    attribution weighted by the REAL per-(src, dst) token bytes (so a
+    hot expert shows up as a hot link), and the trace decision event
+    carrying the precedence chain + the a2av slice plan."""
+    spc = dc.spc
+    if spc is not None:
+        spc.inc(f"coll_arm_{arm}_count")
+        spc.inc("coll_wire_bytes", int(wire))
+    from ..parallel import simdcn
+    if simdcn.us_per_mib() > 0 and cross_bytes > 0:
+        simdcn.charge(int(cross_bytes))
+    from .. import perf, trace, traffic
+    if perf.enabled:
+        perf.note_sample(coll, arm, int(wire), dur_s, dc.n)
+    if traffic.enabled:
+        traffic.note_coll(dc, coll, arm, int(wire), weights=W, hier=None)
+    if trace.enabled:
+        trace.decision(coll, arm=arm, reason=reason, nbytes=int(nbytes),
+                       dtype=str(dtype), ndev=dc.n,
+                       wire_bytes=int(wire), chain=list(chain), **extra)
+
+
+def _route_plan(expert_idx: np.ndarray, n_experts: int, epr: int,
+                capacity: int) -> Dict[str, Any]:
+    """Host routing plan from the (R, t, k) expert assignment: global
+    per-expert capacity enforcement (first come in flat rank-major,
+    token-major order), per-rank send order = stable sort by global
+    expert id (owner-monotone, so sends are dense in destination order
+    — exactly the alltoallv_from_rows layout), counts matrix."""
+    eid = np.asarray(expert_idx)
+    R, t, k = eid.shape
+    flat = eid.reshape(R, t * k)
+    keep = np.ones((R, t * k), bool)
+    conc = flat.reshape(-1)
+    kflat = keep.reshape(-1)
+    for e in range(n_experts):
+        sel = np.flatnonzero(conc == e)
+        if len(sel) > capacity:
+            kflat[sel[capacity:]] = False
+    owner = flat // epr
+    C = np.zeros((R, R), np.int64)
+    send_slots: List[np.ndarray] = []
+    for i in range(R):
+        ks = np.flatnonzero(keep[i])
+        order = ks[np.argsort(flat[i, ks], kind="stable")]
+        send_slots.append(order)
+        C[i] = np.bincount(owner[i, order], minlength=R)
+    loads = np.bincount(conc[kflat], minlength=n_experts)
+    return {"flat": flat, "keep": keep, "owner": owner, "C": C,
+            "send_slots": send_slots, "loads": loads,
+            "routed": int(keep.sum()), "dropped": int((~keep).sum())}
+
+
+def _lane_arrays(plan: Dict[str, Any], sel_fn, k: int, epr: int,
+                 bucket) -> Optional[Dict[str, Any]]:
+    """Per-lane host maps for one ragged exchange: lane counts matrix,
+    send token-index map (row_gather input), the receiver's local-expert
+    map, and the inverse map that puts returned expert outputs back on
+    their original (token, slot) position. ``sel_fn(i, owners)`` masks
+    which of rank i's sends ride this lane. None when the lane is
+    empty this step."""
+    flat, owner = plan["flat"], plan["owner"]
+    R = flat.shape[0]
+    tk = flat.shape[1]
+    sl = []
+    C = np.zeros((R, R), np.int64)
+    for i in range(R):
+        s = plan["send_slots"][i]
+        s = s[sel_fn(i, owner[i, s])]
+        sl.append(s)
+        C[i] = np.bincount(owner[i, s], minlength=R)
+    if int(C.sum()) == 0:
+        return None
+    lmax = max(1, max(len(s) for s in sl))
+    send_idx = np.full((R, lmax), -1, np.int32)
+    inv = np.full((R, tk), -1, np.int32)
+    for i in range(R):
+        send_idx[i, :len(sl[i])] = sl[i] // k
+        inv[i, sl[i]] = np.arange(len(sl[i]), dtype=np.int32)
+    out_cap = bucket(int(C.sum(axis=0).max()))
+    e_local = np.full((R, out_cap), -1, np.int32)
+    fill = np.zeros(R, np.int64)
+    for i in range(R):
+        for j in range(R):
+            seg = sl[i][owner[i, sl[i]] == j]
+            n = len(seg)
+            if n:
+                e_local[j, fill[j]:fill[j] + n] = \
+                    flat[i, seg] - j * epr
+                fill[j] += n
+    return {"C": C, "send_idx": send_idx, "inv": inv,
+            "e_local": e_local}
+
+
+def moe_block_ep(dc, h: jax.Array, params: Dict, n_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 step: Optional[int] = None,
+                 ) -> Tuple[jax.Array, jax.Array, Dict[str, Any]]:
+    """The MoE block on the device-native ragged expert-parallel path.
+
+    h: (R, t, d) canonical device layout over ``dc``'s comm axis (row i
+    = rank i's tokens); params as init_moe_params with n_experts % R ==
+    0 (rank j owns experts [j·epr, (j+1)·epr)). Returns (out (R, t, d)
+    expert mixture, aux load-balance scalar, info dict).
+
+    Same routing discipline as the einsum ``moe_block`` — top-k, global
+    per-expert capacity C = ceil(T·k·cf/E), overflow dropped — but only
+    the ROUTED tokens travel, via row_gather + alltoallv_from_rows under
+    the audited ``moe_dispatch``/``moe_combine`` names. The effective
+    capacity factor reads through ``ompi_tpu.moe.capacity_factor`` (live
+    hot-expert adaptation); the step's per-expert loads feed back via
+    ``moe.note_routing``. Host work is O(T·k) index math per step; all
+    payload movement is cached device programs."""
+    from .. import moe as _moe
+    R, t, d = h.shape
+    if R != dc.n:
+        raise ValueError(f"moe_block_ep: h rows {R} != comm size {dc.n}")
+    if n_experts % R:
+        raise ValueError(f"moe_block_ep: n_experts {n_experts} not "
+                         f"divisible by comm size {R}")
+    epr = n_experts // R
+    cf_eff = _moe.capacity_factor(capacity_factor)
+    probs, gate_vals, expert_idx, aux = _router_fwd(
+        h, params["router"], top_k, n_experts)
+    capacity = max(int(np.ceil(t * R * top_k * cf_eff / n_experts)),
+                   top_k)
+    plan = _route_plan(np.asarray(expert_idx), n_experts, epr, capacity)
+    tok_bytes = d * h.dtype.itemsize
+    g = _outer_groups(dc)
+    offdiag = ~np.eye(R, dtype=bool)
+    cross = g[:, None] != g[None, :]          # rank-pair crosses DCN
+
+    # -- dispatch: route token payloads to their owning expert rank ----
+    arm_d, reason_d, chain_d = _decide_moe_coll(
+        dc, "moe_dispatch",
+        plan["routed"] * tok_bytes // max(R, 1), h.dtype, quant_ok=False)
+    lanes: List[Tuple[str, Dict[str, Any]]] = []
+    if arm_d in ("hier", "hier+quant"):
+        li = _lane_arrays(plan, lambda i, ow: g[ow] == g[i],
+                          top_k, epr, dc._bucket)
+        lo = _lane_arrays(plan, lambda i, ow: g[ow] != g[i],
+                          top_k, epr, dc._bucket)
+        if li is not None:
+            lanes.append(("inner", li))
+        if lo is not None:
+            lanes.append(("outer", lo))
+    else:
+        la = _lane_arrays(plan, lambda i, ow: np.ones(len(ow), bool),
+                          top_k, epr, dc._bucket)
+        if la is not None:
+            lanes.append(("all", la))
+    t0 = time.perf_counter()
+    recvs: List[Tuple[str, Dict[str, Any], Any]] = []
+    for lname, ln in lanes:
+        sendbuf = dc.row_gather(h, ln["send_idx"])
+        recv, _cnt = dc.alltoallv_from_rows(sendbuf, ln["C"])
+        recvs.append((lname, ln, recv))
+    for _, _, r in recvs:
+        jax.block_until_ready(r)
+    dur_d = time.perf_counter() - t0
+    Wd = plan["C"] * tok_bytes
+    wire_d = int(Wd[offdiag].sum())
+    inner_d = int((plan["C"] * tok_bytes)[offdiag & ~cross].sum())
+    outer_d = wire_d - inner_d
+    a2av = dict(dc._last_a2av or {})
+    _audit_moe_coll(
+        dc, "moe_dispatch", arm_d, reason_d, chain_d, wire_d, Wd,
+        outer_d, plan["routed"] * tok_bytes // max(R, 1), h.dtype, dur_d,
+        {"a2av_slice_cap": a2av.get("slice_cap"),
+         "a2av_scan_steps": a2av.get("scan_steps"),
+         "routed_tokens": plan["routed"],
+         "dropped_tokens": plan["dropped"],
+         "moe_inner_bytes": inner_d, "moe_outer_bytes": outer_d})
+
+    # -- expert FFN on each lane's recv buffer -------------------------
+    wg = params["w_gate"].reshape(R, epr, d, -1)
+    wu = params["w_up"].reshape(R, epr, d, -1)
+    wd_ = params["w_down"].reshape(R, epr, -1, d)
+    outs = [(lname, ln,
+             _expert_ffn(recv, dc.from_ranks(list(ln["e_local"])),
+                         wg, wu, wd_, epr))
+            for lname, ln, recv in recvs]
+
+    # -- combine: expert outputs back to their source (token, slot) ----
+    quant_ok = np.issubdtype(np.asarray(h).dtype, np.floating)
+    arm_c, reason_c, chain_c = _decide_moe_coll(
+        dc, "moe_combine",
+        plan["routed"] * tok_bytes // max(R, 1), h.dtype,
+        quant_ok=quant_ok)
+    block = int(_var.get("coll_quant_block", 256))
+    block = block if block and d % block == 0 else d
+    scale_b = 4                                  # f32 scale per block
+    qtok_bytes = d + (d // block) * scale_b
+    t1 = time.perf_counter()
+    slot_sum = None
+    for lname, ln, obuf in outs:
+        if arm_c == "hier+quant" and lname == "outer":
+            from ..coll.quant import dequantize_blocks, quantize_blocks
+            q, scale = quantize_blocks(obuf, block)
+            q_ret, _ = dc.alltoallv_from_rows(q, ln["C"].T)
+            s_ret, _ = dc.alltoallv_from_rows(scale, ln["C"].T)
+            returned = dequantize_blocks(q_ret, s_ret, block,
+                                         dtype=h.dtype)
+        else:
+            returned, _ = dc.alltoallv_from_rows(obuf, ln["C"].T)
+        back = dc.row_gather(returned, ln["inv"])     # (R, t·k, d)
+        slot_sum = back if slot_sum is None else slot_sum + back
+    if slot_sum is None:
+        slot_sum = jnp.zeros((R, t * top_k, d), h.dtype)
+    jax.block_until_ready(slot_sum)
+    dur_c = time.perf_counter() - t1
+    CT = plan["C"].T
+    Wc = CT * tok_bytes
+    if arm_c == "hier+quant":
+        Wc = np.where(cross, CT * qtok_bytes, Wc)
+    wire_c = int(Wc[offdiag].sum())
+    inner_c = int(Wc[offdiag & ~cross].sum())
+    outer_c = wire_c - inner_c
+    a2av = dict(dc._last_a2av or {})
+    _audit_moe_coll(
+        dc, "moe_combine", arm_c, reason_c, chain_c, wire_c, Wc,
+        outer_c, plan["routed"] * tok_bytes // max(R, 1), h.dtype, dur_c,
+        {"a2av_slice_cap": a2av.get("slice_cap"),
+         "a2av_scan_steps": a2av.get("scan_steps"),
+         "routed_tokens": plan["routed"],
+         "dropped_tokens": plan["dropped"],
+         "moe_inner_bytes": inner_c, "moe_outer_bytes": outer_c})
+
+    slot_out = slot_sum.reshape(R, t, top_k, d)
+    keep_dev = dc.from_ranks(list(
+        plan["keep"].reshape(R, t, top_k).astype(np.bool_)))
+    out = _gate_combine(slot_out, gate_vals, keep_dev)
+
+    # -- feed the routing plane: this step's skew is next step's cf ----
+    verdict = _moe.note_routing(plan["loads"], routed=plan["routed"],
+                                dropped=plan["dropped"], step=step)
+    info = {"routed_tokens": plan["routed"],
+            "dropped_tokens": plan["dropped"],
+            "capacity": capacity, "capacity_factor": cf_eff,
+            "expert_load": plan["loads"].tolist(),
+            "dispatch": {"arm": arm_d, "wire_bytes": wire_d,
+                         "inner_bytes": inner_d, "outer_bytes": outer_d},
+            "combine": {"arm": arm_c, "wire_bytes": wire_c,
+                        "inner_bytes": inner_c, "outer_bytes": outer_c},
+            "verdict": verdict}
+    return out, aux, info

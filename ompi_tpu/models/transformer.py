@@ -46,6 +46,19 @@ class Config:
     n_experts: int = 8
     moe_top_k: int = 2
     moe_aux_weight: float = 0.01
+    moe_impl: str = "einsum"         # "einsum" | "ragged" — how MoE
+    #   dispatch/combine moves: "einsum" is the dense (T, E, C) one-hot
+    #   contraction (fully jitted; GSPMD inserts the all-to-alls; wire
+    #   bytes scale with experts × capacity), "ragged" exchanges only
+    #   the routed tokens over the device-native alltoallv path
+    #   (models/moe.moe_block_ep — audited moe_dispatch/moe_combine,
+    #   arms native|hier|hier+quant). The jitted train step always
+    #   differentiates the einsum form (host-orchestrated exchanges
+    #   cannot live under jit); "ragged" selects the EP comm path for
+    #   forward/eval/serving — docs/moe.md
+    moe_capacity_factor: float = 1.25  # per-expert capacity headroom,
+    #   C = ceil(T·k·cf/E); the ragged path reads it through the live
+    #   hot-expert adaptation (ompi_tpu.moe.capacity_factor)
     remat: str = "none"              # "none" | "dots" | "full" — see
     #   make_train_step: "full" recomputes each layer in the backward
     #   (cheapest memory, +~1 forward of FLOPs), "dots" saves matmul
@@ -362,18 +375,9 @@ def _layer_apply_fused(x: jax.Array, layer: Dict, cfg: Config,
     return x + down, jnp.zeros((), jnp.float32)
 
 
-def _layer_apply(x: jax.Array, layer: Dict, cfg: Config,
-                 mesh: Optional[Mesh]) -> Tuple[jax.Array, jax.Array]:
-    """One decoder layer; returns (x, router_aux)."""
-    if cfg.tp_overlap not in ("none", "fused"):
-        raise ValueError(f"unknown tp_overlap {cfg.tp_overlap!r} "
-                         "(expected 'none' or 'fused')")
-    if cfg.tp_overlap == "fused":
-        if mesh is None or "tp" not in mesh.axis_names:
-            raise ValueError(
-                "tp_overlap='fused' needs a mesh with a tp axis "
-                f"(got mesh={'set' if mesh is not None else None})")
-        return _layer_apply_fused(x, layer, cfg, mesh)
+def _attn_apply(x: jax.Array, layer: Dict, cfg: Config,
+                mesh: Optional[Mesh]) -> jax.Array:
+    """Attention half of the decoder layer, residual included."""
     b, s = x.shape[0], x.shape[1]
     positions = jnp.arange(s)
     h = _rms_norm(x, layer["attn_norm"])
@@ -398,12 +402,27 @@ def _layer_apply(x: jax.Array, layer: Dict, cfg: Config,
     else:
         att = attention_reference(q, k, v, causal=True)
     att = att.reshape(b, s, cfg.n_heads * cfg.head_dim)
-    x = x + att @ layer["wo"].astype(cfg.dtype)        # row-parallel → psum
+    return x + att @ layer["wo"].astype(cfg.dtype)     # row-parallel → psum
+
+
+def _layer_apply(x: jax.Array, layer: Dict, cfg: Config,
+                 mesh: Optional[Mesh]) -> Tuple[jax.Array, jax.Array]:
+    """One decoder layer; returns (x, router_aux)."""
+    if cfg.tp_overlap not in ("none", "fused"):
+        raise ValueError(f"unknown tp_overlap {cfg.tp_overlap!r} "
+                         "(expected 'none' or 'fused')")
+    if cfg.tp_overlap == "fused":
+        if mesh is None or "tp" not in mesh.axis_names:
+            raise ValueError(
+                "tp_overlap='fused' needs a mesh with a tp axis "
+                f"(got mesh={'set' if mesh is not None else None})")
+        return _layer_apply_fused(x, layer, cfg, mesh)
+    x = _attn_apply(x, layer, cfg, mesh)
     h = _rms_norm(x, layer["mlp_norm"])
     if "moe" in layer:
         from .moe import moe_block
         mlp_out, aux = moe_block(h, layer["moe"], cfg.n_experts,
-                                 cfg.moe_top_k)
+                                 cfg.moe_top_k, cfg.moe_capacity_factor)
         return x + mlp_out, aux
     gate = jax.nn.silu(h @ layer["w_gate"].astype(cfg.dtype))
     up = h @ layer["w_up"].astype(cfg.dtype)
@@ -503,7 +522,69 @@ def loss_fn(params: Dict, tokens: jax.Array, cfg: Config,
     # scale that second tensor alone is GBs of HBM
     lse = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(lse - gold) + cfg.moe_aux_weight * aux
+    if cfg.mlp == "moe":
+        # the aux weight reads through the MoE plane's live adaptation
+        # (identity while the plane is off). Inside jit this binds at
+        # trace time; the ragged eval path below re-reads every call
+        from .. import moe as _moe
+        return jnp.mean(lse - gold) + _moe.aux_weight(
+            cfg.moe_aux_weight) * aux
+    return jnp.mean(lse - gold)
+
+
+# -- ragged expert-parallel forward (Config(moe_impl="ragged")) -------------
+
+def moe_forward_ep(dc, params: Dict, tokens: jax.Array, cfg: Config,
+                   step: Optional[int] = None,
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """Forward pass with every MoE layer on the device-native ragged EP
+    path (models/moe.moe_block_ep): token payloads travel the audited
+    ``moe_dispatch``/``moe_combine`` exchanges over ``dc``'s comm axis
+    instead of the dense einsum block. Host-orchestrated — the per-layer
+    pieces (attention, router, expert FFN, gate-combine) are jitted, the
+    exchanges are cached device programs — so this is the forward /
+    eval / serving arm; the jitted train step differentiates the einsum
+    form. Returns (logits, router_aux)."""
+    if cfg.mlp != "moe":
+        raise ValueError("moe_forward_ep needs cfg.mlp='moe' "
+                         f"(got {cfg.mlp!r})")
+    from .moe import moe_block_ep
+    x = params["embed"].astype(cfg.dtype)[tokens]      # (b, s, d)
+    b, s, d = x.shape
+    R = dc.n
+    if (b * s) % R:
+        raise ValueError(
+            f"moe_forward_ep: batch·seq {b * s} not divisible by the "
+            f"comm size {R}")
+    t = (b * s) // R
+    aux_total = jnp.zeros((), jnp.float32)
+    for layer in params["layers"]:
+        x = _attn_apply(x, layer, cfg, None)
+        h = _rms_norm(x, layer["mlp_norm"])
+        hc = jax.device_put(jnp.reshape(h, (R, t, d)), dc.sharding())
+        out, aux, _info = moe_block_ep(
+            dc, hc, layer["moe"], cfg.n_experts, cfg.moe_top_k,
+            cfg.moe_capacity_factor, step=step)
+        x = x + jnp.asarray(np.asarray(out)).reshape(b, s, d)
+        aux_total = aux_total + aux
+    x = _rms_norm(x, params["final_norm"])
+    logits = (x @ params["embed"].astype(cfg.dtype).T).astype(jnp.float32)
+    return logits, aux_total
+
+
+def moe_eval_loss(dc, params: Dict, tokens: jax.Array, cfg: Config,
+                  step: Optional[int] = None) -> jax.Array:
+    """loss_fn's ragged-arm counterpart: same logsumexp-form CE + aux
+    term, with the MoE layers on moe_forward_ep and the aux weight read
+    live through the MoE plane each call."""
+    from .. import moe as _moe
+    targets = tokens[:, 1:]
+    logits, aux = moe_forward_ep(dc, params, tokens[:, :-1], cfg,
+                                 step=step)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - gold) + _moe.aux_weight(
+        cfg.moe_aux_weight) * aux
 
 
 # -- training ---------------------------------------------------------------
@@ -569,6 +650,9 @@ def make_train_step(cfg: Config, mesh: Optional[Mesh] = None,
     if cfg.grad_sync not in _MODES:
         raise ValueError(f"unknown grad_sync {cfg.grad_sync!r} "
                          f"(expected one of {_MODES})")
+    if cfg.mlp == "moe" and cfg.moe_impl not in ("einsum", "ragged"):
+        raise ValueError(f"unknown moe_impl {cfg.moe_impl!r} "
+                         "(expected 'einsum' or 'ragged')")
     if cfg.tp_overlap == "fused" and cfg.grad_sync != "native":
         # the explicit grad-sync schedulers shard_map over dp with
         # mesh=None inside — the fused layer cannot run there

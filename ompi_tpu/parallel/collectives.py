@@ -37,7 +37,17 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import jaxcompat as _compat, trace
+from ..core import var as _var
 from ..op import MAX, MIN, SUM, Op
+
+_var.register(
+    "coll", "a2av", "slice_cap", 0, type=int, level=4,
+    help="Capacity-slice size (elements) for the sliced-scan ragged "
+         "alltoallv_from_rows exchange; bounds the per-step transient to "
+         "O(R x slice_cap x elem) per device. 0 = auto (~1M elements per "
+         "device row). The chosen value and the resulting scan-step count "
+         "k are recorded in the decision audit of every collective that "
+         "rides this path (alltoallv, moe_dispatch, moe_combine).")
 
 # ---------------------------------------------------------------------------
 # named-axis primitives (for use inside shard_map) — thin, explicit wrappers
@@ -219,6 +229,7 @@ class DeviceComm:
         self._spec = P(axis)
         self.spc = None          # optional SPC counters
         self._quant = None       # lazy QuantDeviceComm (coll/quant)
+        self._last_a2av = None   # last a2av_plan taken (audit breadcrumb)
 
     def _idx_cached(self, key: tuple, build: Callable) -> Any:
         hit = self._idx_cache.get(key)
@@ -1076,6 +1087,33 @@ class DeviceComm:
                 pos += c
         return out
 
+    def a2av_plan(self, shape: tuple, counts,
+                  slice_cap: Optional[int] = None) -> Dict[str, int]:
+        """The (slice_cap, scan_steps, out_cap) figures the sliced ragged
+        exchange takes for a (R, L, *e) send of ``shape`` + counts matrix
+        — pure shape math, no dispatch. An explicit ``slice_cap`` wins;
+        else the ``coll_a2av_slice_cap`` var; else the ~1M-element
+        transient heuristic. Decision audits record these figures so the
+        footprint/padding trade is visible per collective."""
+        C = np.asarray(counts, dtype=np.int64)
+        R = shape[0]
+        cap = self._bucket(int(C.max()) if C.size else 1)
+        out_cap = self._bucket(int(C.sum(axis=0).max()) if C.size else 1)
+        elem = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        if slice_cap is None:
+            cfgd = int(_var.get("coll_a2av_slice_cap", 0) or 0)
+            if cfgd > 0:
+                slice_cap = min(cap, cfgd)
+            else:
+                # bound the per-step transient (the (R, S, *e) gather) to
+                # ~1M ELEMENTS per device row — trailing elem dims count
+                slice_cap = min(cap, max(64, self._bucket(
+                    max(1, (1 << 20) // max(R * elem, 1)))))
+        slice_cap = max(1, int(slice_cap))
+        return {"slice_cap": int(slice_cap),
+                "scan_steps": int(-(-cap // slice_cap)),
+                "out_cap": int(out_cap)}
+
     def alltoallv_from_rows(self, x: jax.Array, counts,
                             slice_cap: Optional[int] = None
                             ) -> Tuple[jax.Array, list]:
@@ -1102,16 +1140,13 @@ class DeviceComm:
         R = x.shape[0]
         r = R // self.n
         L = x.shape[1]
-        cap = self._bucket(int(C.max()) if C.size else 1)
-        out_cap = self._bucket(int(C.sum(axis=0).max()) if C.size else 1)
-        elem = int(np.prod(x.shape[2:])) if x.ndim > 2 else 1
-        if slice_cap is None:
-            # bound the per-step transient (the (R, S, *e) gather) to
-            # ~1M ELEMENTS per device row — trailing elem dims count
-            slice_cap = min(cap, max(64, self._bucket(
-                max(1, (1 << 20) // max(R * elem, 1)))))
-        slice_cap = max(1, int(slice_cap))
-        k = -(-cap // slice_cap)               # ceil: scan steps
+        plan = self.a2av_plan(x.shape, C, slice_cap)
+        slice_cap = plan["slice_cap"]
+        k = plan["scan_steps"]
+        out_cap = plan["out_cap"]
+        # stash the footprint/padding trade this call actually took so the
+        # caller's decision audit can record it
+        self._last_a2av = dict(plan)
         # k is BAKED into the compiled scan: it must be in the cache key
         # (bucketed cap keeps nearby routings sharing one executable;
         # without k in the key a smaller-cap executable would be reused

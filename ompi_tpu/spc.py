@@ -93,6 +93,14 @@ COUNTERS = [
     ("numerics_snr_db", "most recent sampled quantization SNR, dB"),
     ("numerics_divergence_trips",
      "cross-replica divergence audits that found replicas disagreeing"),
+    # MoE routing plane (fed by ompi_tpu/moe; process-wide)
+    ("moe_routed_tokens",
+     "tokens dispatched to experts by the MoE routing plane"),
+    ("moe_dropped_tokens",
+     "tokens dropped at expert capacity by the MoE routing plane"),
+    ("moe_hot_expert_trips",
+     "hot-expert sentry trips (one expert carrying disproportionate "
+     "token load)"),
     # elastic recovery plane (fed by ompi_tpu/ft/elastic; process-wide)
     ("ft_recoveries",
      "completed elastic recoveries (trip -> shrink -> reshard -> resume)"),
@@ -157,6 +165,10 @@ class Counters:
             from .ft import elastic
             if name in elastic.PVARS:
                 return elastic.pvar_value(name)
+        if name.startswith("moe_"):
+            from . import moe
+            if name in moe.PVARS:
+                return moe.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -180,6 +192,9 @@ class Counters:
         from .ft import elastic
         for name in elastic.PVARS:
             out[name] = elastic.pvar_value(name)
+        from . import moe
+        for name in moe.PVARS:
+            out[name] = moe.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

@@ -35,8 +35,9 @@ from ..trace import merge as _merge
 # detect drift (ISSUE 7 satellite; 4 = the numerics plane section,
 # ISSUE 9; 5 = the reshard plan-cache/last-plan section, ISSUE 10;
 # 6 = the static-verifier section, ISSUE 11;
-# 7 = the ft/elastic recovery section, ISSUE 13)
-SCHEMA_VERSION = 7
+# 7 = the ft/elastic recovery section, ISSUE 13;
+# 8 = the MoE routing-plane section, ISSUE 14)
+SCHEMA_VERSION = 8
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -525,6 +526,57 @@ def build_ft_report(
     return "\n".join(lines), rep
 
 
+def build_moe_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the MoE routing plane: routed/
+    dropped token counters, per-expert load table, live capacity/aux
+    scaling, hot-expert verdicts and the adaptation timeline.  ``path``
+    loads a banked MOE json (bench.py --moe); default reads the live
+    in-process plane."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from .. import moe as _moe
+        rep = _moe.report()
+    lines: List[str] = []
+    w = lines.append
+    src = f" (from {path})" if path else ""
+    routed = int(rep.get("routed_tokens", 0))
+    dropped = int(rep.get("dropped_tokens", 0))
+    w(f"moe routing: {int(rep.get('steps', 0))} step(s), "
+      f"{routed} token(s) routed, {dropped} dropped "
+      f"({100.0 * float(rep.get('drop_rate', 0.0)):.2f}%){src}")
+    loads = rep.get("expert_load") or {}
+    if loads:
+        total = max(sum(int(v) for v in loads.values()), 1)
+        w("  per-expert load (share of routed tokens):")
+        for e in sorted(loads, key=lambda k: int(k)):
+            v = int(loads[e])
+            bar = "#" * max(1, round(40 * v / total)) if v else ""
+            w(f"    e{int(e):<3d} {v:>10d}  {bar}")
+    w(f"  live scaling: capacity x{float(rep.get('cf_scale', 1.0)):g}, "
+      f"aux weight x{float(rep.get('aux_scale', 1.0)):g}")
+    trips = int(rep.get("hot_expert_trips", 0))
+    hot = rep.get("hot_now") or []
+    w(f"  hot-expert sentry: {trips} trip(s)"
+      + (f", currently hot: {hot}" if hot else ""))
+    for v in (rep.get("verdicts") or [])[-6:]:
+        w(f"    step {v.get('step')}: expert {v.get('expert')} carried "
+          f"{v.get('tokens')} token(s) vs median {v.get('median_tokens')} "
+          f"({float(v.get('ratio', 0.0)):.1f}x)")
+    adapts = rep.get("adaptations") or []
+    if not adapts:
+        w("  no capacity adaptations (skew never cleared the cooldown)")
+    for a in adapts[-6:]:
+        w(f"  adaptation @ step {a.get('step')}: "
+          f"cf_scale -> x{float(a.get('cf_scale', 1.0)):g}, "
+          f"aux -> x{float(a.get('aux_scale', 1.0)):g}  "
+          f"[{a.get('reason')}]")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -602,6 +654,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "refreshes. With a path, loads a banked "
                          "ELASTIC json (bench.py --elastic); bare "
                          "flag reads the live in-process plane")
+    ap.add_argument("--moe", nargs="?", const="", default=None,
+                    metavar="MOE.json",
+                    help="render the MoE routing-plane section: routing "
+                         "table, per-expert load, hot-expert verdicts, "
+                         "capacity/aux adaptation timeline. With a "
+                         "path, loads a banked MOE json (bench.py "
+                         "--moe); bare flag reads the live in-process "
+                         "plane")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -639,8 +699,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not ns.dumps:
         if (ns.perf or ns.traffic is not None or ns.numerics is not None
                 or ns.reshard is not None or ns.analyze is not None
-                or ns.ft is not None):
-            # perf/traffic/numerics/reshard/analyze/ft section standalone
+                or ns.ft is not None or ns.moe is not None):
+            # plane sections render standalone (no merged timeline)
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
               "nothing to diagnose")
@@ -685,6 +745,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         ftext, fdata = build_ft_report(ns.ft or None)
         text = (text + "\n" + ftext) if text else ftext
         data["ft"] = fdata
+    if getattr(ns, "moe", None) is not None:
+        mtext, mdata = build_moe_report(ns.moe or None)
+        text = (text + "\n" + mtext) if text else mtext
+        data["moe"] = mdata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

@@ -75,6 +75,10 @@ _RING_COLLS = frozenset({
 _A2A_COLLS = frozenset({
     "alltoall", "alltoallv", "alltoallw",
     "neighbor_alltoall", "neighbor_alltoallv", "neighbor_alltoallw",
+    # MoE token dispatch/combine ride the same ragged a2a geometry; the
+    # router's counts matrix arrives as the audit's weights, so edges
+    # carry the real per-(src, dst) token bytes, not a uniform fill
+    "moe_dispatch", "moe_combine",
 })
 
 

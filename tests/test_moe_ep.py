@@ -1,0 +1,430 @@
+"""Token-proportional MoE on the device-native ragged path (PR 14).
+
+Covers the dropless helpers' round-trip against the host oracle under
+skewed ownership, the einsum block's top-k load-balance fraction, the
+``coll_a2av_slice_cap`` plan var, moe_block_ep parity/audit/conservation
+on native and hier(+quant) arms, and the hot-expert sentry → capacity
+adaptation loop (ompi_tpu/moe plane).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from ompi_tpu import moe as moe_plane  # noqa: E402
+from ompi_tpu import spc, trace, traffic  # noqa: E402
+from ompi_tpu.core import var  # noqa: E402
+from ompi_tpu.models import moe as moe_mod  # noqa: E402
+from ompi_tpu.models import transformer as tfm  # noqa: E402
+from ompi_tpu.parallel import DeviceComm, make_mesh  # noqa: E402
+
+pytestmark = pytest.mark.moe
+
+
+def _dc(n=8, axes=None, sim_dcn=None):
+    """Mesh + comm over the first n host devices; ``sim_dcn`` names the
+    axis to re-classify as DCN (must be set BEFORE the mesh exists)."""
+    if sim_dcn:
+        var.registry.set_cli("topo_sim_dcn_axes", sim_dcn)
+    if axes is None:
+        axes = {"x": n}
+        comm_axes = "x"
+    else:
+        comm_axes = tuple(axes.keys())
+    mesh = make_mesh(axes, devices=jax.devices()[:n])
+    return DeviceComm(mesh, comm_axes)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test leaves the planes and CLI vars as it found them."""
+    yield
+    for name in ("topo_sim_dcn_axes", "coll_a2av_slice_cap",
+                 "coll_xla_moe_dispatch_mode",
+                 "coll_xla_moe_combine_mode",
+                 "moe_sentry_min_tokens", "moe_adapt_cooldown"):
+        var.registry.clear_cli(name)
+    moe_plane.reset()
+    moe_plane.disable()
+    traffic.reset()
+    traffic.disable()
+    trace.clear()
+    trace.disable()
+
+
+def _skewed_owner(rng, R, T):
+    """Ownership with rank R-1 receiving ZERO tokens and rank 0 more
+    than 2x the mean — the satellite's required shape."""
+    owner = rng.integers(0, max(R - 1, 1), size=(R, T))
+    owner[:, : max(1, (2 * T) // max(R, 2)) + 1] = 0
+    counts = np.bincount(owner.ravel(), minlength=R)
+    assert counts[R - 1] == 0
+    # one rank owns 0, one owns >2x the mean (== at R=2, where 2x mean
+    # with a zero rank is the maximum possible)
+    assert counts[0] * R >= 2 * owner.size
+    assert R == 2 or counts[0] * R > 2 * owner.size
+    return owner
+
+
+class TestRaggedRoundtripOracle:
+    """Satellite 3: ragged_ep_route → ragged_ep_combine bitwise
+    round-trip on 2/4/8-device meshes under skewed owners, receive side
+    cross-checked against the compact_from_rows host oracle."""
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_skewed_roundtrip_bitwise_vs_oracle(self, n):
+        dc = _dc(n)
+        R, T, d = n, 24, 6
+        rng = np.random.default_rng(7 + n)
+        owner = _skewed_owner(rng, R, T)
+        tokens_h = rng.normal(size=(R, T, d)).astype(np.float32)
+        tokens = dc.from_ranks(list(tokens_h))
+
+        recv, recv_counts, ctx = moe_mod.ragged_ep_route(dc, tokens, owner)
+        # oracle: same stable sort on the host, then the direct O(total)
+        # segment-copy reference implementation of the exchange
+        orders = np.argsort(owner, axis=1, kind="stable")
+        sorted_h = np.take_along_axis(tokens_h, orders[..., None], axis=1)
+        oracle = DeviceComm.compact_from_rows(
+            sorted_h, ctx["C"], recv.shape[1])
+        got = np.asarray(jax.device_get(recv))
+        for j in range(R):
+            c = recv_counts[j]
+            # bitwise: the routed payload is moved, never recomputed
+            assert np.array_equal(got[j, :c], oracle[j, :c]), f"row {j}"
+        assert recv_counts == [int(v) for v in
+                               np.bincount(owner.ravel(), minlength=R)]
+
+        back = moe_mod.ragged_ep_combine(dc, recv, ctx)
+        assert np.array_equal(np.asarray(jax.device_get(back)), tokens_h)
+
+
+class TestEinsumFracFix:
+    """Satellite 1: the load-balance fraction counts ALL T·k dispatched
+    slots, not just the top-1 choice."""
+
+    def test_topk_equals_experts_gives_uniform_frac(self):
+        # With top_k == n_experts == 2 every token dispatches to BOTH
+        # experts, so frac must be exactly [0.5, 0.5] and the aux loss
+        # E·Σ frac·mean_prob collapses to mean_prob0 + mean_prob1 == 1,
+        # no matter how skewed the router is. The pre-fix top-1 fraction
+        # gave frac ≈ [1, 0] here (aux > 1).
+        rng = jax.random.PRNGKey(0)
+        params = moe_mod.init_moe_params(rng, d_model=8, d_ff=16,
+                                         n_experts=2)
+        # skew the router hard toward expert 0
+        params["router"] = params["router"].at[:, 0].add(10.0)
+        h = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8),
+                              jnp.float32)
+        _out, aux = moe_mod.moe_block(h, params, n_experts=2, top_k=2,
+                                      capacity_factor=8.0)
+        assert abs(float(aux) - 1.0) < 1e-5
+
+
+class TestA2avSliceCapVar:
+    """Satellite 2: coll_a2av_slice_cap steers the sliced exchange and
+    the taken plan lands in the audit breadcrumb."""
+
+    def test_var_sets_plan_and_breadcrumb(self):
+        dc = _dc(8)
+        R, L, d = 8, 32, 4
+        rng = np.random.default_rng(3)
+        C = rng.integers(0, 5, size=(R, R))
+        x_h = rng.normal(size=(R, L, d)).astype(np.float32)
+
+        base = dc.a2av_plan((R, L, d), C)
+        var.registry.set_cli("coll_a2av_slice_cap", "2")
+        plan = dc.a2av_plan((R, L, d), C)
+        assert plan["slice_cap"] == 2
+        assert plan["scan_steps"] == -(-dc._bucket(int(C.max())) // 2)
+        assert plan["scan_steps"] >= base["scan_steps"]
+        assert plan["out_cap"] == base["out_cap"]
+
+        # the sliced exchange takes the configured plan, records it, and
+        # still matches the host oracle
+        dense = np.zeros((R, L, d), np.float32)
+        for i in range(R):
+            dense[i, : C[i].sum()] = x_h[i, : C[i].sum()]
+        out, cnt = dc.alltoallv_from_rows(dc.from_ranks(list(dense)), C)
+        assert dc._last_a2av["slice_cap"] == 2
+        assert dc._last_a2av["scan_steps"] == plan["scan_steps"]
+        oracle = DeviceComm.compact_from_rows(dense, C, out.shape[1])
+        got = np.asarray(jax.device_get(out))
+        for j in range(R):
+            assert np.array_equal(got[j, : cnt[j]], oracle[j, : cnt[j]])
+
+    def test_explicit_arg_wins_over_var(self):
+        dc = _dc(8)
+        C = np.full((8, 8), 3)
+        var.registry.set_cli("coll_a2av_slice_cap", "2")
+        plan = dc.a2av_plan((8, 32, 4), C, slice_cap=4)
+        assert plan["slice_cap"] == 4
+
+
+def _ep_setup(dc, R=8, t=16, d=32, E=8, seed=0):
+    rng = jax.random.PRNGKey(seed)
+    params = moe_mod.init_moe_params(rng, d_model=d, d_ff=2 * d,
+                                     n_experts=E)
+    h_h = np.asarray(jax.random.normal(jax.random.PRNGKey(seed + 1),
+                                       (R, t, d), jnp.float32))
+    h = dc.from_ranks(list(h_h))
+    return params, h_h, h
+
+
+class TestMoeBlockEP:
+    def test_native_parity_audit_and_conservation(self):
+        dc = _dc(8)
+        dc.spc = spc.Counters()
+        traffic.enable()
+        traffic.reset()
+        trace.enable()
+        trace.clear()
+        R, t, d, E, k = 8, 16, 32, 8, 2
+        params, h_h, h = _ep_setup(dc, R, t, d, E)
+        # cf high enough that nothing drops → exact routing parity with
+        # the einsum block on the same global token set
+        out, aux, info = moe_mod.moe_block_ep(dc, h, params, E, top_k=k,
+                                              capacity_factor=8.0)
+        ref, ref_aux = moe_mod.moe_block(
+            jnp.asarray(h_h.reshape(1, R * t, d)), params, E, top_k=k,
+            capacity_factor=8.0)
+        got = np.asarray(jax.device_get(out)).reshape(1, R * t, d)
+        np.testing.assert_allclose(got, np.asarray(jax.device_get(ref)),
+                                   atol=2e-5)
+        assert abs(float(aux) - float(ref_aux)) < 1e-5
+        assert info["dropped_tokens"] == 0
+        assert info["routed_tokens"] == R * t * k
+
+        # exactly ONE decision event per collective invocation
+        evs = [e for e in trace.events()
+               if e.get("name") in ("decide:moe_dispatch",
+                                    "decide:moe_combine")]
+        assert sorted(e["name"] for e in evs) == [
+            "decide:moe_combine", "decide:moe_dispatch"]
+        exp = trace.explain_last("moe_dispatch")
+        assert exp["arm"] == info["dispatch"]["arm"]
+        assert exp["routed_tokens"] == R * t * k
+        assert exp["a2av_slice_cap"] is not None
+
+        # byte-for-byte conservation: audited wire == traffic edge sum,
+        # nothing unattributed
+        wire = info["dispatch"]["wire_bytes"] + info["combine"]["wire_bytes"]
+        assert dc.spc.get("coll_wire_bytes") == wire
+        edge_sum = sum(r["bytes"] for r in traffic.matrix.rows())
+        assert edge_sum == wire
+        assert traffic.matrix.unattributed_bytes == 0
+
+        # acceptance ratio: ragged wire ≤ routed/(E·C) of the einsum
+        # arm's dense-block bytes (2·E·C·d per rank, each direction)
+        cap = info["capacity"]
+        dense_bytes = 2 * E * cap * d * 4 * R
+        bound = info["routed_tokens"] / (E * cap) * dense_bytes
+        assert wire <= bound
+
+    def test_wire_proportionality_at_issue_operating_point(self):
+        # the acceptance criterion's exact operating point: top_k=2,
+        # capacity_factor=1.25 on the 8-device mesh
+        dc = _dc(8)
+        dc.spc = spc.Counters()
+        R, t, d, E, k = 8, 16, 32, 8, 2
+        params, _h_h, h = _ep_setup(dc, R, t, d, E, seed=5)
+        _out, _aux, info = moe_mod.moe_block_ep(
+            dc, h, params, E, top_k=k, capacity_factor=1.25)
+        wire = (info["dispatch"]["wire_bytes"]
+                + info["combine"]["wire_bytes"])
+        assert wire == dc.spc.get("coll_wire_bytes")
+        cap = info["capacity"]
+        dense_bytes = 2 * E * cap * d * 4 * R
+        assert wire <= info["routed_tokens"] / (E * cap) * dense_bytes
+
+    def test_hier_arms_split_planes_and_conserve(self):
+        # "epo" re-classified as DCN: the counts matrix splits into a
+        # same-slab lane and a cross-slab lane; token payloads cross the
+        # slow plane only when the owning expert does
+        dc = _dc(8, axes={"epo": 2, "epi": 4}, sim_dcn="epo")
+        dc.spc = spc.Counters()
+        traffic.enable()
+        traffic.reset()
+        trace.enable()
+        trace.clear()
+        R, t, d, E, k = 8, 16, 32, 8, 2
+        params, h_h, h = _ep_setup(dc, R, t, d, E, seed=2)
+        var.registry.set_cli("coll_xla_moe_dispatch_mode", "hier")
+        var.registry.set_cli("coll_xla_moe_combine_mode", "hier")
+        out, _aux, info = moe_mod.moe_block_ep(dc, h, params, E, top_k=k,
+                                               capacity_factor=8.0)
+        assert info["dispatch"]["arm"] == "hier"
+        assert info["combine"]["arm"] == "hier"
+        # lane split is exact bookkeeping, not an estimate
+        for leg in ("dispatch", "combine"):
+            assert (info[leg]["inner_bytes"] + info[leg]["outer_bytes"]
+                    == info[leg]["wire_bytes"])
+        wire = (info["dispatch"]["wire_bytes"]
+                + info["combine"]["wire_bytes"])
+        assert dc.spc.get("coll_wire_bytes") == wire
+        assert sum(r["bytes"] for r in traffic.matrix.rows()) == wire
+        totals = traffic.matrix.plane_totals()
+        assert totals.get("dcn", 0) == (info["dispatch"]["outer_bytes"]
+                                        + info["combine"]["outer_bytes"])
+
+        # hier parity: lane split must not change the math at all
+        ref, _ = moe_mod.moe_block(
+            jnp.asarray(h_h.reshape(1, R * t, d)), params, E, top_k=k,
+            capacity_factor=8.0)
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(out)).reshape(1, R * t, d),
+            np.asarray(jax.device_get(ref)), atol=2e-5)
+
+    def test_hier_quant_shrinks_outer_combine_only(self):
+        dc = _dc(8, axes={"epo": 2, "epi": 4}, sim_dcn="epo")
+        dc.spc = spc.Counters()
+        R, t, d, E, k = 8, 16, 32, 8, 2
+        params, h_h, h = _ep_setup(dc, R, t, d, E, seed=2)
+        var.registry.set_cli("coll_xla_moe_dispatch_mode", "hier")
+        var.registry.set_cli("coll_xla_moe_combine_mode", "hier")
+        _o, _a, plain = moe_mod.moe_block_ep(dc, h, params, E, top_k=k,
+                                             capacity_factor=8.0)
+        # the quantized lane: dispatch DECAYS to hier (expert inputs have
+        # no int8 lane), only the combine's cross-DCN payload shrinks
+        var.registry.set_cli("coll_xla_moe_dispatch_mode", "hier+quant")
+        var.registry.set_cli("coll_xla_moe_combine_mode", "hier+quant")
+        out, _aux, info = moe_mod.moe_block_ep(dc, h, params, E, top_k=k,
+                                               capacity_factor=8.0)
+        assert info["dispatch"]["arm"] == "hier"
+        assert info["combine"]["arm"] == "hier+quant"
+        assert (info["dispatch"]["wire_bytes"]
+                == plain["dispatch"]["wire_bytes"])
+        assert (info["combine"]["outer_bytes"]
+                < plain["combine"]["outer_bytes"])
+        assert (info["combine"]["inner_bytes"]
+                == plain["combine"]["inner_bytes"])
+        # int8 outputs mix through the float gate: tolerance, not bitwise
+        ref, _ = moe_mod.moe_block(
+            jnp.asarray(h_h.reshape(1, R * t, d)), params, E, top_k=k,
+            capacity_factor=8.0)
+        diff = np.abs(np.asarray(jax.device_get(out)).reshape(-1)
+                      - np.asarray(jax.device_get(ref)).reshape(-1))
+        assert float(diff.max()) < 0.05
+
+
+class TestHotExpertLoop:
+    """The observe→act loop: hot-expert skew trips the sentry, ONE
+    audited adaptation per verdict grows capacity and the aux weight."""
+
+    def _skew(self, E=8, hot=3, base=20, hot_load=500):
+        loads = [base] * E
+        loads[hot] = hot_load
+        return loads
+
+    def test_sentry_trip_adaptation_and_pvars(self):
+        moe_plane.enable()
+        moe_plane.reset()
+        trace.enable()
+        trace.clear()
+        var.registry.set_cli("moe_adapt_cooldown", "4")
+        c = spc.Counters()
+
+        uniform = [100] * 8
+        for s in range(3):
+            assert moe_plane.note_routing(uniform, step=s) is None
+        v = moe_plane.note_routing(self._skew(), step=3)
+        assert v is not None and v["kind"] == "hot_expert"
+        assert v["expert"] == 3
+        # episode hysteresis: the SAME hot expert does not re-trip
+        assert moe_plane.note_routing(self._skew(), step=4) is None
+        assert moe_plane.sentry.trips() == 1
+
+        # one adaptation, audited once
+        assert moe_plane.capacity_factor(1.25) == pytest.approx(1.5625)
+        assert moe_plane.aux_weight(0.01) == pytest.approx(0.02)
+        adel = [e for e in trace.events()
+                if e.get("name") == "decide:moe_adapt"]
+        assert len(adel) == 1
+        assert "sentry:moe_hot_expert" in adel[0]["args"]["reason"]
+
+        # re-arm (cool down), then a second trip inside the cooldown
+        # window adapts NOTHING further
+        moe_plane.note_routing(uniform, step=5)
+        v2 = moe_plane.note_routing(self._skew(hot=5), step=6)
+        assert v2 is not None
+        assert moe_plane.sentry.trips() == 2
+        assert len(moe_plane.adaptations()) == 1
+        assert c.get("moe_hot_expert_trips") == 2
+
+        # pvar read-through + snapshot: 4 uniform steps of 800 tokens
+        # plus 3 skewed steps of 7*20 + 500 = 640
+        routed = 4 * 800 + 3 * 640
+        assert c.get("moe_routed_tokens") == routed
+        snap = c.snapshot()
+        for name in moe_plane.PVARS:
+            assert name in snap
+
+    def test_disabled_plane_is_identity(self):
+        assert moe_plane.capacity_factor(1.25) == 1.25
+        assert moe_plane.aux_weight(0.01) == 0.01
+        assert moe_plane.note_routing([1000, 1], step=0) is None
+
+    def test_capacity_factor_capped(self):
+        moe_plane.enable()
+        moe_plane.reset()
+        var.registry.set_cli("moe_adapt_cooldown", "1")
+        for s in range(12):
+            moe_plane.note_routing([20] * 7 + [900], step=2 * s)
+            moe_plane.note_routing([100] * 8, step=2 * s + 1)
+        assert moe_plane.capacity_factor(2.0) <= 4.0
+
+
+class TestRaggedForward:
+    def test_eval_loss_parity_vs_einsum(self):
+        dc = _dc(8)
+        cfg = tfm.Config(vocab=64, d_model=32, n_layers=1, n_heads=2,
+                         head_dim=16, d_ff=64, seq=17, dtype=jnp.float32,
+                         mlp="moe", n_experts=8, moe_impl="ragged",
+                         moe_capacity_factor=8.0)
+        params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, cfg.seq),
+                                    0, cfg.vocab)
+        ragged = float(tfm.moe_eval_loss(dc, params, tokens, cfg))
+        einsum = float(tfm.loss_fn(params, tokens, cfg))
+        assert abs(ragged - einsum) < 5e-4, (ragged, einsum)
+
+    def test_unknown_moe_impl_rejected(self):
+        cfg = tfm.Config(mlp="moe", moe_impl="bogus")
+        with pytest.raises(ValueError, match="moe_impl"):
+            tfm.make_train_step(cfg)
+
+
+class TestDoctorMoe:
+    def test_moe_report_live_and_banked(self, tmp_path, capsys):
+        import json
+
+        from ompi_tpu.tools import comm_doctor
+
+        assert comm_doctor.SCHEMA_VERSION == 8
+        moe_plane.enable()
+        moe_plane.reset()
+        var.registry.set_cli("moe_adapt_cooldown", "1")
+        moe_plane.note_routing([100] * 8, step=0)
+        moe_plane.note_routing([20] * 7 + [900], dropped=12, step=1)
+        text, rep = comm_doctor.build_moe_report()
+        assert rep["hot_expert_trips"] == 1
+        assert len(rep["adaptations"]) == 1
+        assert "hot-expert sentry: 1 trip(s)" in text
+        assert "adaptation @ step 1" in text
+
+        # banked form round-trips through the loader, and the --moe
+        # --json mode stamps the bumped schema
+        banked = tmp_path / "MOE_cpu.json"
+        banked.write_text(json.dumps({"report": rep}))
+        _t2, rep2 = comm_doctor.build_moe_report(str(banked))
+        assert rep2["routed_tokens"] == rep["routed_tokens"]
+        rc = comm_doctor.main(["--moe", str(banked), "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["schema_version"] == comm_doctor.SCHEMA_VERSION
+        assert out["moe"]["hot_expert_trips"] == 1
