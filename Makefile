@@ -7,7 +7,8 @@ SHELL := /bin/bash
 
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
-	reshard-tests analysis-tests ft-elastic-tests moe-tests comm-lint \
+	reshard-tests analysis-tests ft-elastic-tests moe-tests \
+	serve-tests comm-lint \
 	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
@@ -32,7 +33,7 @@ SHELL := /bin/bash
 # program or an unaudited dispatch path without spending a single
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
-	numerics-tests reshard-tests ft-elastic-tests moe-tests
+	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -149,6 +150,21 @@ moe-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_moe_ep.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --moe
+
+# the serving tier: paged-KV-cache accounting + prefill/decode greedy
+# parity vs the train forward() + convert_params round-trip with the
+# per-weight reshard plan pinned + continuous-vs-static scheduler +
+# decode_ag/decode_rs decision audit/conservation suite, then the
+# end-to-end probe (8 devices, one Poisson stream through both
+# batching policies + a teacher-forced native-vs-int8 window; exits
+# nonzero unless continuous beats static on tokens/s with identical
+# per-request outputs, quant shrinks decode wire >= 3x at parity, and
+# every audited byte conserves; banks SERVE_<platform>.json +
+# BASELINE.md rows)
+serve-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --serve
 
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe

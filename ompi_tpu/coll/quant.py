@@ -422,10 +422,13 @@ class QuantDeviceComm:
             def inner(xs):                       # (r, Lpad)
                 flat = xs.reshape(-1)            # r rank rows end to end
                 full = _all_gather_quant(flat, dc.axis, n, block, sdt)
-                full = full.reshape(R, Lpad)[:, :L]       # (R, L)
-                flat_all = full.reshape(-1).astype(x.dtype)
-                return jnp.broadcast_to(flat_all[None],
-                                        (xs.shape[0],) + flat_all.shape)
+                full = full.reshape(-1).astype(x.dtype)   # (R*Lpad,)
+                # stay fully padded inside the program: the unpadded L
+                # is NOT in the cache key, so two shapes sharing a pad
+                # bucket must share this executable verbatim (the trim
+                # happens outside, like allreduce)
+                return jnp.broadcast_to(full[None],
+                                        (xs.shape[0],) + full.shape)
             return dc._shard_map(inner, dc._spec, dc._spec)
 
         self._spc("device_quant_collectives")
@@ -441,4 +444,5 @@ class QuantDeviceComm:
                 out = dc._compiled(key, build)(xp)
         else:
             out = dc._compiled(key, build)(xp)
+        out = out.reshape(R, R, Lpad)[:, :, :L]
         return out.reshape((R, R * b) + e)

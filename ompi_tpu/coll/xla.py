@@ -83,9 +83,10 @@ _DECIDED = ("allreduce", "reduce", "bcast", "allgather", "alltoall",
             "gather", "gatherv", "scatter", "scatterv", "alltoallv",
             "reduce_scatter")
 # entries with a quantized arm (coll/quant engine entry points; grad_sync
-# buckets ride psum_quant so they carry one too)
+# buckets ride psum_quant so they carry one too, and the serving tier's
+# decode combines ride the same allgather/reduce_scatter quant engines)
 _QUANT_COLLS = ("allreduce", "reduce_scatter_block", "reduce_scatter",
-                "allgather", "grad_sync")
+                "allgather", "grad_sync", "decode_ag", "decode_rs")
 for _c in _DECIDED:
     _var.register("coll", "xla", f"{_c}_mode", "", type=str, level=3,
                   help=f"Force the {_c} device mode (native|staged"
@@ -117,6 +118,19 @@ _var.register("coll", "xla", "moe_dispatch_mode", "", type=str, level=3,
                    "into same-outer-group and cross-DCN lanes; dispatch "
                    "payloads are never quantized (hier+quant decays to "
                    "hier here — quant applies to the combine only).")
+_var.register("coll", "xla", "decode_ag_mode", "", type=str, level=3,
+              help="Force the serving decode allgather arm (native|"
+                   "quant; empty = auto via DEVICE_RULES decode_ag "
+                   "rows). Carries every decode-path feature combine "
+                   "(embed, attention heads, o/mlp projections) plus "
+                   "the logits-psum gather half; quant rides the "
+                   "EQuARX int8 block tier.")
+_var.register("coll", "xla", "decode_rs_mode", "", type=str, level=3,
+              help="Force the serving decode reduce-scatter arm "
+                   "(native|quant; empty = auto via DEVICE_RULES "
+                   "decode_rs rows). Carries the logits-psum reduce "
+                   "half — the B×vocab float32 payload that dominates "
+                   "decode wire bytes.")
 _var.register("coll", "xla", "moe_combine_mode", "", type=str, level=3,
               help="Force the MoE expert-output combine exchange arm "
                    "(native|hier|hier+quant; empty = auto via "

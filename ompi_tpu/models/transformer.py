@@ -286,6 +286,45 @@ def _rope(x, positions, base):
     return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
 
 
+def rope_rows(x, positions, base):
+    """``_rope`` with PER-ROW positions: x (..., b, h, d), positions
+    (b,) — the decode-path variant where every batch row sits at its own
+    sequence position (the serving tier's continuous batch packs
+    unrelated requests into one device batch).  Same rotation math as
+    ``_rope``; only the position broadcast differs."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = base ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]
+    cos = jnp.expand_dims(jnp.cos(ang), axis=-2)       # (b, 1, half)
+    sin = jnp.expand_dims(jnp.sin(ang), axis=-2)
+    x1, x2 = x[..., :half], x[..., half:]
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x2 * cos + x1 * sin
+    return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)
+
+
+def decode_attention(q, k, v, q_pos):
+    """One decode step of ``_attn_apply``'s attention core against a
+    paged KV view: q (..., b, hl, hd) is the new token per batch slot,
+    k/v (..., b, L, hl, hd) the slot's gathered cache pages flattened
+    to L key positions, q_pos (b,) the token's absolute position (−1
+    for an inactive slot — fully masked, output garbage the scheduler
+    discards).  Query b attends key slots l ≤ q_pos[b] (itself
+    included: the engine writes the new k/v before attending), which is
+    exactly ``attention_reference``'s causal row for position q_pos.
+    Heads stay tp-sharded, so the whole op is local per shard."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("...bnd,...blnd->...bnl", q, k) \
+        / jnp.sqrt(jnp.asarray(hd, jnp.float32)).astype(q.dtype)
+    L = k.shape[-3]
+    mask = jnp.arange(L)[None, :] <= q_pos[:, None]    # (b, L)
+    scores = jnp.where(mask[:, None, :], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("...bnl,...blnd->...bnd", w, v)
+
+
 def _layer_apply_fused(x: jax.Array, layer: Dict, cfg: Config,
                        mesh: Mesh) -> Tuple[jax.Array, jax.Array]:
     """The tp_overlap='fused' decoder layer: Megatron sequence

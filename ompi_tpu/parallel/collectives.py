@@ -267,6 +267,29 @@ class DeviceComm:
         from .reshard import reshard as _reshard
         return _reshard(x, dst, mesh=self.mesh, spc=self.spc)
 
+    def canonicalize(self, x: jax.Array, dim: int) -> jax.Array:
+        """Re-layout an array sharded over this comm's axis on dimension
+        ``dim`` into the canonical ``(n, *local)`` dim-0 layout.  A pure
+        local restack — ZERO wire: each rank lifts its own shard under a
+        new leading rank dimension — so a consumer (the serving engine's
+        weight-stationary decode pieces) can feed column-parallel shards
+        straight into dim-0-batched compute without GSPMD guessing."""
+        if not 0 <= dim < x.ndim:
+            raise ValueError(f"canonicalize: dim {dim} out of range for "
+                             f"rank-{x.ndim} array")
+        if x.shape[dim] % self.n:
+            raise ValueError(
+                f"canonicalize: dim {dim} ({x.shape[dim]}) is not "
+                f"divisible by the {self.n}-way comm axis")
+        in_spec = P(*(self.axis if d == dim else None
+                      for d in range(x.ndim)))
+        key = ("canonicalize", dim, tuple(x.shape), str(x.dtype))
+
+        def build():
+            return self._shard_map(lambda a: a[None], (in_spec,),
+                                   P(self.axis))
+        return self._compiled(key, build)(x)
+
     # -- multi-process (rank-per-chip) layout helpers -----------------------
     # In the device-plane model (parallel/device_plane.py) each process owns
     # only its own rows; the global array is assembled from per-process

@@ -1,0 +1,435 @@
+"""Continuous-batching serving engine — prefill/decode over the decode
+weight layout, decode collectives audited as ``decode_ag``/``decode_rs``.
+
+Execution model (the host-orchestrated pattern of
+``models/moe.moe_block_ep``): the per-layer compute is a handful of
+jitted collective-free pieces over CANONICAL dim-0 arrays — every
+weight shard lifted once at init through ``DeviceComm.canonicalize``
+(a zero-wire local restack), every activation carried as ``(tp, B, …)``
+— and the only cross-device traffic is the eagerly dispatched, audited
+decode collectives between pieces.  That structure is what makes "one
+decision event per decode collective" true by construction rather than
+by instrumentation.
+
+Dataflow per token step, consistent with
+``models/transformer.decode_param_specs`` (all weights column-parallel,
+output features sharded over ``tp``; the residual stream rides
+replicated-content canonical form):
+
+* embed lookup → ``decode_ag`` (combine the d/tp feature shards)
+* per layer: qkv (local) → rope → paged-cache write (donated) →
+  paged attention (local: heads are tp-sharded) → ``decode_ag`` (head
+  combine) → wo (local) → ``decode_ag`` → +residual; mlp gate/up
+  (local) → ``decode_ag`` (d_ff combine) → w_down (local) →
+  ``decode_ag`` → +residual
+* logits: per-device partial over its d/tp slice of the tied embedding
+  → ``decode_rs`` + ``decode_ag`` (the bandwidth-bound psum: B×vocab
+  float32 — exactly where the EQuARX int8 tier pays for itself)
+
+Every dispatch runs the full decision chain (``coll/xla.decide_mode``:
+force vars ``coll_xla_decode_ag_mode``/``coll_xla_decode_rs_mode`` >
+blanket > learned > DEVICE_RULES rows > platform default) and fans out
+the same audit record as ``coll/xla._audit``: arm/wire pvars, perf
+``decode_*`` ledger cells, traffic ring-edge attribution (conservation:
+edge-sum == ``coll_wire_bytes``), and the trace decision event.
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import (_rms_norm, decode_attention,
+                                  rope_rows)
+from ..parallel.ring import attention_reference
+from .cache import PagedKVCache
+
+# -- jitted collective-free pieces (canonical dim-0 layout throughout) ------
+
+
+def _regroup(y):
+    """(tp, tp*B, c) allgather output → (tp, B, tp*c): per-token
+    feature concat of the per-device column shards.  Each row is fully
+    resident on one device, so this is a local reshape/transpose."""
+    r, tb, c = y.shape
+    b = tb // r
+    return y.reshape(r, r, b, c).transpose(0, 2, 1, 3).reshape(r, b, r * c)
+
+
+_j_regroup = jax.jit(_regroup)
+
+
+@jax.jit
+def _j_embed(embed_can, tokens):
+    """(tp, V, d/tp), (B,) → (tp, B, d/tp) local embedding slices."""
+    return jnp.take(embed_can, tokens, axis=1)
+
+
+@partial(jax.jit, static_argnames=("head_dim", "base"))
+def _j_qkv(x, norm_w, wqkv, pos, head_dim, base):
+    """Residual (tp, B, d) → roped q, k, v (tp, B, heads/tp, head_dim).
+    The qkv matmul is column-parallel: zero comm."""
+    h = _rms_norm(x, norm_w)
+    qkv = jnp.einsum("rbd,rdc->rbc", h, wqkv)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    r, b, c = q.shape
+    q = rope_rows(q.reshape(r, b, c // head_dim, head_dim), pos, base)
+    k = rope_rows(k.reshape(r, b, c // head_dim, head_dim), pos, base)
+    return q, k, v.reshape(r, b, c // head_dim, head_dim)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def _j_page_write(kp, vp, k_new, v_new, page_idx, offset):
+    """Scatter one k/v row per batch slot into its page — donated, so
+    the pools update in place and cache data never visits the host."""
+    kp = kp.at[:, page_idx, offset].set(k_new)
+    vp = vp.at[:, page_idx, offset].set(v_new)
+    return kp, vp
+
+
+@jax.jit
+def _j_paged_attn(q, kp, vp, bt, q_pos):
+    """Decode attention against the paged pools: gather each slot's
+    pages by block table, flatten to key positions, run the shared
+    ``decode_attention`` core.  Heads are tp-sharded → fully local."""
+    k = jnp.take(kp, bt, axis=1)       # (tp, B, pmax, page, hl, hd)
+    v = jnp.take(vp, bt, axis=1)
+    r, b, pmax, pg, hl, hd = k.shape
+    k = k.reshape(r, b, pmax * pg, hl, hd)
+    v = v.reshape(r, b, pmax * pg, hl, hd)
+    att = decode_attention(q, k, v, q_pos)
+    return att.reshape(r, b, hl * hd)
+
+
+@jax.jit
+def _j_prefill_attn(q, k, v):
+    """Prompt-phase causal attention over the fresh q/k/v (the pages
+    were just written; attending the in-register copies avoids the
+    gather) — ``attention_reference`` with the tp rows as batch."""
+    r, s, hl, hd = q.shape
+    att = attention_reference(q, k, v, causal=True)
+    return att.reshape(r, s, hl * hd)
+
+
+@jax.jit
+def _j_o_proj(ag_att, wo):
+    return jnp.einsum("rbh,rhc->rbc", _regroup(ag_att), wo)
+
+
+@jax.jit
+def _j_mlp_in(ag_o, x, norm_w, wg, wu):
+    x = x + _regroup(ag_o)
+    h = _rms_norm(x, norm_w)
+    g = jax.nn.silu(jnp.einsum("rbd,rdf->rbf", h, wg))
+    u = jnp.einsum("rbd,rdf->rbf", h, wu)
+    return x, g * u
+
+
+@jax.jit
+def _j_mlp_down(ag_z, wd):
+    return jnp.einsum("rbf,rfc->rbc", _regroup(ag_z), wd)
+
+
+@jax.jit
+def _j_residual(ag_d, x):
+    return x + _regroup(ag_d)
+
+
+@jax.jit
+def _j_logits_partial(x, norm_w, embed_can):
+    """Per-device partial logits: each device multiplies ITS d/tp slice
+    of the hidden state against its embedding columns — the partial
+    sums then reduce through decode_rs + decode_ag (the audited psum)."""
+    h = _rms_norm(x, norm_w)
+    r, b, d = h.shape
+    hs = h.reshape(r, b, r, d // r)
+    idx = jnp.arange(r)
+    hloc = hs[idx, :, idx, :]          # row r keeps its own slice
+    part = jnp.einsum("rbd,rvd->rbv", hloc, embed_can)
+    return part.reshape(r, b * part.shape[-1])
+
+
+@partial(jax.jit, static_argnames=("b",))
+def _j_logits_argmax(ag, b):
+    r = ag.shape[0]
+    logits = ag.reshape(r, b, -1).astype(jnp.float32)
+    return logits, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("s",))
+def _j_last_pos(x, s):
+    return x[:, s - 1:s, :]
+
+
+# -- decision + audit shims (the moe.models pattern for custom colls) -------
+
+def _decide_serve_coll(dc, coll: str, nbytes: int,
+                       dtype) -> Tuple[str, str, List[str]]:
+    """Decision shim over coll/xla.decide_mode for the decode coll
+    names: per-entry/blanket force vars, DEVICE_RULES rows (plane-keyed
+    included), the learned source — the full precedence chain.  The
+    decode collectives are single-stage (flat tp ring), so the hier
+    arms are ineligible by construction."""
+    from ..coll.xla import _load_device_rules, decide_mode
+    from ..op import SUM, quantizable
+    from ..parallel.hierarchy import classify_axes
+    axes = dc.axis if isinstance(dc.axis, tuple) else (dc.axis,)
+    kinds = classify_axes(dc.mesh)
+    plane = ("dcn" if any(kinds.get(a) == "dcn" for a in axes)
+             else "ici")
+    platform = next(iter(dc.mesh.devices.flat)).platform
+    return decide_mode(coll, int(nbytes), dc.n, platform,
+                       _load_device_rules(), ("native", "quant"),
+                       quant_ok=quantizable(SUM, dtype), dtype=dtype,
+                       op=None, plane=plane, hier_ok=False,
+                       hier_why="decode collectives are single-stage")
+
+
+def _audit_serve_coll(dc, coll: str, arm: str, reason: str,
+                      chain: List[str], x, dur_s: float,
+                      extra: Optional[Dict[str, Any]] = None) -> int:
+    """ONE decision-audit record per decode collective — the same
+    fan-out as coll/xla._audit: arm + wire pvars, an externally-timed
+    perf sample (the ``decode_*`` ledger cells), traffic ring-edge
+    attribution of the SAME wire figure (conservation's other half),
+    and the trace decision event carrying the precedence chain."""
+    from ..coll.quant import wire_bytes
+    rows = max(x.shape[0], 1)
+    nbytes = x.nbytes // rows
+    qcoll = "allgather" if coll == "decode_ag" else "reduce_scatter"
+    try:
+        wb = wire_bytes(qcoll, max(x.size // rows, 1), dc.n, x.dtype)
+    except (ValueError, TypeError):
+        wb = None
+    ratio = wb["ratio"] if wb is not None else None
+    wire = nbytes
+    if wb is not None:
+        wire = wb["quant_bytes"] if arm == "quant" else wb["native_bytes"]
+    spc = dc.spc
+    if spc is not None:
+        spc.inc(f"coll_arm_{arm}_count")
+        spc.inc("coll_wire_bytes", int(wire))
+    from ..parallel import simdcn
+    if simdcn.us_per_mib() > 0:
+        simdcn.charge(int(wire * simdcn.ring_dcn_fraction(dc.mesh,
+                                                          dc.axis)))
+    from .. import perf, trace, traffic
+    if perf.enabled:
+        perf.note_sample(coll, arm, int(wire), dur_s, dc.n)
+    if traffic.enabled:
+        traffic.note_coll(dc, coll, arm, int(wire))
+    if trace.enabled:
+        bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
+        trace.decision(coll, arm=arm, reason=reason, nbytes=int(nbytes),
+                       shape_bucket=bucket, shape=tuple(x.shape),
+                       dtype=str(x.dtype), ndev=dc.n,
+                       wire_bytes=int(wire), quant_ratio=ratio,
+                       chain=list(chain), **(extra or {}))
+    return int(wire)
+
+
+class ServingEngine:
+    """Prefill + continuous decode over one tp DeviceComm.
+
+    ``params`` arrive in the TRAIN layout by default and are converted
+    on device through ``convert_params(to="decode")`` (the reshard
+    engine — the serving tier is its first consumer in anger), then
+    lifted shard-by-shard into canonical form with zero wire."""
+
+    def __init__(self, dc, params: Dict, cfg, *, n_pages: int = 64,
+                 page_size: int = 16, max_seqs: int = 8,
+                 max_pages_per_seq: Optional[int] = None,
+                 layout: str = "train") -> None:
+        from ..models import transformer as tfm
+        if cfg.mlp != "dense":
+            raise ValueError("ServingEngine: decode path is dense-MLP "
+                             f"only (cfg.mlp={cfg.mlp!r})")
+        for name, dim in (("n_heads", cfg.n_heads),
+                          ("d_model", cfg.d_model), ("d_ff", cfg.d_ff),
+                          ("vocab", cfg.vocab)):
+            if dim % dc.n:
+                raise ValueError(
+                    f"ServingEngine: cfg.{name}={dim} not divisible by "
+                    f"the {dc.n}-way tp axis")
+        if layout == "train":
+            params = tfm.convert_params(params, dc.mesh, cfg,
+                                        to="decode")
+        elif layout != "decode":
+            raise ValueError(f"layout={layout!r} (want train|decode)")
+        self.dc = dc
+        self.cfg = cfg
+        self.max_seqs = int(max_seqs)
+        cdt = jnp.dtype(cfg.dtype)
+
+        def can(w):
+            # weight-stationary: store in the compute dtype (the same
+            # cast forward() pays per step) before the zero-wire restack
+            return dc.canonicalize(w.astype(cdt), 1)
+
+        def can_qkv(w):
+            # the fused (d, 3h) weight is a global [q|k|v] column
+            # concat: canonicalizing it whole would hand rank r a
+            # contiguous 3h/tp chunk of that concat (all-q on the low
+            # ranks), so the per-rank q/k/v split in _j_qkv would slice
+            # the wrong columns.  Canonicalize each projection on its
+            # own and re-concat per rank: row r = [q_r | k_r | v_r],
+            # i.e. global head block r of each.
+            h3 = w.shape[1] // 3
+            return jnp.concatenate(
+                [can(w[:, i * h3:(i + 1) * h3]) for i in range(3)],
+                axis=-1)
+
+        self._embed = can(params["embed"])             # (tp, V, d/tp)
+        self._final_norm = params["final_norm"]
+        self._layers: List[Dict[str, Any]] = [
+            {"attn_norm": lw["attn_norm"],
+             "wqkv": can_qkv(lw["wqkv"]),
+             "wo": can(lw["wo"]),
+             "mlp_norm": lw["mlp_norm"],
+             "w_gate": can(lw["w_gate"]),
+             "w_up": can(lw["w_up"]),
+             "w_down": can(lw["w_down"])}
+            for lw in params["layers"]]
+        self.cache = PagedKVCache(
+            dc, cfg.n_layers, cfg.n_heads, cfg.head_dim,
+            n_pages=n_pages, page_size=page_size, max_seqs=max_seqs,
+            max_pages_per_seq=max_pages_per_seq,
+            dtype=jnp.dtype(cfg.dtype))
+        self.dispatches: Dict[str, int] = {"decode_ag": 0,
+                                           "decode_rs": 0}
+        self.wire_bytes = 0
+
+    # -- audited collective dispatch ---------------------------------------
+
+    def _ag(self, x):
+        t0 = time.perf_counter()
+        arm, reason, chain = _decide_serve_coll(
+            self.dc, "decode_ag", x.nbytes // x.shape[0], x.dtype)
+        out = (self.dc.quant.allgather(x) if arm == "quant"
+               else self.dc.allgather(x))
+        dur = time.perf_counter() - t0
+        self.wire_bytes += _audit_serve_coll(
+            self.dc, "decode_ag", arm, reason, chain, x, dur)
+        self.dispatches["decode_ag"] += 1
+        return out
+
+    def _rs(self, x):
+        t0 = time.perf_counter()
+        arm, reason, chain = _decide_serve_coll(
+            self.dc, "decode_rs", x.nbytes // x.shape[0], x.dtype)
+        out = (self.dc.quant.reduce_scatter(x) if arm == "quant"
+               else self.dc.reduce_scatter(x))
+        dur = time.perf_counter() - t0
+        self.wire_bytes += _audit_serve_coll(
+            self.dc, "decode_rs", arm, reason, chain, x, dur)
+        self.dispatches["decode_rs"] += 1
+        return out
+
+    # -- forward pieces ----------------------------------------------------
+
+    def _backbone(self, x, pos_dev, page_idx, offset,
+                  attend: Callable) -> Any:
+        cfg = self.cfg
+        for i, lw in enumerate(self._layers):
+            q, k, v = _j_qkv(x, lw["attn_norm"], lw["wqkv"], pos_dev,
+                             head_dim=cfg.head_dim,
+                             base=float(cfg.rope_base))
+            self.cache.k[i], self.cache.v[i] = _j_page_write(
+                self.cache.k[i], self.cache.v[i], k, v, page_idx,
+                offset)
+            att = attend(i, q, k, v)
+            o = _j_o_proj(self._ag(att), lw["wo"])
+            x, z = _j_mlp_in(self._ag(o), x, lw["mlp_norm"],
+                             lw["w_gate"], lw["w_up"])
+            d = _j_mlp_down(self._ag(z), lw["w_down"])
+            x = _j_residual(self._ag(d), x)
+        return x
+
+    def _logits(self, x, b: int):
+        part = _j_logits_partial(x, self._final_norm, self._embed)
+        red = self._ag(self._rs(part))
+        return _j_logits_argmax(red, b=b)
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        p = 8
+        while p < n:
+            p *= 2
+        return p
+
+    # -- serving entry points ----------------------------------------------
+
+    def prefill(self, slot: int, prompt: np.ndarray):
+        """Run one request's prompt through the decode-layout model:
+        writes its KV pages, returns (first greedy token, last-position
+        logits (tp, 1, V)).  Prompts pad to a small power-of-2 bucket
+        so compilations stay bounded; padded positions write to the
+        scratch page and never enter the causal window."""
+        from .. import trace
+        prompt = np.asarray(prompt, np.int32)
+        s = int(prompt.shape[0])
+        spad = self._bucket(s)
+        tok = np.zeros(spad, np.int32)
+        tok[:s] = prompt
+        positions = np.arange(spad, dtype=np.int64)
+        live_pos = np.where(positions < s, positions, -1)
+        page_idx, offset = self.cache.write_indices(
+            np.full(spad, slot), live_pos)
+        t0 = time.perf_counter()
+        try:
+            x = _j_regroup(self._ag(_j_embed(self._embed,
+                                             jnp.asarray(tok))))
+            x = self._backbone(
+                x, jnp.asarray(positions.astype(np.int32)),
+                jnp.asarray(page_idx), jnp.asarray(offset),
+                lambda i, q, k, v: _j_prefill_attn(q, k, v))
+            logits, nxt = self._logits(_j_last_pos(x, s=s), b=1)
+            jax.block_until_ready(nxt)
+        finally:
+            if trace.enabled:
+                trace.record_span("serve:prefill", "serve", t0,
+                                  time.perf_counter(),
+                                  args={"slot": slot, "prompt_len": s})
+        self.cache.seq_lens[slot] = s
+        return int(np.asarray(jax.device_get(nxt))[0, 0]), logits
+
+    def decode_step(self, tokens: np.ndarray, positions: np.ndarray):
+        """One continuous-batching decode step over the FULL device
+        batch: ``tokens``/``positions`` are (max_seqs,) with
+        position −1 marking an inactive slot (its lane computes masked
+        garbage on the scratch page — the batch shape never changes, so
+        one executable serves every occupancy).  Returns (next greedy
+        token per slot (max_seqs,), logits (tp, max_seqs, V))."""
+        from .. import trace
+        b = self.max_seqs
+        tokens = np.asarray(tokens, np.int32)
+        positions = np.asarray(positions, np.int64)
+        page_idx, offset = self.cache.write_indices(np.arange(b),
+                                                    positions)
+        t0 = time.perf_counter()
+        try:
+            bt = jnp.asarray(self.cache.block_tables)
+            pos_dev = jnp.asarray(positions.astype(np.int32))
+            x = _j_regroup(self._ag(_j_embed(
+                self._embed,
+                jnp.asarray(np.where(positions >= 0, tokens,
+                                     0).astype(np.int32)))))
+            x = self._backbone(
+                x, pos_dev, jnp.asarray(page_idx), jnp.asarray(offset),
+                lambda i, q, k, v: _j_paged_attn(
+                    q, self.cache.k[i], self.cache.v[i], bt, pos_dev))
+            logits, nxt = self._logits(x, b=b)
+            jax.block_until_ready(nxt)
+        finally:
+            if trace.enabled:
+                trace.record_span(
+                    "serve:decode_step", "serve", t0,
+                    time.perf_counter(),
+                    args={"active": int((positions >= 0).sum()),
+                          "slots": b})
+        return np.asarray(jax.device_get(nxt))[0], logits

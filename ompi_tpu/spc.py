@@ -108,6 +108,16 @@ COUNTERS = [
      "training steps rolled back to the shadow epoch across recoveries"),
     ("ft_shadow_refreshes",
      "peer-shadow ring_shift refreshes of the training state"),
+    # serving plane (fed by ompi_tpu/serving; process-wide)
+    ("serve_tokens",
+     "decode tokens emitted by the serving tier (prefill first "
+     "tokens included)"),
+    ("serve_active_seqs",
+     "sequences currently in flight in the continuous batch"),
+    ("serve_evictions",
+     "sequences evicted from the batch (EOS, max-new or drain)"),
+    ("serve_kv_pages_used",
+     "KV cache pages currently reserved by live sequences"),
 ]
 
 
@@ -169,6 +179,10 @@ class Counters:
             from . import moe
             if name in moe.PVARS:
                 return moe.pvar_value(name)
+        if name.startswith("serve_"):
+            from . import serving
+            if name in serving.PVARS:
+                return serving.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -195,6 +209,9 @@ class Counters:
         from . import moe
         for name in moe.PVARS:
             out[name] = moe.pvar_value(name)
+        from . import serving
+        for name in serving.PVARS:
+            out[name] = serving.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

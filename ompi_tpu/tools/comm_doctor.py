@@ -36,8 +36,9 @@ from ..trace import merge as _merge
 # ISSUE 9; 5 = the reshard plan-cache/last-plan section, ISSUE 10;
 # 6 = the static-verifier section, ISSUE 11;
 # 7 = the ft/elastic recovery section, ISSUE 13;
-# 8 = the MoE routing-plane section, ISSUE 14)
-SCHEMA_VERSION = 8
+# 8 = the MoE routing-plane section, ISSUE 14;
+# 9 = the serving-plane section, ISSUE 15)
+SCHEMA_VERSION = 9
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -577,6 +578,76 @@ def build_moe_report(
     return "\n".join(lines), rep
 
 
+def build_serve_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the serving plane: continuous-
+    batching occupancy, the prefill/decode/host goodput split, inter-
+    token latency percentiles, the per-request lifecycle table and the
+    decode collective arm audit.  ``path`` loads a banked SERVE json
+    (bench.py --serve); default reads the live in-process plane."""
+    decisions: Dict[str, Any] = {}
+    if path:
+        with open(path) as fh:
+            doc = json.load(fh)
+        rep = doc.get("report", doc)
+        decisions = doc.get("decisions", {})
+    else:
+        from .. import serving as _serving
+        from .. import trace as _trace
+        rep = _serving.report()
+        for c in ("decode_ag", "decode_rs"):
+            last = _trace.explain_last(c)
+            if last is not None:
+                decisions[c] = last
+    lines: List[str] = []
+    w = lines.append
+    src = f" (from {path})" if path else ""
+    g = rep.get("goodput") or {}
+    w(f"serving: {int(rep.get('prefills', 0))} prefill(s), "
+      f"{int(rep.get('decode_steps', 0))} decode step(s), "
+      f"{int(rep.get('tokens', 0))} token(s), "
+      f"{int(rep.get('evictions', 0))} eviction(s){src}")
+    w(f"  batch occupancy: "
+      f"{100.0 * float(rep.get('batch_occupancy', 0.0)):.1f}% "
+      f"(active now: {int(rep.get('active_seqs', 0))}, KV pages held: "
+      f"{int(rep.get('kv_pages_used', 0))})")
+    if g:
+        w("  goodput split: "
+          f"prefill {float(g.get('prefill_pct', 0.0)):.1f}% / "
+          f"decode {float(g.get('decode_pct', 0.0)):.1f}% / "
+          f"host {float(g.get('host_pct', 0.0)):.1f}%  "
+          f"({float(g.get('decode_tokens_per_s', 0.0)):.1f} decode "
+          "tok/s)")
+    itl = rep.get("itl") or {}
+    if int(itl.get("count", 0)):
+        w(f"  inter-token latency: p50 {float(itl.get('p50_ms', 0)):.2f} "
+          f"ms, p99 {float(itl.get('p99_ms', 0)):.2f} ms "
+          f"(n={int(itl['count'])})")
+    decisions = {c: d for c, d in (decisions or {}).items() if d}
+    if decisions:
+        w("  decode collective arms:")
+        for c in sorted(decisions):
+            d = decisions[c]
+            w(f"    {c}: arm={d.get('arm')} "
+              f"wire={int(d.get('wire_bytes', 0))}B/call  "
+              f"[{d.get('reason')}]")
+    rows = rep.get("requests") or []
+    if rows:
+        w("  requests (most recent):")
+        w("    rid   state    prompt  gen/max  queue_ms  reason")
+        for r in rows[-12:]:
+            w(f"    {r.get('rid')!s:<5} {r.get('state', '?'):<8} "
+              f"{int(r.get('prompt_len', 0)):>6}  "
+              f"{int(r.get('generated', 0)):>3}/"
+              f"{int(r.get('max_new', 0)):<3}  "
+              f"{1e3 * float(r.get('queue_wait_s', 0.0)):>8.2f}  "
+              f"{r.get('evict_reason') or '-'}")
+    rep = dict(rep)
+    if decisions:
+        rep["decisions"] = decisions
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -662,6 +733,15 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "path, loads a banked MOE json (bench.py "
                          "--moe); bare flag reads the live in-process "
                          "plane")
+    ap.add_argument("--serve", nargs="?", const="", default=None,
+                    metavar="SERVE.json",
+                    help="render the serving-plane section: continuous-"
+                         "batching occupancy, goodput split, inter-"
+                         "token latency p50/p99, per-request lifecycle "
+                         "table and the decode_ag/decode_rs arm audit. "
+                         "With a path, loads a banked SERVE json "
+                         "(bench.py --serve); bare flag reads the live "
+                         "in-process plane")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -699,7 +779,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not ns.dumps:
         if (ns.perf or ns.traffic is not None or ns.numerics is not None
                 or ns.reshard is not None or ns.analyze is not None
-                or ns.ft is not None or ns.moe is not None):
+                or ns.ft is not None or ns.moe is not None
+                or ns.serve is not None):
             # plane sections render standalone (no merged timeline)
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
@@ -749,6 +830,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         mtext, mdata = build_moe_report(ns.moe or None)
         text = (text + "\n" + mtext) if text else mtext
         data["moe"] = mdata
+    if getattr(ns, "serve", None) is not None:
+        stext, sdata = build_serve_report(ns.serve or None)
+        text = (text + "\n" + stext) if text else stext
+        data["serve"] = sdata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

@@ -70,6 +70,10 @@ _RING_COLLS = frozenset({
     "allreduce", "reduce", "bcast", "allgather", "allgatherv",
     "reduce_scatter", "reduce_scatter_block", "scan", "exscan",
     "gather", "gatherv", "scatter", "scatterv",
+    # serving decode combines are plain ring allgather/reduce-scatter
+    # under audited names — same geometry, so conservation (edge-sum ==
+    # coll_wire_bytes) holds for the decode stream too
+    "decode_ag", "decode_rs",
 })
 # bipartite block fills (uniform unless a counts matrix rode along)
 _A2A_COLLS = frozenset({
