@@ -8,7 +8,7 @@ SHELL := /bin/bash
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
 	reshard-tests analysis-tests ft-elastic-tests moe-tests \
-	serve-tests comm-lint \
+	serve-tests decode-tests comm-lint \
 	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
@@ -33,7 +33,8 @@ SHELL := /bin/bash
 # program or an unaudited dispatch path without spending a single
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
-	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests
+	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests \
+	decode-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -163,6 +164,20 @@ moe-tests:
 # BASELINE.md rows)
 serve-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --serve
+
+# the decode fast-path tier: fused collective-matmul decode program
+# (eager-vs-fused parity, 11 -> 2 eager dispatches/step, commgraph
+# static-vs-runtime byte proof on 2/4/8-dev meshes) + speculative
+# draft/verify windows (token-stream identity, MEASURED acceptance) +
+# pad-past-native quant veto + learned decode arms + MoE decode parity
+# + comm-lint over the serving modules; the --serve probe's fused/
+# speculative/learned phases are its end-to-end gate (shares the
+# serve-tests probe so the banked SERVE_<platform>.json stays one
+# artifact)
+decode-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --serve
 

@@ -1566,6 +1566,58 @@ def run_compare(old_path: str, new_path: str) -> None:
         raise SystemExit("bench compare: unreadable artifact "
                          f"({old_path if old is None else new_path})")
     regressions, checked = [], 0
+    if str(old.get("metric", "")).startswith("serve_"):
+        # serving artifacts (SERVE_<platform>.json): gate the decode
+        # headline and each shared arm on tokens/s (higher better) and
+        # ITL p99 (lower better) at the same 10% threshold
+        for col in ("value", "best_tokens_per_s"):
+            ov, nv = old.get(col), new.get(col)
+            if isinstance(ov, (int, float)) \
+                    and isinstance(nv, (int, float)) and ov > 0:
+                checked += 1
+                if nv < 0.9 * ov:
+                    regressions.append(
+                        f"serve: {col} {ov:g} -> {nv:g} tok/s "
+                        f"({(nv / ov - 1) * 100:+.1f}%)")
+        oarms = {a.get("policy"): a for a in old.get("arms") or []}
+        for narm in new.get("arms") or []:
+            oarm = oarms.get(narm.get("policy"))
+            if not oarm:
+                continue
+            ov, nv = oarm.get("tokens_per_s"), narm.get("tokens_per_s")
+            if isinstance(ov, (int, float)) \
+                    and isinstance(nv, (int, float)) and ov > 0:
+                checked += 1
+                if nv < 0.9 * ov:
+                    regressions.append(
+                        f"serve[{narm['policy']}]: tokens_per_s "
+                        f"{ov:g} -> {nv:g} "
+                        f"({(nv / ov - 1) * 100:+.1f}%)")
+            ov, nv = oarm.get("itl_p99_ms"), narm.get("itl_p99_ms")
+            if isinstance(ov, (int, float)) \
+                    and isinstance(nv, (int, float)) and ov > 0:
+                checked += 1
+                if nv > 1.1 * ov:
+                    regressions.append(
+                        f"serve[{narm['policy']}]: itl_p99_ms "
+                        f"{ov:g} -> {nv:g} "
+                        f"({(nv / ov - 1) * 100:+.1f}%)")
+        print(json.dumps({
+            "metric": "bench_compare",
+            "value": float(len(regressions)),
+            "unit": "serve columns regressed >10%",
+            "old": old_path, "new": new_path,
+            "columns_checked": checked,
+            "regressions": regressions,
+        }))
+        if regressions:
+            raise SystemExit("bench compare: regression in "
+                             + "; ".join(regressions))
+        if not checked:
+            raise SystemExit("bench compare: no comparable serve "
+                             f"columns between {old_path} and "
+                             f"{new_path}")
+        return
     for phase, orow in sorted((old.get("phases") or {}).items()):
         nrow = (new.get("phases") or {}).get(phase)
         if not isinstance(orow, dict) or not isinstance(nrow, dict):
@@ -3080,6 +3132,17 @@ def _bank_serve_baseline(doc: dict) -> None:
         f"{q['quant_wire_bytes']} B — {q['shrink']:.2f}x shrink, "
         f"{100.0 * q['token_match']:.1f}% greedy-token agreement "
         f"(logits rel-err {q['logits_relerr']:.3g}).")
+    fu, sp = doc.get("fused"), doc.get("speculative")
+    if fu and sp:
+        lines.append(
+            f"\nDecode fast path: fused collective-matmul program "
+            f"dispatches {fu['eager_dispatches_per_step']:g} eager + "
+            f"{fu['fused_dispatches_per_step']:g} in-program "
+            f"collective(s)/step (eager path: 11); speculative "
+            f"k={sp['k']} verify windows measured "
+            f"{100.0 * sp['acceptance_rate']:.1f}% draft acceptance "
+            f"({sp['accepted']}/{sp['drafted']}) with token streams "
+            f"identical to plain greedy.")
     lines.append(end)
     row = "\n".join(lines)
     try:
@@ -3108,7 +3171,14 @@ def run_serve_probe(platform: str) -> None:
     agreement >= 90% and logits rel-err < 5%, (d) every decode
     collective dispatched exactly one decision event, and (e) every
     audited byte conserves through the traffic matrix (edge sum ==
-    coll_wire_bytes, zero unattributed).  Banks SERVE_<platform>.json
+    coll_wire_bytes, zero unattributed).  The decode fast path then
+    rides the same stream: (f) decode_overlap="fused" emits identical
+    tokens with <= 3 eager dispatches/step, a byte-for-byte
+    static-vs-runtime commgraph proof, and a tokens/s win over eager;
+    (g) speculative k-token verify windows emit identical tokens at a
+    MEASURED nonzero acceptance and win end-to-end; (h)
+    coll_xla_rules=learned resolves both decode arms from the banked
+    perf ledger with a learned: reason.  Banks SERVE_<platform>.json
     and maintains the BASELINE.md rows between the SERVE markers."""
     import jax
     import jax.numpy as jnp
@@ -3143,19 +3213,32 @@ def run_serve_probe(platform: str) -> None:
     serving.reset()
     serving.enable()
     try:
+        SPEC_K = 3
         eng = ServingEngine(dc, sharded, cfg, n_pages=64, page_size=8,
                             max_seqs=8)
-        # warm the jit cache (both prefill buckets + the decode step):
-        # policy comparison must measure batching, not compilation
-        warm = poisson_stream(4, 1000.0, cfg.vocab, seed=3,
-                              prompt_len=(6, 14), max_new=(3, 4))
-        ContinuousBatchingScheduler(eng, warm, policy="continuous").run()
+        cfg_f = dataclasses.replace(cfg, decode_overlap="fused")
+        eng_f = ServingEngine(dc, sharded, cfg_f, n_pages=64,
+                              page_size=8, max_seqs=8)
+        # warm the jit cache (both prefill buckets + the decode step
+        # of BOTH dispatch paths + the (max_seqs*k)-row verify-window
+        # specialization of the fused program): every measured arm must
+        # pay batching, not compilation
+        def warm_stream():
+            return poisson_stream(4, 1000.0, cfg.vocab, seed=3,
+                                  prompt_len=(6, 14), max_new=(3, 4))
+        ContinuousBatchingScheduler(eng, warm_stream(),
+                                    policy="continuous").run()
+        ContinuousBatchingScheduler(eng_f, warm_stream()).run()
+        ContinuousBatchingScheduler(eng_f, warm_stream(),
+                                    spec_k=SPEC_K).run()
 
         # conservation window starts AFTER init + warmup (convert_params
         # resharding and warmup compiles charge other ledgers)
         dc.spc = spc.Counters()
-        eng.wire_bytes = 0
-        eng.dispatches = {"decode_ag": 0, "decode_rs": 0}
+        for e_ in (eng, eng_f):
+            e_.wire_bytes = 0
+            e_.dispatches = {"decode_ag": 0, "decode_rs": 0,
+                             "decode_collmm": 0}
         traffic.reset()
         traffic.enable()
         trace.enable()
@@ -3271,6 +3354,159 @@ def run_serve_probe(platform: str) -> None:
                 f"{100 * match:.0f}% token agreement, logits rel-err "
                 f"{relerr:.3g}")
 
+        # -- fused phase: collective-matmul decode program -------------
+        # Same stream, same weights, decode_overlap="fused": per decode
+        # step the eager decode_ag/decode_rs dispatch chain collapses
+        # into ring collective-matmuls inside ONE jitted program (plus
+        # the embed + logits gathers).  Gates: identical token streams,
+        # eager dispatches/step <= 3, the commgraph static extraction
+        # matches runtime wire bytes byte-for-byte, and end-to-end
+        # tokens/s beats the eager continuous arm.
+        vrep = eng_f.verify_decode_program()
+        if not vrep.ok:
+            raise SystemExit("serve probe: fused decode program failed "
+                             "static-vs-runtime byte verification:\n"
+                             + vrep.summary())
+
+        # teacher-forced window: count dispatches per decode step
+        eng_f.dispatches = {"decode_ag": 0, "decode_rs": 0,
+                            "decode_collmm": 0}
+        n_dec0 = sum(1 for e in trace.events()
+                     if e.get("name") == "decide:decode_collmm")
+        slot = eng_f.cache.admit(len(prompt), Q_STEPS + 1)
+        first, _ = eng_f.prefill(slot, prompt)
+        pre_ag = eng_f.dispatches["decode_ag"]
+        last = first
+        for _s in range(Q_STEPS):
+            t = np.zeros(eng_f.max_seqs, np.int32)
+            p = np.full(eng_f.max_seqs, -1, np.int64)
+            t[slot] = last
+            p[slot] = int(eng_f.cache.seq_lens[slot])
+            nxt, _lg = eng_f.decode_step(t, p)
+            eng_f.cache.seq_lens[slot] += 1
+            last = int(nxt[slot])
+        eng_f.cache.release(slot)
+        eager_per_step = (eng_f.dispatches["decode_ag"] - pre_ag
+                          + eng_f.dispatches["decode_rs"]) / Q_STEPS
+        fused_per_step = eng_f.dispatches["decode_collmm"] / Q_STEPS
+        if eager_per_step > 3:
+            raise SystemExit(
+                "serve probe: fused decode still dispatches "
+                f"{eager_per_step:g} eager collective(s)/step (need "
+                "<= 3)")
+        n_dec = sum(1 for e in trace.events()
+                    if e.get("name") == "decide:decode_collmm") - n_dec0
+        if n_dec != eng_f.dispatches["decode_collmm"]:
+            raise SystemExit(
+                f"serve probe: audit incomplete — {n_dec} "
+                "decide:decode_collmm event(s) for "
+                f"{eng_f.dispatches['decode_collmm']} dispatches")
+
+        def run_fused(spec_k=0):
+            serving.reset()
+            stream = poisson_stream(N_REQ, QPS, cfg.vocab, seed=SEED)
+            out = ContinuousBatchingScheduler(eng_f, stream,
+                                              spec_k=spec_k).run()
+            return out, serving.report()
+
+        out_f, rep_f = run_fused()
+        for rid, r in out_c["results"].items():
+            if r["tokens"] != out_f["results"][rid]["tokens"]:
+                raise SystemExit(
+                    f"serve probe: request {rid} decoded differently "
+                    "under fused vs eager dispatch")
+        if not out_f["tokens_per_s"] > out_c["tokens_per_s"]:
+            raise SystemExit(
+                "serve probe: fused decode did not beat eager "
+                f"({out_f['tokens_per_s']:.1f} vs "
+                f"{out_c['tokens_per_s']:.1f} tok/s)")
+
+        # -- speculative phase: k-token draft/verify on the fused path -
+        out_sp, rep_sp = run_fused(spec_k=SPEC_K)
+        for rid, r in out_c["results"].items():
+            if r["tokens"] != out_sp["results"][rid]["tokens"]:
+                raise SystemExit(
+                    f"serve probe: request {rid} decoded differently "
+                    "under speculative vs plain greedy")
+        accept = rep_sp["speculative"]["acceptance_rate"]
+        if not accept > 0.0:
+            raise SystemExit("serve probe: speculative decode accepted "
+                             "zero draft tokens — the win would be "
+                             "assumed, not measured")
+        if not out_sp["tokens_per_s"] > out_c["tokens_per_s"]:
+            raise SystemExit(
+                "serve probe: speculative decode did not beat the "
+                f"eager baseline ({out_sp['tokens_per_s']:.1f} vs "
+                f"{out_c['tokens_per_s']:.1f} tok/s)")
+
+        # -- learned phase: the ledger picks the decode arms -----------
+        # Both quant and native decode_ag/decode_rs samples are banked
+        # under the LOGICAL payload bucket by now (the policy runs
+        # banked native, the quant window banked quant), so
+        # coll_xla_rules=learned must resolve each arm from measured
+        # GB/s with a learned: reason — not fall through to the rules
+        # table.
+        var.registry.set_cli("coll_xla_rules", "learned")
+        var.registry.set_cli("coll_quant_block", "32")
+        var.registry.set_cli("coll_quant_min_bytes", "0")
+        try:
+            slot = eng.cache.admit(len(prompt), 2)
+            first, _ = eng.prefill(slot, prompt)
+            t = np.zeros(eng.max_seqs, np.int32)
+            p = np.full(eng.max_seqs, -1, np.int64)
+            t[slot] = first
+            p[slot] = int(eng.cache.seq_lens[slot])
+            eng.decode_step(t, p)
+            eng.cache.release(slot)
+            learned = {c: trace.explain_last(c)
+                       for c in ("decode_ag", "decode_rs")}
+            for c, d in learned.items():
+                if not str(d.get("reason", "")).startswith("learned:"):
+                    raise SystemExit(
+                        f"serve probe: coll_xla_rules=learned left "
+                        f"{c} on reason {d.get('reason')!r}")
+        finally:
+            var.registry.clear_cli("coll_xla_rules")
+            var.registry.clear_cli("coll_quant_block")
+            var.registry.clear_cli("coll_quant_min_bytes")
+
+        # conservation still closes over BOTH engines' decode traffic
+        # (eager + fused + speculative windows + the verify runner)
+        edge_sum2 = traffic.matrix.edge_bytes_total()
+        wire_pv2 = int(dc.spc.get("coll_wire_bytes"))
+        unattr2 = int(traffic.matrix.unattributed_bytes)
+        eng_sum = eng.wire_bytes + eng_f.wire_bytes
+        if edge_sum2 != wire_pv2 or wire_pv2 != eng_sum or unattr2:
+            raise SystemExit(
+                f"serve probe: conservation breach after fused phase — "
+                f"coll_wire_bytes {wire_pv2}, engine audit {eng_sum}, "
+                f"edge sum {edge_sum2}, unattributed {unattr2}")
+
+        best = max(out_c["tokens_per_s"], out_f["tokens_per_s"],
+                   out_sp["tokens_per_s"])
+        prior = _load_json(os.path.join(here,
+                                        f"SERVE_{platform}.json"))
+        if prior and isinstance(prior.get("value"), (int, float)):
+            if "fused" not in prior:
+                # first run after the fast path landed: the banked
+                # value is the old eager headline — beat it outright
+                if not best > float(prior["value"]):
+                    raise SystemExit(
+                        "serve probe: decode fast path "
+                        f"({best:.1f} tok/s) did not beat the banked "
+                        f"eager baseline ({prior['value']:.1f})")
+            elif best < 0.85 * float(prior["value"]):
+                # soft self-ratchet only: run-to-run wall-clock noise on
+                # the 1-core CPU host is real (+-10% between back-to-back
+                # idle-machine runs), so a tight ratchet here just flakes
+                # — the WITHIN-run orderings (fused > eager, spec >
+                # eager, identity, byte proof) plus the banked-artifact
+                # --compare guard carry the regression protection
+                raise SystemExit(
+                    f"serve probe: best decode path {best:.1f} tok/s "
+                    "regressed >15% vs banked "
+                    f"{prior['value']:.1f}")
+
         decisions = {c: trace.explain_last(c)
                      for c in ("decode_ag", "decode_rs")}
         arms_rows = [
@@ -3282,22 +3518,41 @@ def run_serve_probe(platform: str) -> None:
              "itl_p99_ms": round(r["itl"]["p99_ms"], 3),
              "goodput": r["goodput"]}
             for p, o, r in (("continuous", out_c, rep_c),
-                            ("static", out_s, rep_s))]
+                            ("static", out_s, rep_s),
+                            ("fused", out_f, rep_f),
+                            (f"fused+spec k={SPEC_K}", out_sp, rep_sp))]
         perf_cells = [
             {k: r[k] for k in ("coll", "arm", "bucket_bytes", "count")}
             for r in perf.report()["model"]
             if r["coll"].startswith("decode_")]
         doc = {
-            "metric": "serve_tokens_per_s_continuous",
-            "value": round(out_c["tokens_per_s"], 2),
-            "unit": "end-to-end decode tokens/s (virtual clock: "
-                    "measured prefill+decode+host durations)",
+            "metric": "serve_tokens_per_s_best",
+            "value": round(best, 2),
+            "unit": "end-to-end decode tokens/s, best dispatch path "
+                    "(virtual clock: measured prefill+decode+host "
+                    "durations)",
             "platform": platform, "ndev": ndev,
             "n_requests": N_REQ, "qps": QPS,
             "d_model": cfg.d_model, "vocab": cfg.vocab,
             "max_seqs": 8, "page_size": 8,
+            "best_tokens_per_s": round(best, 2),
             "arms": arms_rows,
             "dispatches": n_disp,
+            "fused": {
+                "tokens_per_s": round(out_f["tokens_per_s"], 2),
+                "eager_dispatches_per_step": eager_per_step,
+                "fused_dispatches_per_step": fused_per_step,
+                "commgraph": vrep.summary(),
+            },
+            "speculative": {
+                "k": SPEC_K,
+                "tokens_per_s": round(out_sp["tokens_per_s"], 2),
+                "decode_steps": out_sp["decode_steps"],
+                "acceptance_rate": round(accept, 4),
+                "drafted": rep_sp["speculative"]["drafted"],
+                "accepted": rep_sp["speculative"]["accepted"],
+            },
+            "learned": learned,
             "quant": {"steps": Q_STEPS, "block": 32,
                       "native_wire_bytes": int(wire_n),
                       "quant_wire_bytes": int(wire_q),
@@ -3312,7 +3567,20 @@ def run_serve_probe(platform: str) -> None:
             },
             "perf_decode_cells": perf_cells,
             "decisions": decisions,
-            "report": rep_c,
+            # the banked report is the continuous arm's snapshot with the
+            # spec arm's measured accept/reject ledger and the fused arm's
+            # in-program dispatch count grafted in, so the doctor's
+            # artifact replay renders the full fast-path story (the live
+            # plane resets between arms — no single snapshot holds all
+            # three)
+            "report": dict(
+                rep_c,
+                speculative=rep_sp["speculative"],
+                dispatches={
+                    "eager": rep_c["dispatches"]["eager"],
+                    "fused": rep_sp["dispatches"]["fused"],
+                },
+            ),
         }
         with open(os.path.join(here, f"SERVE_{platform}.json"),
                   "w") as f:

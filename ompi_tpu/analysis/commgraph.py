@@ -468,6 +468,10 @@ _DEFAULT_COLL_MAP = {
     "ring_attention": "ppermute",
     "ulysses": "all_to_all",
     "reshard": "reshard",
+    # the fused decode program's collective-matmul rings: n−1 ppermute
+    # hops per ring, charged per-ring by the serving engine — the
+    # ppermute trip model reproduces the schedule's wire column exactly
+    "decode_collmm": "ppermute",
 }
 
 

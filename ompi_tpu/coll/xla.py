@@ -185,6 +185,31 @@ def _load_device_rules(path: Optional[str] = None):
     return _rules_grammar.parse_file(path)
 
 
+def _quant_pads_past_native(coll: str, nbytes: int, ndev: int,
+                            dtype) -> bool:
+    """True when the quantized arm's BLOCK PADDING pushes its wire
+    bytes past the native arm's for this payload: the per-rank shard
+    pads up to ``coll_quant_block`` elements before the int8 cast, so a
+    small-payload/large-block combination (the decode footgun:
+    KB-scale decode_ag shards under ``coll_quant_block=32``… or worse,
+    the 256 default) can make "compression" a strict loss.  The
+    decision layer records ``ineligible:quant:pad-past-native`` instead
+    of silently shipping more bytes than native would."""
+    if dtype is None:
+        return False
+    from .quant import wire_bytes
+    try:
+        count = max(int(nbytes) // np.dtype(dtype).itemsize, 1)
+        qcoll = ("allreduce" if coll == "allreduce" else
+                 "reduce_scatter" if ("reduce_scatter" in coll
+                                      or coll.endswith("_rs"))
+                 else "allgather")
+        wb = wire_bytes(qcoll, count, max(int(ndev), 1), dtype)
+    except (ValueError, TypeError, KeyError):
+        return False     # no quant wire model for this coll/dtype
+    return wb["quant_bytes"] > wb["native_bytes"]
+
+
 def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
                 rules, allowed, quant_ok: bool = False,
                 dtype=None, op: Op = None, plane: Optional[str] = None,
@@ -288,7 +313,9 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
         from .. import perf
         cand = tuple(m for m in allowed
                      if m != "quant"
-                     or (q_ok and not quant_off and nbytes >= floor))
+                     or (q_ok and not quant_off and nbytes >= floor
+                         and not _quant_pads_past_native(
+                             coll, nbytes, ndev, dtype)))
         if hier_ok:
             cand = cand + ("hier",)
             if quant_ok and not quant_off:
@@ -325,6 +352,11 @@ def decide_mode(coll: str, nbytes: int, ndev: int, platform: str,
             if mode == "quant" and nbytes < floor:
                 return (f"floor:coll_quant_min_bytes={floor}"
                         f">{nbytes} (vetoed {rule})")
+            if mode == "quant" and _quant_pads_past_native(
+                    coll, nbytes, ndev, dtype):
+                return (f"ineligible:quant:pad-past-native "
+                        f"(block padding exceeds native bytes at "
+                        f"{nbytes}B; vetoed {rule})")
         if mode in ("hier", "hier+quant") and not hier_ok:
             return f"ineligible:hier:{hier_why} (vetoed {rule})"
         return None

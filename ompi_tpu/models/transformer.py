@@ -106,6 +106,16 @@ class Config:
     #   allgather_matmul, wo/down run matmul_reduce_scatter; ring
     #   direction per call site (native|bidir) via the decision layer.
     #   Needs a tp>=2 mesh, dense attn+mlp, running seq divisible by tp
+    decode_overlap: str = "eager"      # "eager" | "fused" — how the
+    #   serving engine's decode step moves its tp combines: "eager"
+    #   dispatches each decode_ag/decode_rs between jitted pieces (one
+    #   audited collective per combine), "fused" runs the whole decode
+    #   backbone + logits as ONE jitted program whose combines are the
+    #   n−1-hop collective-matmul rings (serving/fused, audited as
+    #   ``decode_collmm``) — the residual stream is BATCH-sharded over
+    #   tp (sequence parallelism with sequence ↦ batch), so only the
+    #   embed + logits combines stay eager. Needs tp>=2, dense mlp,
+    #   max_seqs divisible by tp — docs/serving.md "Decode fast path"
 
 
 def flagship_config(seq: int = 2048) -> Config:

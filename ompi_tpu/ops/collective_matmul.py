@@ -43,6 +43,91 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..jaxcompat import shard_map
 
 
+def ring_allgather_matmul_local(x, w, axis: str, n: int, *,
+                                reverse: bool = False):
+    """Shard-level body of the allgather-matmul ring, callable INSIDE
+    any shard_map over ``axis``: x (..., m_local, k) is this rank's row
+    shard, w (k, c) its (column-local) weight; returns (..., m_local*n,
+    c) with every rank's block filled.  Exactly n−1 ppermutes: the own
+    block's matmul is peeled before the loop, so the rotating shard
+    makes the minimum number of hops and the static extractor's
+    trips × payload figure equals the runtime (n−1)·shard charge
+    byte-for-byte (the serving tier's fused decode program verifies
+    this per step)."""
+    m_local = x.shape[-2]
+    my = lax.axis_index(axis)
+    lead = (0,) * (x.ndim - 2)
+
+    def place(out, block, row0):
+        return lax.dynamic_update_slice(
+            out, block.astype(out.dtype), lead + (row0, 0))
+
+    out = jnp.zeros(x.shape[:-2] + (m_local * n, w.shape[1]),
+                    jnp.promote_types(x.dtype, w.dtype))
+    out = place(out, jnp.dot(x, w, preferred_element_type=out.dtype),
+                my * m_local)
+    if n == 1:
+        return out
+    shift = 1 if not reverse else -1
+    perm = [(j, (j + shift) % n) for j in range(n)]
+
+    def step(i, carry):
+        out, xs = carry
+        xs = lax.ppermute(xs, axis, perm)
+        # after i hops the visiting shard originated at rank (my - i*shift)
+        src = (my - i * shift) % n
+        block = jnp.dot(xs, w, preferred_element_type=out.dtype)
+        return place(out, block, src * m_local), xs
+
+    out, _ = lax.fori_loop(1, n, step, (out, x))
+    return out
+
+
+def ring_allgather_matmul_bidir_local(x, w, axis: str, n: int):
+    """Bidirectional variant of :func:`ring_allgather_matmul_local`:
+    the local rows split in half and rotate in OPPOSITE directions —
+    two concurrent ppermutes per step drive both ICI link directions at
+    once, so each link carries half the bytes. The +1 half visiting at
+    step i originated at (my - i); the -1 half at (my + i). n−1 hops
+    per half (own halves peeled)."""
+    m_local = x.shape[-2]
+    my = lax.axis_index(axis)
+    lead = (0,) * (x.ndim - 2)
+
+    def place(out, block, row0):
+        return lax.dynamic_update_slice(
+            out, block.astype(out.dtype), lead + (row0, 0))
+
+    mh = m_local // 2
+    xa = lax.slice_in_dim(x, 0, mh, axis=-2)
+    xb = lax.slice_in_dim(x, mh, m_local, axis=-2)
+    out = jnp.zeros(x.shape[:-2] + (m_local * n, w.shape[1]),
+                    jnp.promote_types(x.dtype, w.dtype))
+    out = place(out, jnp.dot(xa, w, preferred_element_type=out.dtype),
+                my * m_local)
+    out = place(out, jnp.dot(xb, w, preferred_element_type=out.dtype),
+                my * m_local + mh)
+    if n == 1:
+        return out
+    perm_f = [(j, (j + 1) % n) for j in range(n)]
+    perm_b = [(j, (j - 1) % n) for j in range(n)]
+
+    def step(i, carry):
+        out, xf, xr = carry
+        xf = lax.ppermute(xf, axis, perm_f)
+        xr = lax.ppermute(xr, axis, perm_b)
+        src_f = (my - i) % n
+        src_b = (my + i) % n
+        bf = jnp.dot(xf, w, preferred_element_type=out.dtype)
+        br = jnp.dot(xr, w, preferred_element_type=out.dtype)
+        out = place(out, bf, src_f * m_local)
+        out = place(out, br, src_b * m_local + mh)
+        return out, xf, xr
+
+    out, _, _ = lax.fori_loop(1, n, step, (out, xa, xb))
+    return out
+
+
 @functools.lru_cache(maxsize=64)
 def _build_allgather_matmul(mesh: Mesh, axis: str, w_spec: P, reverse: bool,
                             bidir: bool, batch_axis: Optional[str],
@@ -50,60 +135,9 @@ def _build_allgather_matmul(mesh: Mesh, axis: str, w_spec: P, reverse: bool,
     n = mesh.shape[axis]
 
     def local(x, w):
-        # x: (..., m_local, k) — this rank's shard; w: (k, n_local or n)
-        m_local = x.shape[-2]
-        my = lax.axis_index(axis)
-        lead = (0,) * (x.ndim - 2)
-
-        def place(out, block, row0):
-            return lax.dynamic_update_slice(
-                out, block.astype(out.dtype), lead + (row0, 0))
-
-        out0 = jnp.zeros(x.shape[:-2] + (m_local * n, w.shape[1]),
-                         jnp.promote_types(x.dtype, w.dtype))
-
-        if not bidir:
-            shift = 1 if not reverse else -1
-            perm = [(j, (j + shift) % n) for j in range(n)]
-
-            def step(i, carry):
-                out, xs = carry
-                # the shard visiting at step i originated at rank
-                # (my - i*shift)
-                src = (my - i * shift) % n
-                block = jnp.dot(xs, w, preferred_element_type=out.dtype)
-                out = place(out, block, src * m_local)
-                xs = lax.ppermute(xs, axis, perm)
-                return out, xs
-
-            out, _ = lax.fori_loop(0, n, step, (out0, x))
-            return out
-
-        # Bidirectional ring: split the local rows in half and rotate the
-        # halves in OPPOSITE directions — two concurrent ppermutes per step
-        # drive both ICI link directions at once, so each link carries half
-        # the bytes of the unidirectional schedule. The +1 half visiting at
-        # step i originated at (my - i); the -1 half at (my + i).
-        mh = m_local // 2
-        xa = lax.slice_in_dim(x, 0, mh, axis=-2)
-        xb = lax.slice_in_dim(x, mh, m_local, axis=-2)
-        perm_f = [(j, (j + 1) % n) for j in range(n)]
-        perm_b = [(j, (j - 1) % n) for j in range(n)]
-
-        def step(i, carry):
-            out, xf, xr = carry
-            src_f = (my - i) % n
-            src_b = (my + i) % n
-            bf = jnp.dot(xf, w, preferred_element_type=out.dtype)
-            br = jnp.dot(xr, w, preferred_element_type=out.dtype)
-            out = place(out, bf, src_f * m_local)
-            out = place(out, br, src_b * m_local + mh)
-            xf = lax.ppermute(xf, axis, perm_f)
-            xr = lax.ppermute(xr, axis, perm_b)
-            return out, xf, xr
-
-        out, _, _ = lax.fori_loop(0, n, step, (out0, xa, xb))
-        return out
+        if bidir:
+            return ring_allgather_matmul_bidir_local(x, w, axis, n)
+        return ring_allgather_matmul_local(x, w, axis, n, reverse=reverse)
 
     if batch_axis is not None or ndim == 3:
         x_spec = P(batch_axis, axis, None)
@@ -159,6 +193,82 @@ def allgather_matmul(x: jax.Array, w: jax.Array, mesh: Mesh, axis: str,
                                    x.ndim)(x, w)
 
 
+def ring_matmul_reduce_scatter_local(x, w, axis: str, n: int):
+    """Shard-level body of the matmul-reduce-scatter ring, callable
+    INSIDE any shard_map over ``axis``: x (..., m, k_local) carries the
+    full m rows with this rank's contraction slice, w (k_local, c) its
+    weight rows; returns (..., m/n, c) — the fully reduced m-block this
+    rank owns.  n−1 ppermutes: partial sums ride the ring in float32
+    and each hop's matmul block is produced just in time.
+
+    The chunk destined for rank d starts at rank (d+1)%n and rides the
+    ring n−1 hops, each visited rank adding its local partial block.
+    After t hops, rank r therefore holds the chunk destined for
+    d = (r-1-t) % n; after n−1 hops that is d = r — its own."""
+    m = x.shape[-2]
+    if m % n:
+        raise ValueError(f"m={m} not divisible by ring size {n}")
+    mb = m // n
+    my = lax.axis_index(axis)
+
+    def block(idx, off, nrows):
+        rows = lax.dynamic_slice_in_dim(x, idx * mb + off, nrows,
+                                        axis=-2)
+        return jnp.dot(rows, w, preferred_element_type=jnp.float32)
+
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    acc = block((my - 1) % n, 0, mb)
+    if n == 1:
+        return acc.astype(out_dtype)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def step(t, acc):
+        return (lax.ppermute(acc, axis, perm)
+                + block((my - 1 - t) % n, 0, mb))
+
+    acc = lax.fori_loop(1, n, step, acc)
+    return acc.astype(out_dtype)
+
+
+def ring_matmul_reduce_scatter_bidir_local(x, w, axis: str, n: int):
+    """Bidirectional variant of :func:`ring_matmul_reduce_scatter_local`:
+    each destination's mb rows split in half.  The top half rides the
+    +1 ring; the bottom half rides the -1 ring — its chunk for dest d
+    starts at rank (d-1)%n, and after t backward hops rank r holds the
+    chunk destined for d = (r+1+t) % n, landing at d = r after n−1
+    hops. One fori_loop carries both accumulators so XLA can keep both
+    ppermutes (both ICI directions) in flight at once."""
+    m = x.shape[-2]
+    if m % n:
+        raise ValueError(f"m={m} not divisible by ring size {n}")
+    mb = m // n
+    my = lax.axis_index(axis)
+
+    def block(idx, off, nrows):
+        rows = lax.dynamic_slice_in_dim(x, idx * mb + off, nrows,
+                                        axis=-2)
+        return jnp.dot(rows, w, preferred_element_type=jnp.float32)
+
+    out_dtype = jnp.promote_types(x.dtype, w.dtype)
+    mbh = mb // 2
+    perm_f = [(j, (j + 1) % n) for j in range(n)]
+    perm_b = [(j, (j - 1) % n) for j in range(n)]
+
+    def step(t, carry):
+        af, ab = carry
+        af = (lax.ppermute(af, axis, perm_f)
+              + block((my - 1 - t) % n, 0, mbh))
+        ab = (lax.ppermute(ab, axis, perm_b)
+              + block((my + 1 + t) % n, mbh, mb - mbh))
+        return af, ab
+
+    af = block((my - 1) % n, 0, mbh)
+    ab = block((my + 1) % n, mbh, mb - mbh)
+    if n > 1:
+        af, ab = lax.fori_loop(1, n, step, (af, ab))
+    return jnp.concatenate([af, ab], axis=-2).astype(out_dtype)
+
+
 @functools.lru_cache(maxsize=64)
 def _build_matmul_rs(mesh: Mesh, axis: str, bidir: bool,
                      batch_axis: Optional[str], ndim: int):
@@ -168,58 +278,9 @@ def _build_matmul_rs(mesh: Mesh, axis: str, bidir: bool,
         # x: (..., m, k_local), w: (k_local, n_cols): full partial product
         # would be x @ w (..., m, n_cols); ring-reduce-scatter it over the m
         # dimension while computing each m-block just in time.
-        m = x.shape[-2]
-        if m % n:
-            raise ValueError(f"m={m} not divisible by ring size {n}")
-        mb = m // n
-        my = lax.axis_index(axis)
-
-        def block(idx, off, nrows):
-            rows = lax.dynamic_slice_in_dim(x, idx * mb + off, nrows,
-                                            axis=-2)
-            return jnp.dot(rows, w, preferred_element_type=jnp.float32)
-
-        out_dtype = jnp.promote_types(x.dtype, w.dtype)
-
-        if not bidir:
-            perm = [(j, (j + 1) % n) for j in range(n)]
-
-            # The chunk destined for rank d starts at rank (d+1)%n and
-            # rides the ring n-1 hops, each visited rank adding its local
-            # partial block. After t hops, rank r therefore holds the chunk
-            # destined for d = (r-1-t) % n; after n-1 hops that is d = r —
-            # its own.
-            def step(t, acc):
-                return (lax.ppermute(acc, axis, perm)
-                        + block((my - 1 - t) % n, 0, mb))
-
-            acc = block((my - 1) % n, 0, mb)
-            acc = lax.fori_loop(1, n, step, acc)
-            return acc.astype(out_dtype)
-
-        # Bidirectional ring: split each destination's mb rows in half.
-        # The top half rides the +1 ring exactly as above; the bottom half
-        # rides the -1 ring — its chunk for dest d starts at rank (d-1)%n,
-        # and after t backward hops rank r holds the chunk destined for
-        # d = (r+1+t) % n, landing at d = r after n-1 hops. One fori_loop
-        # carries both accumulators so XLA can keep both ppermutes (both
-        # ICI directions) in flight at once.
-        mbh = mb // 2
-        perm_f = [(j, (j + 1) % n) for j in range(n)]
-        perm_b = [(j, (j - 1) % n) for j in range(n)]
-
-        def step(t, carry):
-            af, ab = carry
-            af = (lax.ppermute(af, axis, perm_f)
-                  + block((my - 1 - t) % n, 0, mbh))
-            ab = (lax.ppermute(ab, axis, perm_b)
-                  + block((my + 1 + t) % n, mbh, mb - mbh))
-            return af, ab
-
-        af = block((my - 1) % n, 0, mbh)
-        ab = block((my + 1) % n, mbh, mb - mbh)
-        af, ab = lax.fori_loop(1, n, step, (af, ab))
-        return jnp.concatenate([af, ab], axis=-2).astype(out_dtype)
+        if bidir:
+            return ring_matmul_reduce_scatter_bidir_local(x, w, axis, n)
+        return ring_matmul_reduce_scatter_local(x, w, axis, n)
 
     if batch_axis is not None or ndim == 3:
         in_specs = (P(batch_axis, None, axis), P(axis, None))

@@ -74,6 +74,10 @@ _occ_sum = 0.0               # sum over decode steps of active/slots
 _itl: List[float] = []       # inter-token deltas, seconds
 _requests: "dict[Any, Dict[str, Any]]" = {}
 _finished_order: List[Any] = []
+_spec_drafted = 0            # speculative: draft tokens proposed
+_spec_accepted = 0           # speculative: draft tokens accepted
+_spec_windows = 0            # speculative: verify windows run
+_dispatches: Dict[str, int] = {"eager": 0, "fused": 0}
 
 
 def enable() -> None:
@@ -97,7 +101,8 @@ _var.watch("serve_enabled", _on_enabled_var)
 
 def reset() -> None:
     global _tokens, _evictions, _active, _pages_used, _prefills, \
-        _decode_steps, _prefill_s, _decode_s, _host_s, _occ_sum
+        _decode_steps, _prefill_s, _decode_s, _host_s, _occ_sum, \
+        _spec_drafted, _spec_accepted, _spec_windows
     with _lock:
         _tokens = 0
         _evictions = 0
@@ -109,6 +114,11 @@ def reset() -> None:
         _decode_s = 0.0
         _host_s = 0.0
         _occ_sum = 0.0
+        _spec_drafted = 0
+        _spec_accepted = 0
+        _spec_windows = 0
+        _dispatches["eager"] = 0
+        _dispatches["fused"] = 0
         _itl.clear()
         _requests.clear()
         _finished_order.clear()
@@ -196,6 +206,28 @@ def set_pages_used(n: int) -> None:
         _pages_used = int(n)
 
 
+def note_spec(drafted: int, accepted: int) -> None:
+    """One speculative verify window: ``drafted`` tokens proposed by the
+    draft source, ``accepted`` of them matched the target model's greedy
+    choice (0 ≤ accepted ≤ drafted).  The MEASURED acceptance rate —
+    accepted/drafted over the run — is the number bench banks; it is
+    never assumed."""
+    global _spec_drafted, _spec_accepted, _spec_windows
+    with _lock:
+        _spec_drafted += int(drafted)
+        _spec_accepted += int(accepted)
+        _spec_windows += 1
+
+
+def note_dispatch(mode: str, n: int = 1) -> None:
+    """Count an eagerly dispatched decode collective (``mode="eager"``:
+    decode_ag/decode_rs between jitted pieces) or a fused-program ring
+    (``mode="fused"``: a decode_collmm site inside the one jitted
+    program) — comm_doctor --serve renders the fused-vs-eager split."""
+    with _lock:
+        _dispatches[mode] = _dispatches.get(mode, 0) + int(n)
+
+
 # -- pvar read-through + report ---------------------------------------------
 
 def pvar_value(name: str) -> float:
@@ -253,6 +285,14 @@ def report() -> Dict[str, Any]:
                 "p99_ms": 1e3 * _percentile(itl, 0.99),
                 "mean_ms": (1e3 * sum(itl) / len(itl)) if itl else 0.0,
             },
+            "speculative": {
+                "windows": _spec_windows,
+                "drafted": _spec_drafted,
+                "accepted": _spec_accepted,
+                "acceptance_rate": (_spec_accepted / _spec_drafted
+                                    if _spec_drafted else 0.0),
+            },
+            "dispatches": dict(_dispatches),
             "requests": rows,
         }
 

@@ -80,13 +80,18 @@ class ContinuousBatchingScheduler:
 
     def __init__(self, engine, requests: List[Request], *,
                  policy: str = "continuous",
-                 eos_id: Optional[int] = None) -> None:
+                 eos_id: Optional[int] = None,
+                 spec_k: int = 0) -> None:
         if policy not in ("continuous", "static"):
             raise ValueError(f"policy={policy!r} "
                              "(want continuous|static)")
+        if spec_k < 0 or spec_k == 1:
+            raise ValueError(f"spec_k={spec_k} (0 disables; >=2 sets "
+                             "the draft/verify window length)")
         self.engine = engine
         self.policy = policy
         self.eos_id = eos_id
+        self.spec_k = int(spec_k)
         self.pending: List[Request] = sorted(requests,
                                              key=lambda r: r.arrival)
         self.active: Dict[int, _Active] = {}       # slot -> state
@@ -166,7 +171,10 @@ class ContinuousBatchingScheduler:
                 # idle: jump the virtual clock to the next arrival
                 self.clock = max(self.clock, self.pending[0].arrival)
                 continue
-            self._step()
+            if self.spec_k >= 2:
+                self._step_spec()
+            else:
+                self._step()
             if self.decode_steps >= max_steps:
                 raise RuntimeError(f"scheduler exceeded {max_steps} "
                                    "decode steps without draining")
@@ -197,6 +205,91 @@ class ContinuousBatchingScheduler:
             if serving.enabled:
                 serving.note_token(st.req.rid, self.clock)
             self._maybe_finish(st, tok)
+        host = time.perf_counter() - th0
+        self.clock += host
+        if serving.enabled:
+            serving.note_host(host)
+
+    # -- speculative decoding (spec_k >= 2) --------------------------------
+
+    @staticmethod
+    def _draft(history: List[int], n: int) -> List[int]:
+        """n-gram SELF-draft: continue the sequence by the most recent
+        bigram match in the request's own history (prompt + emitted
+        tokens), falling back to repeating the last token.  Free — no
+        second model — and measurably nonzero on any stream with local
+        structure; the acceptance rate is MEASURED by the verify loop
+        (serving.note_spec), never assumed."""
+        work = list(history)
+        out: List[int] = []
+        for _ in range(n):
+            d = None
+            if len(work) >= 2:
+                prev, last = work[-2], work[-1]
+                for i in range(len(work) - 3, -1, -1):
+                    if work[i] == prev and work[i + 1] == last:
+                        d = work[i + 2]
+                        break
+            if d is None:
+                d = work[-1]
+            out.append(d)
+            work.append(d)
+        return out
+
+    def _step_spec(self) -> None:
+        """One draft/verify window: each active slot runs its next
+        input token plus ``spec_k − 1`` draft tokens through ONE
+        teacher-forced ``decode_window`` call, then accepts the longest
+        prefix where draft i equals the model's greedy output at window
+        position i−1 — so every emitted token is EXACTLY the token
+        non-speculative greedy would have produced, and a rejection is
+        a block-table truncate (``cache.seq_lens`` rolls back to the
+        accepted prefix; the stale KV rows are masked and later
+        overwritten)."""
+        cache = self.engine.cache
+        b, k = self.engine.max_seqs, self.spec_k
+        tokens = np.zeros((b, k), np.int32)
+        positions = np.full((b, k), -1, np.int64)
+        drafts: Dict[int, List[int]] = {}
+        for slot, st in self.active.items():
+            d = self._draft(list(st.req.prompt) + st.tokens, k - 1)
+            drafts[slot] = d
+            tokens[slot] = [st.last] + d
+            p = int(cache.seq_lens[slot])
+            positions[slot] = np.arange(p, p + k)
+        t0 = time.perf_counter()
+        nxt, _ = self.engine.decode_window(tokens, positions)
+        dur = time.perf_counter() - t0
+        self.clock += dur
+        self.decode_steps += 1
+        if serving.enabled:
+            serving.note_decode_step(dur, len(self.active), b)
+        th0 = time.perf_counter()
+        for slot in list(self.active):
+            st = self.active[slot]
+            d = drafts[slot]
+            y = [int(t) for t in nxt[slot]]
+            j = 0
+            while j < k - 1 and d[j] == y[j]:
+                j += 1
+            if serving.enabled:
+                serving.note_spec(k - 1, j)
+            emitted = 0
+            finished = False
+            for i in range(j + 1):       # y_0..y_j are all greedy-true
+                tok = y[i]
+                st.tokens.append(tok)
+                st.last = tok
+                emitted += 1
+                if serving.enabled:
+                    serving.note_token(st.req.rid, self.clock)
+                if self._maybe_finish(st, tok):
+                    finished = True
+                    break
+            if not finished:
+                # consumed tokens = the input + the accepted drafts:
+                # one KV row each; everything past it is rolled back
+                cache.seq_lens[slot] = int(positions[slot, 0]) + emitted
         host = time.perf_counter() - th0
         self.clock += host
         if serving.enabled:

@@ -37,8 +37,10 @@ from ..trace import merge as _merge
 # 6 = the static-verifier section, ISSUE 11;
 # 7 = the ft/elastic recovery section, ISSUE 13;
 # 8 = the MoE routing-plane section, ISSUE 14;
-# 9 = the serving-plane section, ISSUE 15)
-SCHEMA_VERSION = 9
+# 9 = the serving-plane section, ISSUE 15;
+# 10 = the decode fast path: speculative accept/reject ledger +
+#      fused-vs-eager dispatch counts in --serve, ISSUE 16)
+SCHEMA_VERSION = 10
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -595,7 +597,7 @@ def build_serve_report(
         from .. import serving as _serving
         from .. import trace as _trace
         rep = _serving.report()
-        for c in ("decode_ag", "decode_rs"):
+        for c in ("decode_ag", "decode_rs", "decode_collmm"):
             last = _trace.explain_last(c)
             if last is not None:
                 decisions[c] = last
@@ -623,6 +625,20 @@ def build_serve_report(
         w(f"  inter-token latency: p50 {float(itl.get('p50_ms', 0)):.2f} "
           f"ms, p99 {float(itl.get('p99_ms', 0)):.2f} ms "
           f"(n={int(itl['count'])})")
+    spec = rep.get("speculative") or {}
+    if int(spec.get("windows", 0)):
+        drafted = int(spec.get("drafted", 0))
+        accepted = int(spec.get("accepted", 0))
+        w(f"  speculative: {int(spec['windows'])} verify window(s), "
+          f"{accepted}/{drafted} draft(s) accepted "
+          f"({100.0 * float(spec.get('acceptance_rate', 0.0)):.1f}% "
+          f"measured), {drafted - accepted} rejected")
+    disp = rep.get("dispatches") or {}
+    if any(int(v) for v in disp.values()):
+        w(f"  decode dispatches: eager {int(disp.get('eager', 0))} "
+          f"(decode_ag/decode_rs between jitted pieces), fused "
+          f"{int(disp.get('fused', 0))} (decode_collmm rings inside "
+          "the one-program path)")
     decisions = {c: d for c, d in (decisions or {}).items() if d}
     if decisions:
         w("  decode collective arms:")

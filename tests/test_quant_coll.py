@@ -420,12 +420,18 @@ class TestQuantDecision:
 
         var.registry.set_cli("coll_xla_dynamic_rules", str(rules))
         var.registry.set_cli("coll_quant_min_bytes", "1024")
+        # 512 elems / 8 ranks = 64-elem shards: at the default block of
+        # 256 the padding pushes the quant wire PAST native and the
+        # pad-past-native veto (rightly) refuses the rule row — tune the
+        # block down so the rule row is genuinely eligible here.
+        var.registry.set_cli("coll_quant_block", "64")
         var.registry.reset_cache()
         try:
             assert self._run(fn)
         finally:
             var.registry.set_cli("coll_xla_dynamic_rules", "")
             var.registry.clear_cli("coll_quant_min_bytes")
+            var.registry.clear_cli("coll_quant_block")
             var.registry.reset_cache()
 
     def test_blanket_off_vetoes_rules(self, tmp_path):
