@@ -8,7 +8,7 @@ SHELL := /bin/bash
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
 	reshard-tests analysis-tests ft-elastic-tests moe-tests \
-	serve-tests decode-tests comm-lint \
+	serve-tests decode-tests policy-tests comm-lint \
 	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
@@ -34,7 +34,7 @@ SHELL := /bin/bash
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
 	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests \
-	decode-tests
+	decode-tests policy-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -181,6 +181,20 @@ decode-tests:
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --serve
 
+# the policy-plane tier: verdict bus + statically pre-verified action
+# space + fleet-consistent vote + audited observe->decide->act suite,
+# then the self-driving probe (8 devices; a chaos-slowed allreduce link
+# plus a forced quant-SNR drop the plane must retune PAST without a
+# restart — exits nonzero unless the arm demotes to quant fleet-wide,
+# recovered goodput beats the degraded floor under the SAME chaos,
+# zero steps drop, every decide:policy event names its causing verdict
+# (100% attribution) and the SNR verdict halves the quant block; banks
+# POLICY_<platform>.json + a provenance-commented DEVICE_RULES row)
+policy-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_policy.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --selfdrive
+
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe
 # (extracts the flagship train step's and a reshard plan's collective
@@ -192,7 +206,7 @@ analysis-tests: comm-lint
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --analyze
 
-# repo-invariant comm-lint (rules CL001-CL006, justified waivers only)
+# repo-invariant comm-lint (rules CL001-CL007, justified waivers only)
 # plus the DEVICE_RULES grammar validator; nonzero on any unwaived
 # finding — cheap enough to run on every edit
 comm-lint:

@@ -572,3 +572,81 @@ def verify(fn: Callable, args: Sequence[Any], mesh,
     return VerifyReport(source=g.source, n_records=len(g.records),
                         issues=issues, rows=rows,
                         host_transfers=list(g.host_transfers))
+
+
+# -- policy action verification ----------------------------------------------
+
+# the decided-dispatch vocabulary a policy action may retarget, with the
+# flat native arm's per-device hop factor (fraction of the payload, the
+# same 2(n-1)/n-family expressions as perf/model._FACTOR and the
+# runtime note models)
+_ACTION_COLL_FACTORS: Dict[str, Callable[[int], float]] = {
+    "allreduce": lambda n: 2.0 * (n - 1) / n,
+    "grad_sync": lambda n: 2.0 * (n - 1) / n,        # bucketed allreduce
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "allgather": lambda n: (n - 1) / n,
+    "alltoall": lambda n: (n - 1) / n,
+    "broadcast": lambda n: (n - 1) / n,
+    "ppermute": lambda n: 1.0,
+    "collmm": lambda n: (n - 1) / n,
+    "moe_dispatch": lambda n: (n - 1) / n,
+    "moe_combine": lambda n: (n - 1) / n,
+    "decode_ag": lambda n: (n - 1) / n,
+    "decode_rs": lambda n: (n - 1) / n,
+}
+
+# ops with a quantized wire format (coll/quant.wire_bytes vocabulary,
+# plus the bucketed-allreduce alias)
+_QUANTIZABLE = {"allreduce": "allreduce", "grad_sync": "allreduce",
+                "reduce_scatter": "reduce_scatter",
+                "allgather": "allgather"}
+
+
+def verify_action(coll: str, arm: str, nbytes: int = 1 << 20,
+                  ndev: int = 8, dtype: str = "float32"
+                  ) -> Dict[str, Any]:
+    """Statically verify one policy-reachable ``(coll, arm)`` retarget.
+
+    The policy engine calls this at CONSTRUCTION for every arm its
+    rules can reach — an action that cannot be verified here is
+    rejected at registration, never at 3 a.m.  Checks the arm against
+    the DEVICE_RULES mode vocabulary, the op against the decided
+    dispatch vocabulary, and that the arm has a wire format for the op
+    (``quant`` on an op with no quantized codec is structurally
+    impossible, not a runtime surprise).  Returns the wire-byte
+    prediction for a ``nbytes`` payload over ``ndev`` devices — the
+    figure the decision ledger records next to the measured effect.
+
+    Raises ``ValueError`` with the full (coll, arm) context on any
+    unverifiable action.
+    """
+    from . import rules as _rules
+
+    if arm not in _rules.MODES:
+        raise ValueError(
+            f"policy action retargets {coll!r} to unknown arm {arm!r} "
+            f"— not in the DEVICE_RULES mode vocabulary {_rules.MODES}")
+    if coll not in _ACTION_COLL_FACTORS:
+        raise ValueError(
+            f"policy action retargets unknown op {coll!r} (arm {arm!r}) "
+            f"— not in the decided dispatch vocabulary "
+            f"{tuple(sorted(_ACTION_COLL_FACTORS))}")
+    n = max(int(ndev), 2)
+    esize = int(np.dtype(dtype).itemsize)
+    native = int(round(_ACTION_COLL_FACTORS[coll](n) * int(nbytes)))
+    wire = native
+    quant_ratio = None
+    if arm in ("quant", "hier+quant"):
+        qcoll = _QUANTIZABLE.get(coll)
+        if qcoll is None:
+            raise ValueError(
+                f"policy action retargets {coll!r} to arm {arm!r} but "
+                f"{coll!r} has no quantized wire format "
+                f"(quantizable: {tuple(sorted(_QUANTIZABLE))})")
+        from ..coll.quant import wire_bytes
+        wb = wire_bytes(qcoll, max(int(nbytes) // esize, 1), n, dtype)
+        wire, native = int(wb["quant_bytes"]), int(wb["native_bytes"])
+        quant_ratio = round(float(wb["ratio"]), 4)
+    return {"coll": coll, "arm": arm, "ndev": n, "nbytes": int(nbytes),
+            "predicted_wire_bytes": wire, "native_wire_bytes": native,
+            "quant_ratio": quant_ratio, "ok": True}

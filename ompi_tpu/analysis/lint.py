@@ -38,6 +38,12 @@ Rule catalog (docs/static-analysis.md has the long rationale):
   — the trace analyzer's drift check parses these prefixes.
 * **CL006** one-sided window put/accumulate outside an RMA epoch — no
   completion or ordering guarantee without fence/lock/PSCW.
+* **CL007** the policy-plane attribution contract: every
+  ``trace.decision(...)`` audit-event constructor must thread a
+  ``verdict=`` cause (``verdict=None`` is the explicit operator-forced
+  spelling), and every sentry verdict dict must carry ``plane`` and
+  ``severity`` keys — an unattributed decision or an envelope-less
+  verdict is invisible to ``comm_doctor --policy``.
 """
 
 from __future__ import annotations
@@ -55,6 +61,8 @@ RULES: Dict[str, str] = {
     "CL004": "disabled-path guard does more than one attribute read",
     "CL005": "decision reason outside the audited grammar",
     "CL006": "one-sided window op reachable outside an RMA epoch",
+    "CL007": "decision without a verdict= cause / verdict without "
+             "plane+severity",
 }
 
 _HINTS: Dict[str, str] = {
@@ -77,6 +85,11 @@ _HINTS: Dict[str, str] = {
     "CL006": "open an epoch first (fence / lock / lock_all / start+post) "
              "— a one-sided op outside an epoch has no completion or "
              "ordering guarantee",
+    "CL007": "thread the causing verdict through the audit event "
+             "(verdict=<cause>, or the explicit verdict=None for an "
+             "operator-forced decision), and give every sentry verdict "
+             "dict the bus envelope keys 'plane' and 'severity' — "
+             "comm_doctor --policy renders only attributed decisions",
 }
 
 # -- CL001 vocabulary --------------------------------------------------------
@@ -119,13 +132,21 @@ _CL002_ENGINE_SUFFIXES = ("ompi_tpu/trace/__init__.py",)
 
 # -- CL004 vocabulary --------------------------------------------------------
 
-_PLANES = ("trace", "traffic", "perf", "numerics", "health")
+_PLANES = ("trace", "traffic", "perf", "numerics", "health", "policy")
 _PLANE_ENABLED_VARS = frozenset(f"{p}_enabled" for p in _PLANES)
 
 # -- CL005 vocabulary --------------------------------------------------------
 
 _REASON_PREFIXES = ("force:", "blanket:", "rule:", "floor:", "off:",
                     "ineligible:", "default:", "learned:")
+
+# -- CL007 vocabulary --------------------------------------------------------
+
+# the decision constructor's home (defines the signature, is not a call
+# site) and the engine that BUILDS the verdict= payload it threads
+_CL007_ENGINE_SUFFIXES = ("ompi_tpu/trace/__init__.py",)
+# names whose dict construction is held to the bus-envelope contract
+_CL007_VERDICT_NAMES = re.compile(r"(^|_)verdicts?$")
 
 # -- CL006 vocabulary --------------------------------------------------------
 
@@ -435,6 +456,53 @@ def _cl005(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _cl007(tree: ast.AST, path: str) -> List[Finding]:
+    if any(_norm(path).endswith(s) for s in _CL007_ENGINE_SUFFIXES):
+        return []
+    out = []
+
+    def _dict_keys(node) -> Optional[Set[str]]:
+        """Constant keys of a dict literal or dict(...) call, else None."""
+        if isinstance(node, ast.Dict):
+            return {k.value for k in node.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+        if isinstance(node, ast.Call) and _call_name(node) == "dict":
+            return {kw.arg for kw in node.keywords if kw.arg}
+        return None
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_name(node) == "decision":
+            chain = _attr_chain(node.func)
+            # only the audit constructor's spellings (trace.decision /
+            # _trace.decision); a different receiver is not the event
+            if chain.split(".")[0] not in ("trace", "_trace") \
+                    and chain != "decision":
+                continue
+            if not any(kw.arg == "verdict" for kw in node.keywords):
+                out.append(_finding(
+                    "CL007", path, node,
+                    "decision audit event without a verdict= cause — "
+                    "pass the causing verdict, or the explicit "
+                    "verdict=None for an operator-forced decision"))
+        elif isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if not any(_CL007_VERDICT_NAMES.search(n) for n in names):
+                continue
+            keys = _dict_keys(node.value)
+            if keys is None or "kind" not in keys:
+                continue              # not a sentry verdict construction
+            missing = [k for k in ("plane", "severity") if k not in keys]
+            if missing:
+                out.append(_finding(
+                    "CL007", path, node,
+                    f"sentry verdict dict missing the bus envelope "
+                    f"key(s) {missing} — every verdict must carry "
+                    "plane + severity for the policy bus"))
+    return out
+
+
 def _cl006(tree: ast.AST, path: str) -> List[Finding]:
     npath = _norm(path)
     if any(s in npath for s in _CL006_EXEMPT_SUFFIXES):
@@ -537,6 +605,7 @@ def lint_sources(src_by_path: Dict[str, str]) -> List[Finding]:
         findings += _cl004(tree, path)
         findings += _cl005(tree, path)
         findings += _cl006(tree, path)
+        findings += _cl007(tree, path)
     findings += _cl003(trees)
     findings = _apply_waivers(findings, src_by_path)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -564,7 +633,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(
-        description="repo-invariant comm-lint (rules CL001-CL006; "
+        description="repo-invariant comm-lint (rules CL001-CL007; "
                     "waive per line with '# comm-lint: disable=CLnnn "
                     "<why>')")
     ap.add_argument("paths", nargs="*", default=["ompi_tpu"])

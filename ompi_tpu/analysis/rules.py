@@ -37,11 +37,12 @@ MODES = ("native", "staged", "quant", "bidir", "hier", "hier+quant")
 # classify_axes split, incl. the topo_sim_dcn_axes override)
 PLANES = ("ici", "dcn")
 
-# provenance headers emitted by coll_tune (--device and --from-ledger):
-# a '# learned from ...' comment is a machine-written claim about where
+# provenance headers emitted by machine rule-writers (coll_tune
+# --device / --from-ledger, bench.py --selfdrive's policy plane): a
+# '# learned from ...' comment is a machine-written claim about where
 # the rows came from, so its shape is part of the file contract
 _PROVENANCE_PREFIX = "# learned from "
-_PROVENANCE_SOURCES = ("PERF_LEDGER",)
+_PROVENANCE_SOURCES = ("PERF_LEDGER", "policy")
 
 Row = Tuple[str, int, int, str]
 

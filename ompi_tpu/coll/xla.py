@@ -606,7 +606,7 @@ class XlaModule(CollModule):
                               "hier_inner_bytes": 2 * hier_split[2],
                               "hier_outer_bytes": hier_split[3]})
             trace.decision(
-                coll, arm=arm, reason=reason,
+                coll, arm=arm, reason=reason, verdict=None,
                 nbytes=nbytes, rank=getattr(ctx, "rank", 0),
                 shape_bucket=bucket, shape=tuple(x.shape),
                 dtype=str(x.dtype),

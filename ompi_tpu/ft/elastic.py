@@ -517,6 +517,7 @@ class ElasticTrainer:
             trace.decision(
                 "ft_recovery", arm="shrink",
                 reason=f"{verdict['kind']}:rank{dead_pos[0]}",
+                verdict=dict(verdict),
                 nbytes=moved, dead_rank=int(dead_pos[0]),
                 dead=sorted(dead_pos), survivors=rec["survivors"],
                 mesh_before=old_n, mesh_after=self.n,

@@ -220,6 +220,17 @@ def _trip(ctx, entries: List[registry.Entry], allow_raise: bool) -> None:
     if v is not None:
         text += "\n" + sentinel.format_verdict(v)
     output.verbose(1, "health", text)
+    from .. import policy
+    if policy.enabled:
+        policy.publish("health", "watchdog_trip", "error",
+                       evidence={"kind": "watchdog_trip",
+                                 "plane": "health", "severity": "error",
+                                 "rank": ctx.rank, "entry": oldest})
+        if v is not None and v.get("desync"):
+            policy.publish("health", "desync", "error",
+                           evidence={"kind": "desync", "plane": "health",
+                                     "severity": "error",
+                                     "rank": ctx.rank, "sentinel": v})
     _escalate(ctx, report, allow_raise)
 
 

@@ -343,8 +343,8 @@ def _audit_moe_coll(dc, coll: str, arm: str, reason: str, chain: List,
     if traffic.enabled:
         traffic.note_coll(dc, coll, arm, int(wire), weights=W, hier=None)
     if trace.enabled:
-        trace.decision(coll, arm=arm, reason=reason, nbytes=int(nbytes),
-                       dtype=str(dtype), ndev=dc.n,
+        trace.decision(coll, arm=arm, reason=reason, verdict=None,
+                       nbytes=int(nbytes), dtype=str(dtype), ndev=dc.n,
                        wire_bytes=int(wire), chain=list(chain), **extra)
 
 

@@ -28,8 +28,10 @@ Three coupled pieces:
   re-learns) and boosts the load-balance aux weight by
   ``moe_adapt_aux_boost`` (so the router actually re-learns), emitting
   exactly ONE audited ``moe_adapt`` decision event carrying the verdict
-  that caused it.  ``moe_block_ep`` reads the scales live through
-  ``capacity_factor(base)`` / ``aux_weight(base)``.
+  that caused it.  The verdict rides the policy plane's bus
+  (``ompi_tpu/policy``) and the engine's builtin moe rule calls back
+  into :func:`apply_adaptation`; ``moe_block_ep`` reads the scales
+  live through ``capacity_factor(base)`` / ``aux_weight(base)``.
 
 All entry points are behind ONE ``moe.enabled`` attribute read — the
 same disabled-path bar as trace/health/perf/traffic.
@@ -154,7 +156,8 @@ class HotExpertSentry:
             if hot and not self._hot.get(he):
                 self._hot[he] = True
                 self._trips += 1
-                verdict = {"kind": "hot_expert", "expert": he,
+                verdict = {"kind": "hot_expert", "plane": "moe",
+                           "severity": "warn", "expert": he,
                            "tokens": int(hb), "median_tokens": int(med),
                            "ratio": round(hb / max(med, 1.0), 2),
                            "mad_tokens": int(mad),
@@ -227,25 +230,34 @@ def note_routing(expert_load: Sequence[int], routed: Optional[int] = None,
             _expert_load[e] = _expert_load.get(e, 0) + v
     verdict = sentry.check(loads, step=this_step)
     if verdict is not None:
-        _maybe_adapt(verdict, this_step)
+        # the observe->decide->act hop now rides the policy plane: the
+        # verdict goes onto the bus and the engine's builtin moe rule
+        # routes it back through apply_adaptation with ONE audited
+        # decide:moe_adapt event naming this verdict as the cause
+        from . import policy
+        policy.publish("moe", "hot_expert", "warn", evidence=verdict,
+                       step=this_step)
     return verdict
 
 
-def _maybe_adapt(verdict: Dict[str, Any], step: int) -> None:
-    """One audited adaptation per verdict, gated by the cooldown window
+def apply_adaptation(verdict: Dict[str, Any],
+                     step: int) -> Optional[Dict[str, Any]]:
+    """The act half of the hot-expert loop, called by the policy
+    engine's moe rule.  Grows the live capacity/aux scales and banks
+    the adaptation event, or returns None inside the cooldown window
     (the hysteresis half of 'can't flap' — the sentry's episode re-arm
-    is the other half)."""
+    is the other half).  The window lives HERE, against state
+    ``reset()`` clears, so the absorbed loop stays exactly PR 14's."""
     global _cf_scale, _aux_scale, _last_adapt_step
     growth = float(_var.get("moe_adapt_growth", 1.25))
     max_cf = float(_var.get("moe_adapt_max_cf", 4.0))
     boost = float(_var.get("moe_adapt_aux_boost", 2.0))
     cooldown = int(_var.get("moe_adapt_cooldown", 4))
-    event = None
     with _lock:
         if (_last_adapt_step is not None
                 and step - _last_adapt_step < max(cooldown, 1)):
-            return                      # inside the hysteresis window
-        _last_adapt_step = step
+            return None                 # inside the hysteresis window
+        _last_adapt_step = int(step)
         _cf_scale = _cf_scale * max(growth, 1.0)
         _aux_scale = min(_aux_scale * max(boost, 1.0), _AUX_SCALE_CAP)
         event = {"step": int(step), "expert": verdict["expert"],
@@ -257,14 +269,7 @@ def _maybe_adapt(verdict: Dict[str, Any], step: int) -> None:
         _adaptations.append(event)
         if len(_adaptations) > 64:
             del _adaptations[:len(_adaptations) - 64]
-    from . import trace
-    if trace.enabled:
-        # ONE audited decision event per adaptation — the observe→act
-        # hop, same vocabulary as the coll arm decisions
-        trace.decision("moe_adapt", arm=f"cf_scale={event['cf_scale']}",
-                       reason=event["reason"], nbytes=0,
-                       step=event["step"], expert=event["expert"],
-                       aux_scale=event["aux_scale"])
+    return event
 
 
 def capacity_factor(base: float) -> float:

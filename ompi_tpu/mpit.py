@@ -13,7 +13,13 @@ pvars  — performance variables: the SPC counters (spc.py) of a Context plus
 categories — frameworks with their components, variables and descriptions.
 
 The tpu_info tool (tools/tpu_info.py) and tests are the consumers; external
-tools get the same dicts via these functions.
+tools get the same dicts via these functions.  The policy plane
+(ompi_tpu/policy) is a cvar *writer*: every engine adaptation that
+retargets an arm or resizes a knob goes through :func:`cvar_write`, so
+a self-driving change is indistinguishable from an operator's MPI_T
+write — same precedence, same watch notifications — and the plane's
+verdict/decision counters surface as ``policy_*`` pvars like every
+other plane's.
 """
 
 from __future__ import annotations

@@ -78,7 +78,8 @@ class NonfiniteSentry:
             self._tripped[op] = True
             self._trips += 1
             verdict = {
-                "kind": "nonfinite", "op": op, "step": int(step),
+                "kind": "nonfinite", "plane": "numerics",
+                "severity": "error", "op": op, "step": int(step),
                 "arm": arm,
                 # the attribution: the FIRST rank whose input was
                 # already corrupt — or, when every input was clean, the
@@ -95,6 +96,10 @@ class NonfiniteSentry:
         from .. import trace
         if trace.enabled:                        # outside the lock
             trace.instant("numerics_nonfinite", "numerics", args=verdict)
+        from .. import policy
+        if policy.enabled:
+            policy.publish("numerics", "nonfinite", "error",
+                           evidence=verdict, step=verdict["step"])
         return verdict
 
     def trips(self) -> int:
@@ -178,7 +183,8 @@ class SnrSentry:
                 return None
             self._tripped = True
             self._trips += 1
-            verdict = {"kind": "quant_snr", "coll": coll,
+            verdict = {"kind": "quant_snr", "plane": "numerics",
+                       "severity": "warn", "coll": coll,
                        "snr_db": round(db, 2), "block": int(block),
                        "baseline_p50": round(base["p50"], 2),
                        "z": round(z, 2), "sustained": self._streak}
@@ -189,6 +195,10 @@ class SnrSentry:
         if trace.enabled:                        # outside the lock
             trace.instant("numerics_snr_regression", "numerics",
                           args=verdict)
+        from .. import policy
+        if policy.enabled:
+            policy.publish("numerics", "quant_snr", "warn",
+                           evidence=verdict)
         return verdict
 
     def last_db(self) -> float:

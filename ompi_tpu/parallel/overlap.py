@@ -203,7 +203,7 @@ def _decide_buckets(plan: BucketPlan, ndev: int, platform: str,
                 details.update(grad_bucket_span_args(
                     b.nbytes, ndev, np.float32, block))
             trace.decision("grad_sync", arm=arm, reason=reason,
-                           nbytes=b.nbytes, **details)
+                           verdict=None, nbytes=b.nbytes, **details)
     _PVARS["grad_bucket_count"] = plan.n_buckets
     _PVARS["grad_bucket_bytes"] = plan.total_bytes
     return tuple(arms)
@@ -498,7 +498,7 @@ def decide_collmm(kind: str, nbytes: int, mesh: Mesh, axis: str,
         "collmm", int(nbytes), n, _mesh_platform(mesh),
         _xla._load_device_rules(), allowed, quant_ok=False)
     if trace.enabled:
-        trace.decision("collmm", arm=arm, reason=reason,
+        trace.decision("collmm", arm=arm, reason=reason, verdict=None,
                        nbytes=int(nbytes), ndev=n, op_kind=kind,
                        chain=list(chain))
     return arm

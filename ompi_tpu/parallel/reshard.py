@@ -612,7 +612,8 @@ class Resharder:
                              planes=planes)
         if trace.enabled:
             trace.decision(
-                "reshard", arm=arm, reason=reason, nbytes=wire,
+                "reshard", arm=arm, reason=reason, verdict=None,
+                nbytes=wire,
                 step=i, step_op=step.describe(), plan=plan.label,
                 plan_steps=len(plan.steps), peak_bytes=plan.peak_bytes,
                 bound_bytes=plan.bound_bytes, ndev=ndev,
@@ -1151,7 +1152,8 @@ def cross_reshard(x: jax.Array, dst: NamedSharding, *,
     step_op = plan.describe()[0]
     if trace.enabled:
         trace.decision(
-            "reshard", arm=arm, reason=reason, nbytes=int(wire),
+            "reshard", arm=arm, reason=reason, verdict=None,
+            nbytes=int(wire),
             step=0, step_op=step_op, plan=plan.label, plan_steps=1,
             peak_bytes=plan.peak_bytes, bound_bytes=plan.bound_bytes,
             ndev=plan.n_src, wire_bytes=int(wire), chain=chain,

@@ -127,7 +127,9 @@ class Sentry:
                 return None
             self._tripped[key] = True
             self._trips += 1
-            verdict = dict(detail, baseline_p50=round(base["p50"], 3),
+            verdict = dict(detail, kind="perf_regression", plane="perf",
+                           severity="warn",
+                           baseline_p50=round(base["p50"], 3),
                            baseline_mean=round(base["mean"], 3),
                            z=round(z, 2), sustained=self._streak[key])
             self._verdicts.append(verdict)
@@ -137,6 +139,10 @@ class Sentry:
         from .. import trace
         if trace.enabled:
             trace.instant("perf_regression", "perf", args=verdict)
+        from .. import policy
+        if policy.enabled:
+            policy.publish("perf", "perf_regression", "warn",
+                           evidence=verdict)
         return verdict
 
     # ---- queries ---------------------------------------------------

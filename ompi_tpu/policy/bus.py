@@ -1,0 +1,94 @@
+"""The verdict bus — one registry for every sentry's trip.
+
+Before this plane each sentry invented its own report shape (the perf
+sentry's ``dict(detail, ...)``, the traffic sentry's hotlink rows, the
+moe plane's hot-expert dicts) and each consumer re-learned each shape.
+The bus normalizes the *envelope* without touching the evidence: a
+:class:`Verdict` is ``{plane, kind, severity, evidence, step}`` where
+``evidence`` is the sentry's own verdict dict, verbatim.  Publishing
+is cheap (ring append + one trace instant + subscriber dispatch) and
+trips are rare, so the bus sits outside every hot path.
+
+Severity vocabulary is fixed: ``info`` < ``warn`` < ``error`` — rules
+filter on it, the doctor sorts on it.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+SEVERITIES = ("info", "warn", "error")
+_SEV_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+_RING_CAP = 64
+
+
+def severity_rank(severity: str) -> int:
+    """Position in the fixed severity order (unknown severities judge
+    as ``info`` so a typo can never outrank a real error)."""
+    return _SEV_RANK.get(severity, 0)
+
+
+@dataclass(frozen=True)
+class Verdict:
+    """One sentry trip in the fleet-wide envelope."""
+    plane: str                      # publishing plane: perf/traffic/...
+    kind: str                       # sentry grammar: perf_regression/...
+    severity: str                   # info | warn | error
+    evidence: Dict[str, Any] = field(default_factory=dict)
+    step: Optional[int] = None
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"plane": self.plane, "kind": self.kind,
+                "severity": self.severity, "step": self.step,
+                "evidence": dict(self.evidence)}
+
+
+class VerdictBus:
+    """Ring of recent verdicts + subscriber fan-out (the engine is the
+    one standing subscriber; tests may add more)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._ring: List[Verdict] = []
+        self._count = 0
+        self._subs: List[Callable[[Verdict], None]] = []
+
+    def subscribe(self, fn: Callable[[Verdict], None]) -> None:
+        with self._lock:
+            if fn not in self._subs:
+                self._subs.append(fn)
+
+    def unsubscribe(self, fn: Callable[[Verdict], None]) -> None:
+        with self._lock:
+            if fn in self._subs:
+                self._subs.remove(fn)
+
+    def publish(self, verdict: Verdict) -> Verdict:
+        with self._lock:
+            self._count += 1
+            self._ring.append(verdict)
+            if len(self._ring) > _RING_CAP:
+                del self._ring[:len(self._ring) - _RING_CAP]
+            subs = list(self._subs)
+        from .. import trace
+        if trace.enabled:               # outside the lock (ring has its own)
+            trace.instant("policy_verdict", "policy",
+                          args=verdict.as_dict())
+        for fn in subs:
+            fn(verdict)
+        return verdict
+
+    def verdicts(self) -> List[Verdict]:
+        with self._lock:
+            return list(self._ring)
+
+    def count(self) -> int:
+        return self._count
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._count = 0

@@ -1,0 +1,394 @@
+"""The policy engine — declarative rules from verdicts to adaptations.
+
+Three structural guarantees, each enforced here rather than hoped for:
+
+* **Pre-verified action space** — at CONSTRUCTION every arm a rule can
+  reach goes through ``analysis.commgraph.verify_action`` and every
+  cvar an action writes is looked up in the registry; an unverifiable
+  action raises :class:`ActionVeto` at registration, never at 3 a.m.
+* **Fleet consistency** — with a control-plane context the engine
+  votes before acting (the numerics auditor's out-of-band pattern):
+  every rank publishes its proposal, gathers the peers', majority
+  rules, and the agreed switch step is a pure function of the gathered
+  set — so every rank flips the arm on the SAME step and an adaptation
+  that would desync SPMD is structurally impossible.  Without a
+  context the vote degenerates to a recorded local round.
+* **One audited decision per adaptation** — each applied action emits
+  exactly one ``decide:<audit_op>`` event whose ``verdict=`` names the
+  causing verdict; the ledger keeps the full verdict -> vote ->
+  action -> effect row for ``comm_doctor --policy``.
+
+Cooldown hysteresis is per action: inside the window a matching
+verdict is ledgered as ``cooldown`` and nothing fires (the sentries'
+one-trip-per-episode re-arm is the other half of "can't flap").  The
+MoE capacity action keeps its window inside the moe plane's own state
+(``moe_adapt_cooldown`` against ``moe.reset()``-cleared state) so the
+absorbed PR 14 loop behaves bit-for-bit as before.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from ..core import var as _var
+from .bus import Verdict, severity_rank
+
+_LEDGER_CAP = 128
+
+
+class ActionVeto(ValueError):
+    """An action failed static verification at engine construction."""
+
+
+@dataclass
+class Action:
+    """One adaptation from the fixed vocabulary.
+
+    ``apply(verdict, step)`` performs the state change and returns the
+    effect dict (``arm``/``reason`` feed the audit event; everything
+    else rides along as decision details), or None when the action
+    judged itself a no-op (e.g. the moe plane's own cooldown window).
+    ``colls`` x ``arm`` is the statically verified retarget surface:
+    apply may only touch those ops.  ``cvars`` are the control
+    variables the action writes — verified registered at construction.
+    """
+    name: str
+    apply: Callable[[Verdict, int], Optional[Dict[str, Any]]]
+    audit_op: str = "policy"
+    colls: Tuple[str, ...] = ()
+    arm: Optional[str] = None
+    cvars: Tuple[str, ...] = ()
+    cooldown: Union[int, Callable[[], int]] = 8
+    nbytes: int = 1 << 20               # payload for the wire prediction
+
+    def cooldown_steps(self) -> int:
+        cd = self.cooldown() if callable(self.cooldown) else self.cooldown
+        return int(cd)
+
+
+@dataclass
+class Rule:
+    """Declarative verdict filter -> action binding."""
+    name: str
+    action: Action
+    plane: Optional[str] = None         # None matches any plane
+    kind: Optional[str] = None          # None matches any kind
+    min_severity: str = "info"
+    enabled: Callable[[], bool] = field(default=lambda: True)
+
+    def matches(self, v: Verdict) -> bool:
+        if self.plane is not None and v.plane != self.plane:
+            return False
+        if self.kind is not None and v.kind != self.kind:
+            return False
+        return severity_rank(v.severity) >= severity_rank(self.min_severity)
+
+
+class PolicyEngine:
+    """Rules + vote + audited apply.  One instance per process in the
+    default wiring; tests build one per simulated rank."""
+
+    def __init__(self, rules: Sequence[Rule], ctx: Any = None) -> None:
+        self.ctx = ctx
+        self.rank = int(getattr(ctx, "rank", 0))
+        self.nranks = int(getattr(ctx, "size", 1))
+        self.rules: List[Rule] = []
+        self.verified: Dict[str, List[Dict[str, Any]]] = {}
+        self._lock = threading.Lock()
+        self._ledger: List[Dict[str, Any]] = []
+        self._pending: List[Dict[str, Any]] = []
+        self._last_applied: Dict[str, int] = {}
+        self._vote_round = 0
+        self._decisions = 0
+        for r in rules:
+            self.register(r)
+
+    # ---- registration: the pre-verified action space ----------------
+
+    def register(self, rule: Rule) -> None:
+        from ..analysis import commgraph
+        act = rule.action
+        reports = []
+        if act.arm is not None and not act.colls:
+            raise ActionVeto(
+                f"policy rule {rule.name!r}: action {act.name!r} names "
+                f"arm {act.arm!r} but no target ops — an arm retarget "
+                "with no verified coll surface is unverifiable")
+        for coll in act.colls:
+            try:
+                reports.append(commgraph.verify_action(
+                    coll, act.arm or "native", nbytes=act.nbytes))
+            except ValueError as exc:
+                raise ActionVeto(
+                    f"policy rule {rule.name!r}: action {act.name!r} "
+                    f"REJECTED at registration — {exc}") from exc
+        for cv in act.cvars:
+            if _var.registry.lookup(cv) is None:
+                raise ActionVeto(
+                    f"policy rule {rule.name!r}: action {act.name!r} "
+                    f"writes unregistered cvar {cv!r} — REJECTED at "
+                    "registration")
+        self.rules.append(rule)
+        self.verified[act.name] = reports
+
+    # ---- the observe -> decide hop ----------------------------------
+
+    def consider(self, verdict: Verdict) -> List[Dict[str, Any]]:
+        """Route one verdict through the rules; returns the new ledger
+        rows (applied, scheduled, cooldown or vote_failed)."""
+        rows: List[Dict[str, Any]] = []
+        step = int(verdict.step or 0)
+        for rule in self.rules:
+            if not rule.enabled() or not rule.matches(verdict):
+                continue
+            act = rule.action
+            cd = act.cooldown_steps()
+            with self._lock:
+                last = self._last_applied.get(act.name)
+            if cd > 0 and last is not None and step - last < cd:
+                rows.append(self._ledger_row(
+                    rule, verdict, step, outcome="cooldown", vote=None,
+                    effect={"last_applied_step": last, "cooldown": cd}))
+                continue
+            vote = self._vote(rule, verdict, step)
+            if not vote["passed"]:
+                rows.append(self._ledger_row(
+                    rule, verdict, step, outcome="vote_failed",
+                    vote=vote, effect=None))
+                continue
+            if self.ctx is None or self.nranks <= 1:
+                rows.append(self._apply(rule, verdict, vote, step))
+            else:
+                with self._lock:
+                    self._pending.append({"rule": rule, "verdict": verdict,
+                                          "vote": vote})
+                rows.append(self._ledger_row(
+                    rule, verdict, step, outcome="scheduled", vote=vote,
+                    effect={"switch_step": vote["switch_step"]}))
+        return rows
+
+    def tick(self, step: int) -> List[Dict[str, Any]]:
+        """Apply every fleet-scheduled action whose agreed switch step
+        has arrived.  Call once per training step (cheap: one lock +
+        list scan; empty in the common case)."""
+        step = int(step)
+        with self._lock:
+            due = [p for p in self._pending
+                   if p["vote"]["switch_step"] <= step]
+            self._pending = [p for p in self._pending
+                             if p["vote"]["switch_step"] > step]
+        return [self._apply(p["rule"], p["verdict"], p["vote"],
+                            p["vote"]["switch_step"]) for p in due]
+
+    # ---- fleet vote (the numerics auditor's out-of-band pattern) ----
+
+    def _vote(self, rule: Rule, verdict: Verdict,
+              step: int) -> Dict[str, Any]:
+        with self._lock:
+            self._vote_round += 1
+            rnd = self._vote_round
+        act = rule.action
+        if self.ctx is None or self.nranks <= 1:
+            return {"round": rnd, "mode": "local", "yes": 1,
+                    "missing": [], "passed": True, "switch_step": step}
+        timeout = float(_var.get("policy_vote_timeout", 5.0))
+        lead = int(_var.get("policy_vote_lead", 2))
+        key = f"policy:vote:{rnd}:{rule.name}"
+        mine = {"rank": self.rank, "step": step, "action": act.name,
+                "arm": act.arm}
+        try:
+            # a dead control plane must never take down the step
+            self.ctx.bootstrap.put(key, json.dumps(mine, sort_keys=True))
+        except Exception:
+            pass
+        proposals: Dict[int, Dict[str, Any]] = {self.rank: mine}
+        missing: List[int] = []
+        for peer in range(self.nranks):
+            if peer == self.rank:
+                continue
+            try:
+                doc = json.loads(self.ctx.bootstrap.get(
+                    peer, key, timeout=timeout))
+                proposals[peer] = doc
+            except Exception:
+                missing.append(peer)
+        yes = sum(1 for p in proposals.values()
+                  if p.get("action") == act.name
+                  and p.get("arm") == act.arm)
+        passed = yes * 2 > self.nranks
+        # the agreed switch step is a pure function of the gathered
+        # set — max proposed step + lead — so every rank that saw the
+        # same votes flips on the SAME step
+        switch = max(int(p.get("step", step))
+                     for p in proposals.values()) + max(lead, 0)
+        return {"round": rnd, "mode": "fleet", "yes": yes,
+                "missing": missing, "passed": passed,
+                "switch_step": switch}
+
+    # ---- the decide -> act hop --------------------------------------
+
+    def _apply(self, rule: Rule, verdict: Verdict,
+               vote: Dict[str, Any], step: int) -> Dict[str, Any]:
+        act = rule.action
+        effect = act.apply(verdict, step)
+        if effect is None:
+            return self._ledger_row(rule, verdict, step, outcome="noop",
+                                    vote=vote, effect=None)
+        with self._lock:
+            self._last_applied[act.name] = step
+            self._decisions += 1
+        row = self._ledger_row(rule, verdict, step, outcome="applied",
+                               vote=vote, effect=effect)
+        arm = str(effect.get("arm") or act.arm or act.name)
+        reason = str(effect.get("reason")
+                     or f"rule:{rule.name}:{verdict.plane}/{verdict.kind}")
+        details = {k: v for k, v in effect.items()
+                   if k not in ("arm", "reason", "nbytes")}
+        from .. import trace
+        if trace.enabled:
+            # exactly ONE audited decision per adaptation, naming the
+            # causing verdict — the observe->decide->act hop
+            trace.decision(act.audit_op, arm=arm, reason=reason,
+                           nbytes=int(effect.get("nbytes", 0)),
+                           verdict={"plane": verdict.plane,
+                                    "kind": verdict.kind,
+                                    "severity": verdict.severity,
+                                    "step": verdict.step},
+                           **details)
+        return row
+
+    def _ledger_row(self, rule: Rule, verdict: Verdict, step: int,
+                    outcome: str, vote: Optional[Dict[str, Any]],
+                    effect: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        row = {"step": int(step), "rule": rule.name,
+               "action": rule.action.name,
+               "audit_op": rule.action.audit_op, "outcome": outcome,
+               "verdict": verdict.as_dict(), "vote": vote,
+               "effect": effect}
+        with self._lock:
+            self._ledger.append(row)
+            if len(self._ledger) > _LEDGER_CAP:
+                del self._ledger[:len(self._ledger) - _LEDGER_CAP]
+        return row
+
+    # ---- queries ----------------------------------------------------
+
+    def ledger(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(r) for r in self._ledger]
+
+    def decisions(self) -> int:
+        return self._decisions
+
+    def vote_rounds(self) -> int:
+        return self._vote_round
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ledger.clear()
+            self._pending.clear()
+            self._last_applied.clear()
+            self._vote_round = 0
+            self._decisions = 0
+
+
+# -- the builtin vocabulary ---------------------------------------------------
+
+def _set_arm(colls: Tuple[str, ...], arm: str
+             ) -> Callable[[Verdict, int], Optional[Dict[str, Any]]]:
+    def apply(verdict: Verdict, step: int) -> Optional[Dict[str, Any]]:
+        coll = str(verdict.evidence.get("coll") or colls[0])
+        if coll not in colls:
+            return None                 # outside the verified surface
+        cvar = f"coll_xla_{coll}_mode"
+        prev = _var.get(cvar, "")
+        if prev == arm:
+            return None                 # already there: no flap
+        from .. import mpit
+        mpit.cvar_write(cvar, arm)      # the MPI_T-sanctioned write path
+        return {"arm": arm, "coll": coll, "cvar": cvar,
+                "prev": prev, "step": step}
+    return apply
+
+
+def _halve_cvar(cvar: str, floor: int
+                ) -> Callable[[Verdict, int], Optional[Dict[str, Any]]]:
+    def apply(verdict: Verdict, step: int) -> Optional[Dict[str, Any]]:
+        cur = int(_var.get(cvar, 0) or 0)
+        new = max(cur // 2, floor)
+        if new >= cur:
+            return None                 # already at the floor
+        from .. import mpit
+        mpit.cvar_write(cvar, new)      # the MPI_T-sanctioned write path
+        return {"cvar": cvar, "prev": cur, "value": new, "step": step}
+    return apply
+
+
+def _moe_apply(verdict: Verdict, step: int) -> Optional[Dict[str, Any]]:
+    from .. import moe
+    event = moe.apply_adaptation(verdict.evidence, step)
+    if event is None:
+        return None                     # inside the moe cooldown window
+    return {"arm": f"cf_scale={event['cf_scale']}",
+            "reason": event["reason"], "step": event["step"],
+            "expert": event["expert"], "cf_scale": event["cf_scale"],
+            "aux_scale": event["aux_scale"]}
+
+
+def builtin_rules() -> List[Rule]:
+    """The default observe->act wiring: one rule per closed loop.
+
+    The moe rule is live whenever its plane is (its verdicts only
+    exist when ``moe.enabled``); the rest act only when the policy
+    plane itself is enabled — publishing stays observability-only
+    until the operator opts into self-driving.
+    """
+    from .. import policy as _p
+
+    def _pol() -> bool:
+        return _p.enabled
+
+    demote_cd = lambda: int(_var.get("policy_cooldown", 8))  # noqa: E731
+    return [
+        Rule(name="moe_hot_expert", plane="moe", kind="hot_expert",
+             min_severity="warn",
+             action=Action(
+                 name="moe_capacity", apply=_moe_apply,
+                 audit_op="moe_adapt", cooldown=0)),
+        Rule(name="perf_demote_quant", plane="perf",
+             kind="perf_regression", min_severity="warn", enabled=_pol,
+             action=Action(
+                 name="demote_arm_quant",
+                 apply=_set_arm(("allreduce", "grad_sync",
+                                 "reduce_scatter", "allgather"), "quant"),
+                 colls=("allreduce", "grad_sync", "reduce_scatter",
+                        "allgather"),
+                 arm="quant", cooldown=demote_cd)),
+        Rule(name="snr_shrink_block", plane="numerics", kind="quant_snr",
+             min_severity="warn", enabled=_pol,
+             action=Action(
+                 name="shrink_quant_block",
+                 apply=_halve_cvar("coll_quant_block", 32),
+                 cvars=("coll_quant_block",), cooldown=demote_cd)),
+        Rule(name="hotlink_redirect_ring", plane="traffic",
+             kind="hotlink", min_severity="warn", enabled=_pol,
+             action=Action(
+                 name="redirect_ring_bidir",
+                 apply=_set_arm(("allreduce",), "bidir"),
+                 colls=("allreduce",), arm="bidir",
+                 cooldown=demote_cd)),
+        Rule(name="straggler_shrink_buckets", plane="trace",
+             kind="straggler", min_severity="warn", enabled=_pol,
+             action=Action(
+                 name="resize_grad_bucket",
+                 apply=_halve_cvar("coll_xla_grad_bucket_bytes", 1 << 20),
+                 cvars=("coll_xla_grad_bucket_bytes",),
+                 cooldown=demote_cd)),
+    ]

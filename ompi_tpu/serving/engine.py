@@ -251,7 +251,8 @@ def _audit_serve_coll(dc, coll: str, arm: str, reason: str,
         traffic.note_coll(dc, coll, arm, int(wire))
     if trace.enabled:
         bucket = 1 << max(int(nbytes) - 1, 0).bit_length()
-        trace.decision(coll, arm=arm, reason=reason, nbytes=int(nbytes),
+        trace.decision(coll, arm=arm, reason=reason, verdict=None,
+                       nbytes=int(nbytes),
                        shape_bucket=bucket, shape=tuple(x.shape),
                        dtype=str(x.dtype), ndev=dc.n,
                        wire_bytes=int(wire), quant_ratio=ratio,
@@ -513,6 +514,7 @@ class ServingEngine:
         if trace.enabled:
             bucket = 1 << max(int(payload) - 1, 0).bit_length()
             trace.decision("decode_collmm", arm=arm, reason=reason,
+                           verdict=None,
                            nbytes=int(payload), shape_bucket=bucket,
                            shape=(rows // dc.n, self.cfg.d_model),
                            dtype=str(self.cfg.dtype), ndev=dc.n,

@@ -101,6 +101,14 @@ COUNTERS = [
     ("moe_hot_expert_trips",
      "hot-expert sentry trips (one expert carrying disproportionate "
      "token load)"),
+    # policy plane (fed by ompi_tpu/policy; process-wide)
+    ("policy_verdicts",
+     "sentry verdicts published onto the policy plane's bus"),
+    ("policy_decisions",
+     "adaptations applied by the policy engine (each an audited "
+     "decide event naming its causing verdict)"),
+    ("policy_vote_rounds",
+     "fleet consistency vote rounds run by the policy engine"),
     # elastic recovery plane (fed by ompi_tpu/ft/elastic; process-wide)
     ("ft_recoveries",
      "completed elastic recoveries (trip -> shrink -> reshard -> resume)"),
@@ -179,6 +187,10 @@ class Counters:
             from . import moe
             if name in moe.PVARS:
                 return moe.pvar_value(name)
+        if name.startswith("policy_"):
+            from . import policy
+            if name in policy.PVARS:
+                return policy.pvar_value(name)
         if name.startswith("serve_"):
             from . import serving
             if name in serving.PVARS:
@@ -209,6 +221,9 @@ class Counters:
         from . import moe
         for name in moe.PVARS:
             out[name] = moe.pvar_value(name)
+        from . import policy
+        for name in policy.PVARS:
+            out[name] = policy.pvar_value(name)
         from . import serving
         for name in serving.PVARS:
             out[name] = serving.pvar_value(name)

@@ -39,8 +39,10 @@ from ..trace import merge as _merge
 # 8 = the MoE routing-plane section, ISSUE 14;
 # 9 = the serving-plane section, ISSUE 15;
 # 10 = the decode fast path: speculative accept/reject ledger +
-#      fused-vs-eager dispatch counts in --serve, ISSUE 16)
-SCHEMA_VERSION = 10
+#      fused-vs-eager dispatch counts in --serve, ISSUE 16;
+# 11 = the policy-plane section: verdict->vote->action->effect
+#      ledger with attribution, ISSUE 17)
+SCHEMA_VERSION = 11
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -664,6 +666,71 @@ def build_serve_report(
     return "\n".join(lines), rep
 
 
+def build_policy_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the policy plane: published
+    verdicts, the registered (statically pre-verified) rule table, and
+    the verdict->vote->action->effect ledger with its attribution
+    percentage.  ``path`` loads a banked POLICY json (bench.py
+    --selfdrive); default reads the live in-process plane."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from .. import policy as _policy
+        rep = _policy.report()
+    lines: List[str] = []
+    w = lines.append
+    src = f" (from {path})" if path else ""
+    w(f"policy: {'enabled' if rep.get('enabled') else 'disabled'}, "
+      f"{int(rep.get('verdicts_published', 0))} verdict(s) published, "
+      f"{int(rep.get('decisions_applied', 0))} adaptation(s) applied, "
+      f"{int(rep.get('vote_rounds', 0))} vote round(s){src}")
+    w(f"  attribution: {float(rep.get('attribution_pct', 100.0)):.1f}% "
+      "of applied actions name their causing verdict"
+      + (f" ({int(rep.get('unattributed', 0))} unattributed)"
+         if int(rep.get("unattributed", 0)) else ""))
+    rules = rep.get("rules") or []
+    if rules:
+        w(f"  rule table ({len(rules)} rule(s), every reachable arm "
+          "statically pre-verified at registration):")
+        for r in sorted(rules, key=lambda r: str(r.get("rule"))):
+            scope = f"{r.get('plane') or '*'}/{r.get('kind') or '*'}"
+            reports = r.get("verified") or []
+            pred = ""
+            if reports:
+                v0 = reports[0]
+                pred = (f"  wire {int(v0.get('predicted_wire_bytes', 0))}B"
+                        f"/{int(v0.get('native_wire_bytes', 0))}B native")
+            w(f"    {r.get('rule'):<24} on {scope:<24} "
+              f"-> {r.get('action')}{pred}")
+    for v in (rep.get("verdicts") or [])[-8:]:
+        w(f"  verdict step {v.get('step')}: [{v.get('severity')}] "
+          f"{v.get('plane')}/{v.get('kind')}")
+    ledger = rep.get("ledger") or []
+    if not ledger:
+        w("  ledger empty (no verdict has matched an enabled rule)")
+    for row in ledger[-10:]:
+        vd = row.get("verdict") or {}
+        vote = row.get("vote") or {}
+        eff = row.get("effect") or {}
+        cause = f"{vd.get('plane')}/{vd.get('kind')}"
+        votestr = ""
+        if vote:
+            votestr = (f"  vote r{vote.get('round')} "
+                       f"{int(vote.get('yes', 0))}y "
+                       f"-> step {vote.get('switch_step')}")
+        effstr = ""
+        if eff:
+            effstr = f"  {eff.get('cvar') or eff.get('arm') or ''}"
+            if "prev" in eff:
+                effstr += f" {eff.get('prev')}->{eff.get('arm')}"
+        w(f"  step {row.get('step')}: {cause} => "
+          f"{row.get('rule')} [{row.get('outcome')}]{votestr}{effstr}")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -758,6 +825,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "With a path, loads a banked SERVE json "
                          "(bench.py --serve); bare flag reads the live "
                          "in-process plane")
+    ap.add_argument("--policy", nargs="?", const="", default=None,
+                    metavar="POLICY.json",
+                    help="render the policy-plane section: published "
+                         "verdicts, the pre-verified rule table and "
+                         "the verdict->vote->action->effect ledger "
+                         "with attribution. With a path, loads a "
+                         "banked POLICY json (bench.py --selfdrive); "
+                         "bare flag reads the live in-process plane")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -796,7 +871,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if (ns.perf or ns.traffic is not None or ns.numerics is not None
                 or ns.reshard is not None or ns.analyze is not None
                 or ns.ft is not None or ns.moe is not None
-                or ns.serve is not None):
+                or ns.serve is not None or ns.policy is not None):
             # plane sections render standalone (no merged timeline)
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
@@ -850,6 +925,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         stext, sdata = build_serve_report(ns.serve or None)
         text = (text + "\n" + stext) if text else stext
         data["serve"] = sdata
+    if getattr(ns, "policy", None) is not None:
+        ptext, pdata = build_policy_report(ns.policy or None)
+        text = (text + "\n" + ptext) if text else ptext
+        data["policy"] = pdata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

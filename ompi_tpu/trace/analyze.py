@@ -136,10 +136,22 @@ def entry_skew(tl: FleetTimeline, z_thresh: float = 2.5
             z = (m - med) / scale if scale > 0 else 0.0
             z_scores[r] = round(z, 3)
             # alignment-confidence gate: lateness within ±rtt/2 could be
-            # clock-sync residual, not a straggler
+            # clock-sync residual, not a straggler; a rank the merge
+            # could not align at all is never flagged — its "lateness"
+            # is its unshifted clock
             conf_us = tl.best_rtt.get(r, 0.0) / 2 * 1e6
-            if z >= z_thresh and m > conf_us:
+            if (z >= z_thresh and m > conf_us
+                    and r not in getattr(tl, "unaligned_ranks", ())):
                 flagged.append(r)
+    from .. import policy
+    if policy.enabled:
+        for r in flagged:
+            policy.publish("trace", "straggler", "warn",
+                           evidence={"kind": "straggler", "plane": "trace",
+                                     "severity": "warn", "rank": int(r),
+                                     "z": z_scores.get(r),
+                                     "lateness_us": round(mean_late[r], 3),
+                                     "z_thresh": z_thresh})
     return {"per_coll": per_coll,
             "rank_lateness_us": {r: round(v, 3)
                                  for r, v in sorted(mean_late.items())},
@@ -298,6 +310,7 @@ def analyze(tl: FleetTimeline, rules: Optional[str] = None,
             "offsets_s": {str(r): v for r, v in tl.offsets.items()},
             "confidence_us": {str(r): round(v / 2 * 1e6, 3)
                               for r, v in tl.best_rtt.items()},
+            "unaligned_ranks": list(getattr(tl, "unaligned_ranks", [])),
         },
         "entry_skew": entry_skew(tl, z_thresh=z_thresh),
         "latency": latency_histograms(tl),

@@ -52,6 +52,10 @@ class FleetTimeline:
     offsets: Dict[int, float] = field(default_factory=dict)
     best_rtt: Dict[int, float] = field(default_factory=dict)
     dropped: Dict[int, int] = field(default_factory=dict)
+    # ranks whose events are on their LOCAL clock because the (non-empty)
+    # offsets table had no entry for them — cross-rank skew touching one
+    # of these is alignment artifact, not evidence
+    unaligned_ranks: List[int] = field(default_factory=list)
 
     @property
     def ranks(self) -> List[int]:
@@ -83,6 +87,7 @@ class FleetTimeline:
             "clock_offsets_s": {str(r): v for r, v in self.offsets.items()},
             "best_rtt_s": {str(r): v for r, v in self.best_rtt.items()},
             "dropped_events": {str(r): v for r, v in self.dropped.items()},
+            "unaligned_ranks": list(self.unaligned_ranks),
         }
         with open(path, "w") as fh:
             json.dump(doc, fh)
@@ -96,8 +101,24 @@ def merge(per_rank: Dict[int, List[dict]],
     """Pure merge: shift every rank's events onto the rank-0 clock
     (``t - offsets[rank]``) and interleave into one sorted timeline.
     Events are copied — the caller's (and the live tracer's) dicts are
-    never mutated."""
+    never mutated.
+
+    A PARTIAL offsets table degrades loudly: ranks present in
+    ``per_rank`` but absent from a non-empty ``offsets`` stay on their
+    local clocks, are recorded in ``unaligned_ranks``, and an error is
+    printed — silently merging half-aligned clocks manufactures
+    stragglers out of alignment error.  An empty/absent table means "no
+    alignment attempted" (single-clock runs) and stays quiet."""
     offsets = dict(offsets or {})
+    unaligned = (sorted(r for r in per_rank if r not in offsets)
+                 if offsets else [])
+    if unaligned:
+        from ..core.output import output
+        output.error(
+            "trace",
+            f"merge: offsets table covers rank(s) {sorted(offsets)} but "
+            f"not {unaligned}; unaligned rank(s) stay on their local "
+            "clocks — cross-rank skew involving them is untrustworthy")
     aligned: List[dict] = []
     for rank, evs in per_rank.items():
         off = float(offsets.get(rank, 0.0))
@@ -109,7 +130,8 @@ def merge(per_rank: Dict[int, List[dict]],
     aligned.sort(key=lambda e: e["t"])
     return FleetTimeline(events=aligned, offsets=offsets,
                          best_rtt=dict(best_rtt or {}),
-                         dropped=dict(dropped or {}))
+                         dropped=dict(dropped or {}),
+                         unaligned_ranks=unaligned)
 
 
 # -- in-band gather over the comm --------------------------------------------

@@ -86,6 +86,7 @@ class HotlinkSentry:
                 self._trips += 1
                 verdict = {"kind": "hotlink", "src": hs, "dst": hd,
                            "bytes": int(hb), "plane": hplane,
+                           "severity": "warn",
                            "median_bytes": int(med),
                            "ratio": round(hb / max(med, 1.0), 2),
                            "mad_bytes": int(mad)}
@@ -93,6 +94,14 @@ class HotlinkSentry:
             pv = self._check_planes(edges, ratio, min_bytes)
         self._emit(verdict, "traffic_hotlink")
         self._emit(pv, "traffic_plane_imbalance")
+        from .. import policy
+        if policy.enabled:
+            if verdict is not None:
+                policy.publish("traffic", "hotlink", "warn",
+                               evidence=verdict)
+            if pv is not None:
+                policy.publish("traffic", "plane_imbalance", "warn",
+                               evidence=pv)
         return verdict
 
     def _check_planes(self, edges, ratio: float,
@@ -115,7 +124,8 @@ class HotlinkSentry:
         if self._plane_tripped:
             return None
         self._plane_tripped = True
-        verdict = {"kind": "plane_imbalance", "hot_plane": hi,
+        verdict = {"kind": "plane_imbalance", "plane": "traffic",
+                   "severity": "warn", "hot_plane": hi,
                    "mean_bytes": {p: int(m) for p, m in means.items()},
                    "ratio": round(means[hi] / max(means[lo], 1.0), 2)}
         self._bank(verdict)

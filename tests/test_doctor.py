@@ -445,3 +445,178 @@ def test_export_prometheus_bare_counters():
     text = spc.export_prometheus(c, comm="sub0", prefix="tpu")
     _assert_prometheus_grammar(text)
     assert 'tpu_isends{rank="0",comm="sub0"} 3' in text
+
+
+# ---------------------------------------------------------------------------
+# partial clock-offset tables: the merge must degrade LOUDLY (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _capture_output_stream():
+    """output._stream is bound at import (pytest's capture object), so
+    capsys/capfd never see it — swap in a StringIO for the assertion."""
+    import contextlib
+    import io
+    from ompi_tpu.core.output import output
+
+    @contextlib.contextmanager
+    def cm():
+        buf = io.StringIO()
+        prev = output._stream
+        output._stream = buf
+        try:
+            yield buf
+        finally:
+            output._stream = prev
+    return cm()
+
+
+def test_merge_partial_offsets_degrades_loudly():
+    """Ranks missing from a non-empty offsets table stay on their local
+    clocks, are recorded in unaligned_ranks, and an error is printed —
+    silently merging half-aligned clocks manufactures stragglers out of
+    alignment error."""
+    _synthetic_fleet(straggler=3, delay=8e-4)
+    per_rank = {r: trace.events(r) for r in range(4)}
+    t_orig = {r: [e["t"] for e in evs] for r, evs in per_rank.items()}
+    with _capture_output_stream() as buf:
+        tl = merge.merge(per_rank, offsets={0: 0.0, 1: -2e-3, 2: 1e-3})
+    assert tl.unaligned_ranks == [3]
+    err = buf.getvalue()
+    assert "offsets table covers rank(s) [0, 1, 2] but not [3]" in err
+    assert "local clocks" in err
+    # covered ranks shifted by their offset; the uncovered rank untouched
+    assert [e["t"] for e in tl.by_rank(1)] == pytest.approx(
+        [t + 2e-3 for t in sorted(t_orig[1])])
+    assert [e["t"] for e in tl.by_rank(3)] == pytest.approx(
+        sorted(t_orig[3]))
+
+
+def test_merge_empty_offsets_stays_quiet():
+    """An empty/absent table means 'no alignment attempted' (single-clock
+    runs) — no unaligned ranks, no error."""
+    _synthetic_fleet()
+    with _capture_output_stream() as buf:
+        tl = merge.merge({r: trace.events(r) for r in range(4)})
+        assert tl.unaligned_ranks == []
+        tl = merge.merge({r: trace.events(r) for r in range(4)}, offsets={})
+        assert tl.unaligned_ranks == []
+    assert "unaligned" not in buf.getvalue()
+
+
+def test_entry_skew_never_flags_unaligned_rank():
+    """A rank the merge could not align is never attributed as a
+    straggler — its 'lateness' is its unshifted clock."""
+    _synthetic_fleet(straggler=3, delay=8e-4)
+    tl = merge.merge({r: trace.events(r) for r in range(4)},
+                     offsets={0: 0.0, 1: 0.0, 2: 0.0})
+    sk = analyze.entry_skew(tl, z_thresh=2.0)
+    assert sk["flagged"] == []
+    assert sk["z_scores"][3] >= 2.0           # the z still reports it
+
+
+def test_load_chrome_partial_offsets_roundtrip(tmp_path):
+    """load_chrome dumps + a partial offsets table: unaligned_ranks
+    survives into analyze()'s alignment section and the merged Chrome
+    export's otherData."""
+    _synthetic_fleet(n_ranks=2, straggler=1, delay=8e-4)
+    paths = []
+    for r in range(2):
+        p = str(tmp_path / f"t.{r}.json")
+        trace.save_chrome(p, rank=r)
+        paths.append(p)
+    per = merge.load_chrome(paths)
+    assert set(per) == {0, 1}
+    with _capture_output_stream() as buf:
+        tl = merge.merge(per, offsets={0: 0.0})   # table misses rank 1
+    assert tl.unaligned_ranks == [1]
+    assert "not [1]" in buf.getvalue()
+    rep = analyze.analyze(tl, z_thresh=2.0)
+    assert rep["alignment"]["unaligned_ranks"] == [1]
+    assert rep["entry_skew"]["flagged"] == []
+    merged = str(tmp_path / "merged.json")
+    tl.save_chrome(merged)
+    assert json.load(open(merged))["otherData"]["unaligned_ranks"] == [1]
+
+
+# ---------------------------------------------------------------------------
+# comm_doctor --policy (schema v11, ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def test_comm_doctor_policy_banked_json_golden(tmp_path, capsys):
+    """--policy with a banked POLICY json (bench.py --selfdrive shape)
+    renders standalone and round-trips the report verbatim into the
+    structured output, under the v11 schema pin."""
+    report = {
+        "enabled": True, "verdicts_published": 2, "decisions_applied": 2,
+        "vote_rounds": 2, "pending": 0, "attribution_pct": 100.0,
+        "unattributed": 0,
+        "rules": [{"rule": "perf_demote_quant", "plane": "perf",
+                   "kind": "perf_regression", "min_severity": "warn",
+                   "action": "demote_arm_quant", "audit_op": "policy",
+                   "arm": "quant",
+                   "verified": [{"coll": "allreduce", "arm": "quant",
+                                 "predicted_wire_bytes": 465920,
+                                 "native_wire_bytes": 1835008}]}],
+        "verdicts": [{"plane": "perf", "kind": "perf_regression",
+                      "severity": "warn", "step": 9,
+                      "evidence": {"coll": "allreduce"}}],
+        "ledger": [{"step": 9, "rule": "perf_demote_quant",
+                    "action": "demote_arm_quant", "audit_op": "policy",
+                    "outcome": "applied",
+                    "verdict": {"plane": "perf",
+                                "kind": "perf_regression",
+                                "severity": "warn", "step": 9},
+                    "vote": {"round": 1, "mode": "local", "yes": 1,
+                             "missing": [], "passed": True,
+                             "switch_step": 9},
+                    "effect": {"arm": "quant", "coll": "allreduce",
+                               "cvar": "coll_xla_allreduce_mode",
+                               "prev": "", "step": 9}}],
+    }
+    banked = tmp_path / "POLICY_cpu.json"
+    banked.write_text(json.dumps(
+        {"metric": "policy_selfdrive", "value": 4, "report": report}))
+
+    rc = comm_doctor.main(["--policy", str(banked), "--json"])
+    assert rc == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["schema_version"] == 11       # the v10 -> v11 pin
+    assert data["policy"] == report           # banked report, verbatim
+
+    rc = comm_doctor.main(["--policy", str(banked)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "policy: enabled, 2 verdict(s) published" in out
+    assert "attribution: 100.0%" in out
+    assert "statically pre-verified at registration" in out
+    assert "perf_demote_quant" in out
+    assert "wire 465920B/1835008B native" in out
+    assert "perf/perf_regression => perf_demote_quant [applied]" in out
+
+
+def test_comm_doctor_policy_live_section(capsys):
+    """Bare --policy reads the live in-process plane: one published
+    verdict drives the builtin engine and the rendered ledger."""
+    from ompi_tpu import policy
+    from ompi_tpu.coll import xla  # noqa: F401  (registers the mode cvars)
+    policy.reset()
+    policy.enable()
+    try:
+        policy.publish("perf", "perf_regression", "warn",
+                       evidence={"coll": "allreduce"}, step=5)
+        rc = comm_doctor.main(["--policy", "--json"])
+        assert rc == 0
+        data = json.loads(capsys.readouterr().out)
+        assert data["schema_version"] == 11
+        pol = data["policy"]
+        assert pol["verdicts_published"] == 1
+        assert pol["decisions_applied"] == 1
+        assert pol["attribution_pct"] == 100.0
+        applied = [r for r in pol["ledger"] if r["outcome"] == "applied"]
+        assert applied[0]["verdict"]["kind"] == "perf_regression"
+        assert var.get("coll_xla_allreduce_mode") == "quant"
+    finally:
+        var.registry.set_override("coll_xla_allreduce_mode", "")
+        var.registry.reset_cache()
+        policy.disable()
+        policy.reset()

@@ -1,0 +1,482 @@
+"""Policy plane: verdict bus -> rules engine -> audited adaptations
+(PR 17).
+
+Covers the bus (ring, subscribers, trace instants), the statically
+pre-verified action space (commgraph.verify_action + cvar lookup, loud
+ActionVeto at construction), the local observe->decide->act hop
+(exactly one ``decide:<op>`` event naming the causing verdict, cooldown
+hysteresis, severity filter), the 8-rank fleet vote over the
+out-of-band control plane (same vote round, same agreed switch step,
+same-step apply via tick), the sentry->bus bridges, and the CL007 lint
+rule (every decision threads ``verdict=``; every sentry verdict carries
+plane+severity).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from ompi_tpu import policy, trace
+from ompi_tpu.analysis import commgraph
+from ompi_tpu.coll import quant as _coll_quant  # noqa: F401
+from ompi_tpu.coll import xla as _coll_xla  # noqa: F401  (the two imports
+#   register the coll_* cvars the builtin action vocabulary writes)
+from ompi_tpu.analysis.lint import lint_sources
+from ompi_tpu.control.bootstrap import LocalBootstrap
+from ompi_tpu.core import var
+from ompi_tpu.policy.bus import Verdict, VerdictBus, severity_rank
+from ompi_tpu.policy.engine import (Action, ActionVeto, PolicyEngine,
+                                    Rule, builtin_rules)
+
+pytestmark = pytest.mark.policy
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test leaves the plane, the overrides and the tracer as it
+    found them."""
+    yield
+    for name in ("policy_enabled", "policy_vote_lead",
+                 "policy_vote_timeout", "policy_cooldown"):
+        var.registry.clear_cli(name)
+    var.registry.set_override("coll_xla_allreduce_mode", "")
+    var.registry.set_override("coll_quant_block", 256)
+    var.registry.set_override("coll_xla_grad_bucket_bytes", 4 << 20)
+    var.registry.reset_cache()
+    policy.disable()
+    policy.reset()
+    trace.clear()
+    trace.disable()
+
+
+def _verdict(plane="perf", kind="perf_regression", severity="warn",
+             step=3, **ev):
+    return Verdict(plane=plane, kind=kind, severity=severity,
+                   evidence=ev, step=step)
+
+
+# -- the bus -----------------------------------------------------------------
+
+
+class TestVerdictBus:
+    def test_publish_count_and_ring_cap(self):
+        bus = VerdictBus()
+        for i in range(100):
+            bus.publish(_verdict(step=i))
+        assert bus.count() == 100
+        ring = bus.verdicts()
+        assert len(ring) == 64            # ring keeps the newest 64
+        assert ring[-1].step == 99 and ring[0].step == 36
+
+    def test_subscribers_see_every_verdict(self):
+        bus = VerdictBus()
+        seen = []
+        bus.subscribe(seen.append)
+        v = _verdict()
+        bus.publish(v)
+        assert seen == [v]
+        bus.unsubscribe(seen.append)
+        bus.publish(_verdict())
+        assert len(seen) == 1
+
+    def test_publish_emits_trace_instant(self):
+        trace.enable()
+        trace.clear()
+        bus = VerdictBus()
+        bus.publish(_verdict(plane="numerics", kind="quant_snr"))
+        evs = [e for e in trace.events()
+               if e.get("name") == "policy_verdict"]
+        assert len(evs) == 1
+        assert evs[0]["args"]["plane"] == "numerics"
+        assert evs[0]["args"]["kind"] == "quant_snr"
+
+    def test_severity_order(self):
+        assert (severity_rank("info") < severity_rank("warn")
+                < severity_rank("error"))
+        # a typo can never outrank a real error
+        assert severity_rank("catastrophic") == severity_rank("info")
+
+    def test_verdict_as_dict_is_json_safe(self):
+        d = _verdict(coll="allreduce", z=4.2).as_dict()
+        assert json.loads(json.dumps(d)) == d
+        assert d["plane"] == "perf" and d["evidence"]["z"] == 4.2
+
+
+# -- the pre-verified action space -------------------------------------------
+
+
+class TestVerifyAction:
+    def test_quant_predicts_fewer_wire_bytes(self):
+        rep = commgraph.verify_action("allreduce", "quant",
+                                      nbytes=1 << 20, ndev=8)
+        assert rep["ok"]
+        assert rep["predicted_wire_bytes"] < rep["native_wire_bytes"]
+        assert 0.0 < rep["quant_ratio"] < 0.5      # int8 + scales vs f32
+
+    def test_native_predicts_ring_bytes(self):
+        rep = commgraph.verify_action("allreduce", "native",
+                                      nbytes=1 << 20, ndev=8)
+        # 2(n-1)/n ring hops over the 1 MiB payload
+        assert rep["predicted_wire_bytes"] == int(2 * 7 / 8 * (1 << 20))
+
+    def test_unknown_arm_rejected(self):
+        with pytest.raises(ValueError, match="warp9"):
+            commgraph.verify_action("allreduce", "warp9")
+
+    def test_quant_on_unquantizable_coll_rejected(self):
+        with pytest.raises(ValueError, match="quant"):
+            commgraph.verify_action("decode_ag", "quant")
+
+    def test_unknown_coll_rejected(self):
+        with pytest.raises(ValueError, match="warpdrive"):
+            commgraph.verify_action("warpdrive", "native")
+
+
+class TestRegistrationVeto:
+    def test_statically_failing_action_rejected_at_construction(self):
+        bad = Rule(name="bad", plane="perf", action=Action(
+            name="demote_to_warp9", apply=lambda v, s: None,
+            colls=("allreduce",), arm="warp9"))
+        with pytest.raises(ActionVeto, match="REJECTED at registration"):
+            PolicyEngine([bad])
+
+    def test_quant_on_unquantizable_surface_rejected(self):
+        bad = Rule(name="bad", plane="perf", action=Action(
+            name="quant_decode", apply=lambda v, s: None,
+            colls=("decode_ag",), arm="quant"))
+        with pytest.raises(ActionVeto, match="REJECTED"):
+            PolicyEngine([bad])
+
+    def test_unregistered_cvar_rejected(self):
+        bad = Rule(name="bad", plane="perf", action=Action(
+            name="tweak_ghost", apply=lambda v, s: None,
+            cvars=("coll_xla_ghost_knob",)))
+        with pytest.raises(ActionVeto, match="unregistered cvar"):
+            PolicyEngine([bad])
+
+    def test_arm_without_coll_surface_rejected(self):
+        bad = Rule(name="bad", plane="perf", action=Action(
+            name="armless", apply=lambda v, s: None, arm="quant"))
+        with pytest.raises(ActionVeto, match="no target ops"):
+            PolicyEngine([bad])
+
+    def test_builtin_vocabulary_verifies_clean(self):
+        eng = PolicyEngine(builtin_rules())
+        assert len(eng.rules) == 5
+        quant_reports = eng.verified["demote_arm_quant"]
+        assert len(quant_reports) == 4     # one per coll in the surface
+        assert all(r["predicted_wire_bytes"] < r["native_wire_bytes"]
+                   for r in quant_reports)
+
+
+# -- the local observe -> decide -> act hop ----------------------------------
+
+
+class TestLocalEngine:
+    def _engine(self, cooldown=0):
+        calls = []
+
+        def apply(verdict, step):
+            calls.append((verdict.kind, step))
+            return {"arm": "quant", "coll": "allreduce", "step": step}
+
+        rule = Rule(name="demote", plane="perf", kind="perf_regression",
+                    min_severity="warn",
+                    action=Action(name="demote", apply=apply,
+                                  colls=("allreduce",), arm="quant",
+                                  cooldown=cooldown))
+        return PolicyEngine([rule]), calls
+
+    def test_apply_emits_one_decision_naming_the_verdict(self):
+        trace.enable()
+        trace.clear()
+        eng, calls = self._engine()
+        rows = eng.consider(_verdict(step=7, coll="allreduce"))
+        assert [r["outcome"] for r in rows] == ["applied"]
+        assert calls == [("perf_regression", 7)]
+        evs = [e for e in trace.events()
+               if e.get("name") == "decide:policy"]
+        assert len(evs) == 1              # exactly one audited decision
+        assert evs[0]["args"]["verdict"] == {
+            "plane": "perf", "kind": "perf_regression",
+            "severity": "warn", "step": 7}
+        assert evs[0]["args"]["arm"] == "quant"
+
+    def test_cooldown_hysteresis(self):
+        eng, calls = self._engine(cooldown=4)
+        eng.consider(_verdict(step=3))
+        rows = eng.consider(_verdict(step=5))      # inside the window
+        assert rows[0]["outcome"] == "cooldown"
+        assert rows[0]["effect"] == {"last_applied_step": 3,
+                                     "cooldown": 4}
+        rows = eng.consider(_verdict(step=7))      # window expired
+        assert rows[0]["outcome"] == "applied"
+        assert [s for _, s in calls] == [3, 7]
+
+    def test_severity_filter(self):
+        eng, calls = self._engine()
+        assert eng.consider(_verdict(severity="info")) == []
+        assert eng.consider(_verdict(severity="error"))[0][
+            "outcome"] == "applied"
+        assert len(calls) == 1
+
+    def test_plane_kind_filter(self):
+        eng, calls = self._engine()
+        assert eng.consider(_verdict(plane="traffic")) == []
+        assert eng.consider(_verdict(kind="hotlink")) == []
+        assert not calls
+
+    def test_noop_effect_is_not_a_decision(self):
+        trace.enable()
+        trace.clear()
+        rule = Rule(name="idem", plane="perf",
+                    action=Action(name="idem",
+                                  apply=lambda v, s: None, cooldown=0))
+        eng = PolicyEngine([rule])
+        rows = eng.consider(_verdict())
+        assert rows[0]["outcome"] == "noop"
+        assert eng.decisions() == 0
+        assert not [e for e in trace.events()
+                    if e.get("name") == "decide:policy"]
+
+    def test_set_arm_writes_cvar_and_reverts_no_flap(self):
+        eng = PolicyEngine(builtin_rules())
+        var.registry.set_cli("policy_enabled", "true")
+        var.registry.reset_cache()
+        policy.enable()
+        rows = eng.consider(_verdict(step=2, coll="allreduce"))
+        applied = [r for r in rows if r["outcome"] == "applied"]
+        assert len(applied) == 1
+        assert var.get("coll_xla_allreduce_mode") == "quant"
+        assert applied[0]["effect"]["cvar"] == "coll_xla_allreduce_mode"
+        # already quant: the second verdict is a no-flap noop
+        rows = eng.consider(_verdict(step=99, coll="allreduce"))
+        assert [r["outcome"] for r in rows] == ["noop"]
+
+
+# -- fleet consistency over the out-of-band control plane --------------------
+
+
+class _FleetCtx:
+    def __init__(self, rank, size, bootstrap):
+        self.rank, self.size, self.bootstrap = rank, size, bootstrap
+
+
+class TestFleetConsistency:
+    N = 8
+
+    def _fleet(self):
+        boots = LocalBootstrap.create_job(self.N, job_id="policy-test")
+        engines = []
+        for r in range(self.N):
+            rule = Rule(name="demote", plane="perf",
+                        kind="perf_regression",
+                        action=Action(
+                            name="demote", cooldown=0,
+                            apply=lambda v, s: {"arm": "quant",
+                                                "step": s},
+                            colls=("allreduce",), arm="quant"))
+            engines.append(PolicyEngine(
+                [rule], ctx=_FleetCtx(r, self.N, boots[r])))
+        return engines
+
+    def test_eight_ranks_agree_on_the_same_switch_step(self):
+        engines = self._fleet()
+        # ranks observe the regression on slightly different steps —
+        # the agreed switch step must still be identical fleet-wide
+        steps = [10, 10, 11, 10, 12, 10, 10, 11]
+        rows_by_rank = [None] * self.N
+
+        def run(r):
+            rows_by_rank[r] = engines[r].consider(
+                _verdict(step=steps[r], coll="allreduce"))
+
+        threads = [threading.Thread(target=run, args=(r,))
+                   for r in range(self.N)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        votes = [rows[0]["vote"] for rows in rows_by_rank]
+        assert all(r[0]["outcome"] == "scheduled" for r in rows_by_rank)
+        assert {v["mode"] for v in votes} == {"fleet"}
+        assert {v["round"] for v in votes} == {1}   # same vote round
+        assert all(v["yes"] == self.N and not v["missing"]
+                   for v in votes)
+        # pure function of the gathered set: max proposed step + lead
+        lead = int(var.get("policy_vote_lead", 2))
+        assert {v["switch_step"] for v in votes} == {12 + lead}
+
+        # nothing fires before the agreed step; everything fires AT it
+        switch = 12 + lead
+        assert all(not e.tick(switch - 1) for e in engines)
+        applied = [e.tick(switch) for e in engines]
+        assert all(len(a) == 1 and a[0]["outcome"] == "applied"
+                   and a[0]["step"] == switch for a in applied)
+        assert all(e.pending() == 0 for e in engines)
+
+    def test_dead_control_plane_never_kills_the_step(self):
+        # a bootstrap whose put/get raise must degrade to a failed
+        # vote, not an exception out of consider()
+        class DeadBootstrap:
+            def put(self, key, value):
+                raise RuntimeError("control plane down")
+
+            def get(self, peer, key, timeout=1.0):
+                raise RuntimeError("control plane down")
+
+        var.registry.set_cli("policy_vote_timeout", "0.05")
+        var.registry.reset_cache()
+        rule = Rule(name="demote", plane="perf",
+                    action=Action(name="demote", cooldown=0,
+                                  apply=lambda v, s: {"arm": "quant"},
+                                  colls=("allreduce",), arm="quant"))
+        eng = PolicyEngine([rule],
+                           ctx=_FleetCtx(0, 4, DeadBootstrap()))
+        rows = eng.consider(_verdict(step=5))
+        assert rows[0]["outcome"] == "vote_failed"
+        assert rows[0]["vote"]["yes"] == 1          # only itself
+        assert rows[0]["vote"]["missing"] == [1, 2, 3]
+
+
+# -- the wired plane (sentry bridges + report) -------------------------------
+
+
+class TestWiredPlane:
+    def _enable(self):
+        var.registry.set_cli("policy_enabled", "true")
+        var.registry.reset_cache()
+        policy.reset()
+        policy.enable()
+
+    def test_publish_counts_and_report_attribution(self):
+        self._enable()
+        trace.enable()
+        policy.publish("perf", "perf_regression", "warn",
+                       evidence={"coll": "allreduce"}, step=4)
+        rep = policy.report()
+        assert rep["verdicts_published"] == 1
+        assert rep["decisions_applied"] == 1
+        assert rep["attribution_pct"] == 100.0
+        assert rep["unattributed"] == 0
+        assert policy.pvar_value("policy_verdicts") == 1.0
+        assert policy.pvar_value("policy_decisions") == 1.0
+        assert var.get("coll_xla_allreduce_mode") == "quant"
+
+    def test_perf_sentry_publishes_on_trip(self):
+        from ompi_tpu.perf.sentry import Sentry
+        self._enable()
+        s = Sentry()
+        s.load_baseline({"allreduce|native|20": {
+            "bw_GBps": [10.0, 10.1, 9.9, 10.0, 10.2]}}, [])
+        for _ in range(3):                 # sustain=3 slow samples
+            s.observe_coll("allreduce", "native", 1 << 20, 10.0, 8)
+        assert policy.bus.count() == 1
+        v = policy.bus.verdicts()[0]
+        assert (v.plane, v.kind, v.severity) == (
+            "perf", "perf_regression", "warn")
+        assert v.evidence["coll"] == "allreduce"
+
+    def test_snr_sentry_publishes_and_block_shrinks(self):
+        from ompi_tpu.numerics.sentry import SnrSentry
+        self._enable()
+        s = SnrSentry()
+        for _ in range(3):                 # sustain=3 low-SNR samples
+            s.observe("allreduce", 10.0, block=256)
+        assert policy.bus.count() == 1
+        assert int(var.get("coll_quant_block")) == 128
+
+    def test_disabled_plane_publishes_nothing(self):
+        from ompi_tpu.numerics.sentry import SnrSentry
+        policy.reset()
+        assert not policy.enabled
+        s = SnrSentry()
+        for _ in range(3):
+            s.observe("allreduce", 10.0, block=256)
+        assert policy.bus.count() == 0
+        assert int(var.get("coll_quant_block")) == 256
+
+
+# -- CL007: every decision threads its verdict cause -------------------------
+
+
+class TestCL007:
+    def _findings(self, src):
+        return [f for f in lint_sources({"ompi_tpu/fake/mod.py": src})
+                if f.rule == "CL007"]
+
+    def test_decision_without_verdict_flagged(self):
+        src = ("from .. import trace\n"
+               "def f():\n"
+               "    trace.decision('allreduce', arm='native', "
+               "reason='rule:x', nbytes=4)\n")
+        assert len(self._findings(src)) == 1
+
+    def test_decision_with_verdict_none_passes(self):
+        src = ("from .. import trace\n"
+               "def f():\n"
+               "    trace.decision('allreduce', arm='native', "
+               "reason='rule:x', verdict=None, nbytes=4)\n")
+        assert self._findings(src) == []
+
+    def test_decision_with_verdict_value_passes(self):
+        src = ("from .. import trace\n"
+               "def f(v):\n"
+               "    trace.decision('ft_recovery', arm='shrink', "
+               "reason='rule:x', verdict=dict(v), nbytes=4)\n")
+        assert self._findings(src) == []
+
+    def test_sentry_verdict_without_plane_severity_flagged(self):
+        src = ("def f():\n"
+               "    verdict = {'kind': 'hotlink', 'src': 2, 'dst': 5}\n"
+               "    return verdict\n")
+        assert len(self._findings(src)) == 1
+
+    def test_sentry_verdict_with_plane_severity_passes(self):
+        src = ("def f():\n"
+               "    verdict = {'kind': 'hotlink', 'plane': 'traffic',\n"
+               "               'severity': 'warn'}\n"
+               "    return verdict\n")
+        assert self._findings(src) == []
+
+    def test_repo_is_cl007_clean(self):
+        import os
+        import subprocess
+        import sys
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        r = subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.analysis.lint", "ompi_tpu"],
+            capture_output=True, text=True, cwd=root)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- straggler bridge (trace/analyze -> bus) ---------------------------------
+
+
+class TestStragglerBridge:
+    def test_entry_skew_publishes_straggler_verdict(self):
+        from ompi_tpu.trace.analyze import entry_skew
+        from ompi_tpu.trace.merge import FleetTimeline
+        var.registry.set_cli("policy_enabled", "true")
+        var.registry.reset_cache()
+        policy.reset()
+        policy.enable()
+        rng = np.random.default_rng(0)
+        events = []
+        for inst in range(8):
+            base = inst * 1e-3
+            for r in range(8):
+                late = 500e-6 if r == 5 else rng.uniform(0, 5e-6)
+                events.append({
+                    "name": "decide:allreduce", "cat": "decision",
+                    "ph": "i", "t": base + late, "rank": r,
+                    "args": {"op": "allreduce"}})
+        tl = FleetTimeline(events=sorted(events, key=lambda e: e["t"]))
+        rep = entry_skew(tl)
+        assert rep["flagged"] == [5]
+        stragglers = [v for v in policy.bus.verdicts()
+                      if v.kind == "straggler"]
+        assert len(stragglers) == 1
+        assert stragglers[0].evidence["rank"] == 5
