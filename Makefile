@@ -8,7 +8,7 @@ SHELL := /bin/bash
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
 	reshard-tests analysis-tests ft-elastic-tests moe-tests \
-	serve-tests decode-tests policy-tests comm-lint \
+	serve-tests decode-tests policy-tests fleet-tests comm-lint \
 	bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
@@ -34,7 +34,7 @@ SHELL := /bin/bash
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
 	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests \
-	decode-tests policy-tests
+	decode-tests policy-tests fleet-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -194,6 +194,18 @@ policy-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_policy.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --selfdrive
+
+# the serving-fleet gate: KV-page migration round-trip + router +
+# hot_replica sentry suite, then the end-to-end probe (one Poisson
+# stream through colocated tp=8 vs prefill/decode-split tp=4 replicas
+# at the SAME 8 chips; exits nonzero unless the split beats colocated
+# on p99 ITL with IDENTICAL token streams, every migration within the
+# reshard peak bound and fleet-wide conservation closed; banks
+# FLEET_<platform>.json)
+fleet-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --fleet
 
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe

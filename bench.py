@@ -1610,6 +1610,57 @@ def run_compare(old_path: str, new_path: str) -> None:
                              f"columns between {old_path} and "
                              f"{new_path}")
         return
+    if str(old.get("metric", "")).startswith("fleet_"):
+        # fleet artifacts (FLEET_<platform>.json): gate the
+        # disaggregated headline and each topology arm on tokens/s
+        # (higher better) and ITL p99 (lower better) at 10%
+        ov, nv = old.get("value"), new.get("value")
+        if isinstance(ov, (int, float)) \
+                and isinstance(nv, (int, float)) and ov > 0:
+            checked += 1
+            if nv < 0.9 * ov:
+                regressions.append(
+                    f"fleet: tokens_per_s {ov:g} -> {nv:g} "
+                    f"({(nv / ov - 1) * 100:+.1f}%)")
+        oarms = {a.get("policy"): a for a in old.get("arms") or []}
+        for narm in new.get("arms") or []:
+            oarm = oarms.get(narm.get("policy"))
+            if not oarm:
+                continue
+            ov, nv = oarm.get("tokens_per_s"), narm.get("tokens_per_s")
+            if isinstance(ov, (int, float)) \
+                    and isinstance(nv, (int, float)) and ov > 0:
+                checked += 1
+                if nv < 0.9 * ov:
+                    regressions.append(
+                        f"fleet[{narm['policy']}]: tokens_per_s "
+                        f"{ov:g} -> {nv:g} "
+                        f"({(nv / ov - 1) * 100:+.1f}%)")
+            ov, nv = oarm.get("itl_p99_ms"), narm.get("itl_p99_ms")
+            if isinstance(ov, (int, float)) \
+                    and isinstance(nv, (int, float)) and ov > 0:
+                checked += 1
+                if nv > 1.1 * ov:
+                    regressions.append(
+                        f"fleet[{narm['policy']}]: itl_p99_ms "
+                        f"{ov:g} -> {nv:g} "
+                        f"({(nv / ov - 1) * 100:+.1f}%)")
+        print(json.dumps({
+            "metric": "bench_compare",
+            "value": float(len(regressions)),
+            "unit": "fleet columns regressed >10%",
+            "old": old_path, "new": new_path,
+            "columns_checked": checked,
+            "regressions": regressions,
+        }))
+        if regressions:
+            raise SystemExit("bench compare: regression in "
+                             + "; ".join(regressions))
+        if not checked:
+            raise SystemExit("bench compare: no comparable fleet "
+                             f"columns between {old_path} and "
+                             f"{new_path}")
+        return
     if str(old.get("metric", "")).startswith("serve_"):
         # serving artifacts (SERVE_<platform>.json): gate the decode
         # headline and each shared arm on tokens/s (higher better) and
@@ -3641,6 +3692,261 @@ def run_serve_probe(platform: str) -> None:
         trace.disable()
 
 
+def _bank_fleet_baseline(doc: dict) -> None:
+    """Maintain the auto-measured fleet rows in BASELINE.md between
+    FLEET markers (replace-or-append)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BASELINE.md")
+    begin, end = "<!-- FLEET:BEGIN -->", "<!-- FLEET:END -->"
+    lines = [
+        begin,
+        "### Serving fleet: goodput-routed replicas + prefill/decode "
+        "split (auto-measured: `python bench.py --fleet`)",
+        "",
+        f"Same {doc['ndev']} chips both arms, {doc['n_requests']} "
+        f"Poisson request(s) @ {doc['qps']:g} QPS, long-prompt-heavy "
+        f"mix (prompt {doc['prompt_len'][0]}-{doc['prompt_len'][1]}, "
+        f"gen {doc['max_new'][0]}-{doc['max_new'][1]}), "
+        f"d={doc['d_model']}, vocab={doc['vocab']}; KV pages migrate "
+        "prefill->decode over `cross_reshard` (audited, conserved, "
+        "peak within `reshard_peak_factor`).",
+        "",
+        "| platform | topology | tokens/s | itl p50 ms | itl p99 ms "
+        "| migrations |",
+        "|---|---|---|---|---|---|",
+    ]
+    for arm in doc["arms"]:
+        lines.append(
+            f"| {doc['platform']} | {arm['policy']} "
+            f"| {arm['tokens_per_s']:.1f} "
+            f"| {arm['itl_p50_ms']:.2f} | {arm['itl_p99_ms']:.2f} "
+            f"| {arm['migrations']} |")
+    mig = doc["migration"]
+    lines.append(
+        f"\nMigration ledger: {mig['count']} KV-page handoff(s), "
+        f"{mig['bytes']} B on the wire, every one within the "
+        f"{mig['peak_factor']:g}x reshard peak bound; token streams "
+        "IDENTICAL colocated vs disaggregated; fleet-wide byte "
+        "conservation holds with zero unattributed bytes.")
+    lines.append(end)
+    row = "\n".join(lines)
+    try:
+        with open(path) as f:
+            txt = f.read()
+    except FileNotFoundError:
+        txt = ""
+    if begin in txt and end in txt:
+        txt = txt.split(begin)[0] + row + txt.split(end, 1)[1]
+    else:
+        txt = txt.rstrip("\n") + "\n\n" + row + "\n"
+    with open(path, "w") as f:
+        f.write(txt)
+
+
+def run_fleet_probe(platform: str) -> None:
+    """--fleet: end-to-end acceptance for the disaggregated
+    multi-replica serving fleet.  On the SAME 8 devices, replays one
+    long-prompt-heavy Poisson stream through (a) one colocated tp=8
+    replica and (b) a prefill replica + decode replica at tp=4, where
+    finished KV pages migrate prefill->decode over ``cross_reshard``
+    (the bridge mesh's fleet axis classified as simulated DCN so the
+    hop is charged).  Exits nonzero unless the disaggregated split
+    beats colocated on p99 ITL, per-request token streams are
+    IDENTICAL across topologies, every migration lands within the
+    ``reshard_peak_factor`` contract, and fleet-wide byte conservation
+    closes (edge sum == coll_wire_bytes == engine decode wire +
+    migrated KV bytes, zero unattributed).  Banks FLEET_<platform>.json
+    and maintains the BASELINE.md rows between the FLEET markers."""
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import serving, spc, trace, traffic
+    from ompi_tpu.core import var
+    from ompi_tpu.models import transformer as tfm
+    from ompi_tpu.serving.fleet import ServingFleet
+    from ompi_tpu.serving.scheduler import poisson_stream
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"fleet probe: needs 8 devices, have {ndev}")
+
+    cfg = tfm.Config(vocab=2048, d_model=256, n_layers=2, n_heads=8,
+                     head_dim=32, d_ff=1024, dtype=jnp.float32)
+    N_REQ, QPS, SEED = 16, 100.0, 7
+    PROMPT, MAX_NEW = (20, 40), (4, 8)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+    c = spc.Counters()
+    serving.reset()
+    serving.enable()
+    # the bridge mesh's fleet axis is the cross-replica hop: classify
+    # it as simulated DCN so every migration pays a modeled wire cost
+    # (replica-internal tp rings stay ICI)
+    var.registry.set_cli("topo_sim_dcn_axes", "fleet")
+    var.registry.set_cli("topo_sim_dcn_us_per_mib", "25")
+    try:
+        coloc = ServingFleet(params, cfg, replicas=1, tp=8, spc=c)
+        disagg = ServingFleet(params, cfg, replicas=2, tp=4,
+                              prefill_replicas=1, spc=c)
+
+        # warm every jit bucket the measured window will hit (prompt
+        # buckets 32 and 64 + the decode step of each engine + the
+        # migration import) — measured arms pay batching, not compiles
+        def warm_stream():
+            return poisson_stream(4, 1000.0, cfg.vocab, seed=3,
+                                  prompt_len=(20, 40), max_new=(2, 3))
+        coloc.run(warm_stream())
+        disagg.run(warm_stream())
+
+        # conservation window starts AFTER init + warmup
+        c2 = spc.Counters()
+        for fl in (coloc, disagg):
+            fl.spc = c2
+            for rep in fl.replicas:
+                rep.dc.spc = c2
+                rep.engine.wire_bytes = 0
+        traffic.reset()
+        traffic.enable()
+        trace.enable()
+        trace.clear()
+
+        def run_arm(fleet):
+            serving.reset()
+            stream = poisson_stream(N_REQ, QPS, cfg.vocab, seed=SEED,
+                                    prompt_len=PROMPT, max_new=MAX_NEW)
+            out = fleet.run(stream)
+            return out, serving.fleet_report()
+
+        out_c, rep_c = run_arm(coloc)
+        out_d, rep_d = run_arm(disagg)
+
+        # (a) identical greedy outputs: the topologies may only differ
+        # in WHERE work runs, never in what each request decodes
+        for rid, r in out_c["results"].items():
+            if r["tokens"] != out_d["results"][rid]["tokens"]:
+                raise SystemExit(
+                    f"fleet probe: request {rid} decoded differently "
+                    "colocated vs disaggregated")
+        # (b) the tentpole claim: pulling prefills off the decode
+        # replica shortens the inter-token tail at the same chip count
+        p99_c = out_c["itl"]["p99_ms"]
+        p99_d = out_d["itl"]["p99_ms"]
+        if not p99_d < p99_c:
+            raise SystemExit(
+                "fleet probe: disaggregated p99 ITL did not beat "
+                f"colocated ({p99_d:.1f} vs {p99_c:.1f} ms)")
+        # (c) every request migrated exactly once, every migration
+        # within the reshard peak contract
+        n_mig = rep_d["migrations"]
+        if n_mig != N_REQ:
+            raise SystemExit(
+                f"fleet probe: {n_mig} migration(s) for {N_REQ} "
+                "request(s) — the prefill/decode split did not carry "
+                "every sequence")
+        bad = [m for m in rep_d["migration_log"]
+               if not m["within_bound"]]
+        if bad:
+            raise SystemExit(
+                f"fleet probe: {len(bad)} migration(s) exceeded the "
+                "reshard peak bound: "
+                + "; ".join(f"rid {m['rid']} peak {m['peak_bytes']} > "
+                            f"bound {m['bound_bytes']}" for m in bad))
+        # (d) fleet-wide conservation: decode collectives + migrated
+        # KV pages all land on audited edges, nothing unattributed
+        wire_pv = int(c2.get("coll_wire_bytes"))
+        edge_sum = traffic.matrix.edge_bytes_total()
+        unattr = int(traffic.matrix.unattributed_bytes)
+        eng_sum = sum(rep.engine.wire_bytes
+                      for fl in (coloc, disagg)
+                      for rep in fl.replicas)
+        mig_bytes = int(c2.get("fleet_migrated_bytes"))
+        if wire_pv != eng_sum + mig_bytes or edge_sum != wire_pv \
+                or unattr:
+            raise SystemExit(
+                f"fleet probe: conservation breach — coll_wire_bytes "
+                f"{wire_pv}, engine audit {eng_sum} + migrated "
+                f"{mig_bytes}, edge sum {edge_sum}, unattributed "
+                f"{unattr}")
+        n_span = sum(1 for e in trace.events()
+                     if e.get("name") == "serve:migrate")
+        if n_span != n_mig:
+            raise SystemExit(
+                f"fleet probe: {n_span} serve:migrate span(s) for "
+                f"{n_mig} migration(s)")
+
+        peak_factor = float(var.get("reshard_peak_factor", 2.0))
+        prior = _load_json(os.path.join(here,
+                                        f"FLEET_{platform}.json"))
+        if prior and isinstance(prior.get("value"), (int, float)) \
+                and out_d["tokens_per_s"] < 0.85 * float(prior["value"]):
+            # soft self-ratchet (see the serve probe): within-run
+            # orderings + the --compare guard carry the hard gate
+            raise SystemExit(
+                f"fleet probe: disaggregated {out_d['tokens_per_s']:.1f}"
+                f" tok/s regressed >15% vs banked {prior['value']:.1f}")
+        serve_prior = _load_json(os.path.join(
+            here, f"SERVE_{platform}.json")) or {}
+
+        arms_rows = []
+        for name, out, rep in (("colocated", out_c, rep_c),
+                               ("disaggregated", out_d, rep_d)):
+            arms_rows.append({
+                "policy": name,
+                "tokens_per_s": round(out["tokens_per_s"], 2),
+                "tokens": out["tokens"],
+                "clock_s": round(out["clock_s"], 4),
+                "decode_steps": out["decode_steps"],
+                "itl_p50_ms": round(out["itl"]["p50_ms"], 3),
+                "itl_p99_ms": round(out["itl"]["p99_ms"], 3),
+                "migrations": rep["migrations"],
+                "per_replica": out["per_replica"],
+            })
+        doc = {
+            "metric": "fleet_tokens_per_s",
+            "value": round(out_d["tokens_per_s"], 2),
+            "unit": "end-to-end decode tokens/s, disaggregated "
+                    "prefill/decode fleet (virtual clock)",
+            "platform": platform, "ndev": ndev,
+            "n_requests": N_REQ, "qps": QPS,
+            "prompt_len": list(PROMPT), "max_new": list(MAX_NEW),
+            "d_model": cfg.d_model, "vocab": cfg.vocab,
+            "tp_colocated": 8, "tp_disaggregated": 4,
+            "itl_p99_ms_colocated": round(p99_c, 3),
+            "itl_p99_ms_disaggregated": round(p99_d, 3),
+            "serve_baseline_tokens_per_s": serve_prior.get("value"),
+            "arms": arms_rows,
+            "migration": {
+                "count": n_mig,
+                "bytes": mig_bytes,
+                "peak_factor": peak_factor,
+                "log": rep_d["migration_log"],
+            },
+            "conservation": {
+                "coll_wire_bytes": wire_pv,
+                "engine_wire_bytes": eng_sum,
+                "fleet_migrated_bytes": mig_bytes,
+                "edge_bytes_sum": edge_sum,
+                "unattributed_bytes": unattr,
+            },
+            "report": rep_d,
+        }
+        with open(os.path.join(here, f"FLEET_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k not in ("report", "migration",
+                                       "arms")}),
+              flush=True)
+        _bank_fleet_baseline(doc)
+    finally:
+        var.registry.clear_cli("topo_sim_dcn_axes")
+        var.registry.clear_cli("topo_sim_dcn_us_per_mib")
+        serving.reset()
+        serving.disable()
+        traffic.disable()
+        trace.disable()
+
+
 def _bank_policy_rule_row(doc) -> None:
     """Maintain the machine-authored rule block in DEVICE_RULES.txt
     between POLICY markers (replace-or-append).  The row is scoped
@@ -3966,6 +4272,9 @@ def main() -> None:
             return
         if "--serve" in sys.argv[1:]:
             run_serve_probe(platform)
+            return
+        if "--fleet" in sys.argv[1:]:
+            run_fleet_probe(platform)
             return
         if "--selfdrive" in sys.argv[1:]:
             run_selfdrive_probe(platform)

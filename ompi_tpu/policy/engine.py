@@ -342,6 +342,26 @@ def _moe_apply(verdict: Verdict, step: int) -> Optional[Dict[str, Any]]:
             "aux_scale": event["aux_scale"]}
 
 
+def _route_weight_apply(verdict: Verdict,
+                        step: int) -> Optional[Dict[str, Any]]:
+    """Shift fleet admission weight away from the hot replica: the
+    router reads ``serving.fleet_route_bias`` on every assignment, so
+    the change takes effect at the next admission — no restart, no
+    collective surface (like moe_capacity, this action touches only
+    host-side scheduling state)."""
+    from .. import serving
+    rep = verdict.evidence.get("replica")
+    if rep is None:
+        return None                     # verdict without a target
+    scale = float(_var.get("serve_fleet_route_scale", 0.5))
+    bias = serving.apply_route_weight(int(rep), scale)
+    if bias is None:
+        return None                     # replica unknown to the fleet
+    return {"arm": f"bias={bias:g}", "reason": "hot_replica",
+            "replica": int(rep), "scale": scale, "bias": bias,
+            "step": step}
+
+
 def builtin_rules() -> List[Rule]:
     """The default observe->act wiring: one rule per closed loop.
 
@@ -391,4 +411,9 @@ def builtin_rules() -> List[Rule]:
                  apply=_halve_cvar("coll_xla_grad_bucket_bytes", 1 << 20),
                  cvars=("coll_xla_grad_bucket_bytes",),
                  cooldown=demote_cd)),
+        Rule(name="fleet_hot_replica", plane="serve",
+             kind="hot_replica", min_severity="warn", enabled=_pol,
+             action=Action(
+                 name="route_weight", apply=_route_weight_apply,
+                 audit_op="fleet_route", cooldown=demote_cd)),
     ]

@@ -97,7 +97,13 @@ class ContinuousBatchingScheduler:
         self.active: Dict[int, _Active] = {}       # slot -> state
         self.clock = 0.0
         self.decode_steps = 0
+        self.decode_s = 0.0
+        self.occ_sum = 0.0
         self.results: Dict[int, Dict[str, Any]] = {}
+
+    def _on_token(self, st: _Active) -> None:
+        """Subclass hook: one emitted token for ``st`` at ``self.clock``
+        (the fleet's per-replica ITL attribution overrides this)."""
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -117,6 +123,7 @@ class ContinuousBatchingScheduler:
         if serving.enabled:
             serving.note_prefill(dur, len(req.prompt))
             serving.note_token(req.rid, self.clock)
+        self._on_token(st)
         self._maybe_finish(st, first)
 
     def _finish(self, st: _Active, reason: str) -> None:
@@ -193,6 +200,8 @@ class ContinuousBatchingScheduler:
         dur = time.perf_counter() - t0
         self.clock += dur
         self.decode_steps += 1
+        self.decode_s += dur
+        self.occ_sum += len(self.active) / b
         if serving.enabled:
             serving.note_decode_step(dur, len(self.active), b)
         th0 = time.perf_counter()
@@ -204,6 +213,7 @@ class ContinuousBatchingScheduler:
             st.last = tok
             if serving.enabled:
                 serving.note_token(st.req.rid, self.clock)
+            self._on_token(st)
             self._maybe_finish(st, tok)
         host = time.perf_counter() - th0
         self.clock += host
@@ -262,6 +272,8 @@ class ContinuousBatchingScheduler:
         dur = time.perf_counter() - t0
         self.clock += dur
         self.decode_steps += 1
+        self.decode_s += dur
+        self.occ_sum += len(self.active) / b
         if serving.enabled:
             serving.note_decode_step(dur, len(self.active), b)
         th0 = time.perf_counter()
@@ -283,6 +295,7 @@ class ContinuousBatchingScheduler:
                 emitted += 1
                 if serving.enabled:
                     serving.note_token(st.req.rid, self.clock)
+                self._on_token(st)
                 if self._maybe_finish(st, tok):
                     finished = True
                     break
@@ -306,3 +319,65 @@ class ContinuousBatchingScheduler:
             "tokens_per_s": toks / self.clock if self.clock else 0.0,
             "results": self.results,
         }
+
+
+class FleetRouter:
+    """Deterministic weighted admission across fleet replicas.
+
+    Deficit weighted round-robin: every assignment credits each replica
+    its share of the effective weight vector and picks the replica with
+    the largest accumulated credit (ties break to the LOWEST replica
+    id), then debits the winner one unit.  The decision is a pure
+    function of the weight/credit history, so two routers fed identical
+    streams under identical weights produce identical assignments — the
+    property the fleet determinism test pins.
+
+    Two inputs move the weights: ``update(replica, tokens_per_s,
+    itl_p99_ms)`` feeds the serving plane's live goodput/ITL (a hot
+    replica — high tail latency per unit goodput — loses share), and
+    the policy plane's ``route_weight`` action multiplies a per-replica
+    bias (``serving.fleet_route_bias``) read on EVERY assignment, so an
+    audited ``decide:fleet_route`` shifts admission immediately."""
+
+    def __init__(self, n: int,
+                 weights: Optional[List[float]] = None) -> None:
+        if n < 1:
+            raise ValueError(f"n={n} (want >= 1 replicas)")
+        if weights is not None and len(weights) != n:
+            raise ValueError(f"{len(weights)} weights for {n} replicas")
+        self.n = int(n)
+        self.weights = ([1.0] * n if weights is None
+                        else [float(w) for w in weights])
+        self._credits = [0.0] * n
+
+    def set_weight(self, replica: int, w: float) -> None:
+        self.weights[int(replica)] = max(float(w), 0.0)
+
+    def update(self, replica: int, tokens_per_s: float,
+               itl_p99_ms: float) -> None:
+        """Live reweighting from a replica's serving-plane stats:
+        goodput per unit of tail latency, so slow-tail replicas shed
+        admission share proportionally."""
+        self.weights[int(replica)] = (max(float(tokens_per_s), 0.0)
+                                      / max(float(itl_p99_ms), 1e-3))
+
+    def effective_weights(self) -> List[float]:
+        eff = [max(self.weights[i], 0.0)
+               * serving.fleet_route_bias(i) for i in range(self.n)]
+        if not any(w > 0.0 for w in eff):
+            eff = [1.0] * self.n           # all-zero: fall back to even
+        return eff
+
+    def assign(self, rid: Any) -> int:
+        eff = self.effective_weights()
+        tot = sum(eff)
+        for i in range(self.n):
+            self._credits[i] += eff[i] / tot
+        pick = 0
+        for i in range(1, self.n):
+            if self._credits[i] > self._credits[pick] + 1e-12:
+                pick = i
+        self._credits[pick] -= 1.0
+        if serving.enabled:
+            serving.note_route(rid, pick, eff)
+        return pick

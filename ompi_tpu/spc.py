@@ -126,6 +126,15 @@ COUNTERS = [
      "sequences evicted from the batch (EOS, max-new or drain)"),
     ("serve_kv_pages_used",
      "KV cache pages currently reserved by live sequences"),
+    # serving fleet (fed by ompi_tpu/serving's fleet ledger)
+    ("fleet_replicas",
+     "serving replicas in the most recently built fleet"),
+    ("fleet_migrations",
+     "KV-page migrations executed prefill -> decode via cross_reshard"),
+    ("fleet_migrated_bytes",
+     "wire bytes moved by KV-page migrations"),
+    ("fleet_rebalances",
+     "route_weight adaptations applied to the fleet router"),
 ]
 
 
@@ -195,6 +204,10 @@ class Counters:
             from . import serving
             if name in serving.PVARS:
                 return serving.pvar_value(name)
+        if name.startswith("fleet_"):
+            from . import serving
+            if name in serving.FLEET_PVARS:
+                return serving.fleet_pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -227,6 +240,8 @@ class Counters:
         from . import serving
         for name in serving.PVARS:
             out[name] = serving.pvar_value(name)
+        for name in serving.FLEET_PVARS:
+            out[name] = serving.fleet_pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:

@@ -41,8 +41,10 @@ from ..trace import merge as _merge
 # 10 = the decode fast path: speculative accept/reject ledger +
 #      fused-vs-eager dispatch counts in --serve, ISSUE 16;
 # 11 = the policy-plane section: verdict->vote->action->effect
-#      ledger with attribution, ISSUE 17)
-SCHEMA_VERSION = 11
+#      ledger with attribution, ISSUE 17;
+# 12 = the serving-fleet section: per-replica rows, migration
+#      ledger, router decision table, ISSUE 18)
+SCHEMA_VERSION = 12
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -731,6 +733,73 @@ def build_policy_report(
     return "\n".join(lines), rep
 
 
+def build_fleet_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the serving fleet: per-replica
+    occupancy/goodput/ITL rows, the KV-page migration ledger (wire
+    bytes + standing under the reshard peak contract) and the router
+    decision table.  ``path`` loads a banked FLEET json (bench.py
+    --fleet); default reads the live in-process fleet ledger."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from .. import serving as _serving
+        rep = _serving.fleet_report()
+    lines: List[str] = []
+    w = lines.append
+    src = f" (from {path})" if path else ""
+    w(f"fleet: {int(rep.get('replicas', 0))} replica(s), "
+      f"{int(rep.get('migrations', 0))} KV-page migration(s), "
+      f"{int(rep.get('migrated_bytes', 0))} byte(s) migrated, "
+      f"{int(rep.get('rebalances', 0))} route rebalance(s){src}")
+    rows = rep.get("replica_rows") or []
+    if rows:
+        w("  replicas:")
+        w("    id  role     reqs  tokens  tok/s     occ%   "
+          "itl p50/p99 ms  bias")
+        for r in rows:
+            if r.get("role") == "prefill":
+                w(f"    {int(r.get('replica', 0)):<3d} prefill  "
+                  f"{int(r.get('prefills', 0)):>4}  "
+                  f"(prefill lane: "
+                  f"{float(r.get('prefill_s', 0.0)):.3f}s busy of "
+                  f"{float(r.get('clock_s', 0.0)):.3f}s)")
+                continue
+            w(f"    {int(r.get('replica', 0)):<3d} "
+              f"{str(r.get('role', '?')):<8} "
+              f"{int(r.get('requests', 0)):>4}  "
+              f"{int(r.get('tokens', 0)):>6}  "
+              f"{float(r.get('tokens_per_s', 0.0)):>7.1f}  "
+              f"{100.0 * float(r.get('occupancy', 0.0)):>5.1f}  "
+              f"{float(r.get('itl_p50_ms', 0.0)):>7.2f}/"
+              f"{float(r.get('itl_p99_ms', 0.0)):<7.2f}  "
+              f"{float(r.get('route_bias', 1.0)):g}")
+    migs = rep.get("migration_log") or []
+    if migs:
+        over = [m for m in migs if not m.get("within_bound", True)]
+        w(f"  migration ledger ({len(migs)} most recent"
+          + (f"; {len(over)} OVER the peak bound" if over else
+             "; all within the reshard peak bound") + "):")
+        for m in migs[-8:]:
+            w(f"    rid {m.get('rid')!s:<5} r{int(m.get('src', 0))}->"
+              f"r{int(m.get('dst', 0))}  {int(m.get('pages', 0)):>3} "
+              f"page(s)  {int(m.get('bytes', 0)):>9}B  peak "
+              f"{int(m.get('peak_bytes', 0))}/"
+              f"{int(m.get('bound_bytes', 0))}B  "
+              f"{float(m.get('dur_ms', 0.0)):.2f} ms")
+    routes = rep.get("routes") or []
+    if routes:
+        w(f"  router decisions ({len(routes)} most recent):")
+        for r in routes[-8:]:
+            ws = "/".join(f"{float(x):g}" for x in
+                          (r.get("weights") or []))
+            w(f"    rid {r.get('rid')!s:<5} -> replica "
+              f"{int(r.get('replica', 0))}  [weights {ws}]")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -833,6 +902,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "with attribution. With a path, loads a "
                          "banked POLICY json (bench.py --selfdrive); "
                          "bare flag reads the live in-process plane")
+    ap.add_argument("--fleet", nargs="?", const="", default=None,
+                    metavar="FLEET.json",
+                    help="render the serving-fleet section: per-replica "
+                         "occupancy/goodput/ITL rows, the KV-page "
+                         "migration ledger and the router decision "
+                         "table. With a path, loads a banked FLEET "
+                         "json (bench.py --fleet); bare flag reads "
+                         "the live in-process fleet ledger")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -871,7 +948,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         if (ns.perf or ns.traffic is not None or ns.numerics is not None
                 or ns.reshard is not None or ns.analyze is not None
                 or ns.ft is not None or ns.moe is not None
-                or ns.serve is not None or ns.policy is not None):
+                or ns.serve is not None or ns.policy is not None
+                or ns.fleet is not None):
             # plane sections render standalone (no merged timeline)
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
@@ -929,6 +1007,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         ptext, pdata = build_policy_report(ns.policy or None)
         text = (text + "\n" + ptext) if text else ptext
         data["policy"] = pdata
+    if getattr(ns, "fleet", None) is not None:
+        fltext, fldata = build_fleet_report(ns.fleet or None)
+        text = (text + "\n" + fltext) if text else fltext
+        data["fleet"] = fldata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:
