@@ -8,8 +8,8 @@ SHELL := /bin/bash
 .PHONY: tier1 quant-tests trace-tests overlap-tests doctor-tests \
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
 	reshard-tests analysis-tests ft-elastic-tests moe-tests \
-	serve-tests decode-tests policy-tests fleet-tests comm-lint \
-	bench-compare
+	serve-tests decode-tests policy-tests fleet-tests request-tests \
+	comm-lint bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -34,7 +34,7 @@ SHELL := /bin/bash
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
 	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests \
-	decode-tests policy-tests fleet-tests
+	decode-tests policy-tests fleet-tests request-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -207,6 +207,19 @@ fleet-tests:
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --fleet
 
+# the request-plane gate: span-tree stitching + conservation + exemplar
+# reservoir + SLO-judge suite, then the end-to-end probe (a chaos-
+# delayed migration lane and a slowed prefill replica on the same
+# 8-chip disaggregated fleet; exits nonzero unless each degradation is
+# attributed to its true stage at p99, every sampled request's stage
+# sum matches e2e within clock confidence on the merged timeline, and
+# each breach episode lands exactly one slo_breach verdict answered by
+# one audited decide:fleet_route; banks REQUESTS_<platform>.json)
+request-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_requests.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --slo
+
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe
 # (extracts the flagship train step's and a reshard plan's collective
@@ -218,7 +231,7 @@ analysis-tests: comm-lint
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --analyze
 
-# repo-invariant comm-lint (rules CL001-CL007, justified waivers only)
+# repo-invariant comm-lint (rules CL001-CL008, justified waivers only)
 # plus the DEVICE_RULES grammar validator; nonzero on any unwaived
 # finding — cheap enough to run on every edit
 comm-lint:

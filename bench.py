@@ -3947,6 +3947,300 @@ def run_fleet_probe(platform: str) -> None:
         trace.disable()
 
 
+def _bank_requests_baseline(doc: dict) -> None:
+    """Maintain the auto-measured request-plane rows in BASELINE.md
+    between REQUESTS markers (replace-or-append)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BASELINE.md")
+    begin, end = "<!-- REQUESTS:BEGIN -->", "<!-- REQUESTS:END -->"
+    lines = [
+        begin,
+        "### Request plane: per-request tracing + critical-path tail "
+        "attribution (auto-measured: `python bench.py --slo`)",
+        "",
+        f"Disaggregated {doc['ndev']}-chip fleet, {doc['n_requests']} "
+        "Poisson request(s) per phase; each arm injects one chaos "
+        "degradation after a clean phase and the SLO judge + critical-"
+        "path analyzer must attribute every p99 tail breach to the "
+        "injected stage (stage sums conserve against e2e within clock "
+        "confidence on the merged timeline).",
+        "",
+        "| platform | chaos arm | clean e2e p99 ms | chaos e2e p99 ms "
+        "| breaches | episodes | p99 attributed |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for arm in doc["arms"]:
+        lines.append(
+            f"| {doc['platform']} | {arm['arm']} "
+            f"| {arm['clean_e2e_p99_ms']:.2f} "
+            f"| {arm['chaos_e2e_p99_ms']:.2f} "
+            f"| {arm['breaches']} | {arm['episodes']} "
+            f"| {arm['attributed_stage']} |")
+    lines.append(
+        "\nEach episode published exactly one `slo_breach` verdict "
+        "carrying the attributed stage; the policy engine answered "
+        "every one with a single audited `decide:fleet_route` "
+        "re-weighting.")
+    lines.append(end)
+    row = "\n".join(lines)
+    try:
+        with open(path) as f:
+            txt = f.read()
+    except FileNotFoundError:
+        txt = ""
+    if begin in txt and end in txt:
+        txt = txt.split(begin)[0] + row + txt.split(end, 1)[1]
+    else:
+        txt = txt.rstrip("\n") + "\n\n" + row + "\n"
+    with open(path, "w") as f:
+        f.write(txt)
+
+
+def run_slo_probe(platform: str) -> None:
+    """--slo: end-to-end acceptance for the request plane — per-request
+    trace contexts threaded admit->route->queue->prefill->migrate->
+    join->decode across the disaggregated fleet, stitched through the
+    trace/merge clock alignment into one span tree per request, with
+    the critical-path analyzer attributing the tail and the SLO judge
+    closing the loop over the policy bus.  Two chaos arms on the same
+    8 devices: a delayed KV-migration lane, then a slowed prefill
+    replica.  Exits nonzero unless each injected degradation is
+    attributed to its true stage at p99, every sampled request's stage
+    sum matches e2e within clock confidence on the merged timeline,
+    and each breach episode lands exactly one ``slo_breach`` verdict
+    on the bus answered by one audited ``decide:fleet_route``.  Banks
+    REQUESTS_<platform>.json and the BASELINE.md REQUESTS rows."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu import policy, serving, spc, trace
+    from ompi_tpu.core import var
+    from ompi_tpu.models import transformer as tfm
+    from ompi_tpu.serving import requests
+    from ompi_tpu.serving.fleet import ServingFleet
+    from ompi_tpu.serving.scheduler import poisson_stream
+    from ompi_tpu.trace import critical
+    from ompi_tpu.trace import merge as tmerge
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    if ndev < 8:
+        raise SystemExit(f"slo probe: needs 8 devices, have {ndev}")
+
+    cfg = tfm.Config(vocab=2048, d_model=256, n_layers=2, n_heads=8,
+                     head_dim=32, d_ff=1024, dtype=jnp.float32)
+    N_REQ, QPS, SEED = 12, 100.0, 7
+    PROMPT, MAX_NEW = (20, 40), (4, 8)
+    params = tfm.init_params(jax.random.PRNGKey(0), cfg)
+
+    var.registry.set_cli("topo_sim_dcn_axes", "fleet")
+    var.registry.set_cli("topo_sim_dcn_us_per_mib", "25")
+    var.registry.set_cli("policy_enabled", "true")
+    var.registry.reset_cache()
+    arms_rows = []
+    last_report = None
+    try:
+        for arm, chaos_var, stage in (
+                ("migrate", "serve_req_chaos_migrate_ms", "migrate"),
+                ("prefill", "serve_req_chaos_prefill_scale", "prefill")):
+            c = spc.Counters()
+            serving.reset()
+            serving.enable()
+            requests.reset()
+            requests.enable()
+            policy.reset()
+            policy.enable()
+            trace.enable()
+            trace.clear()
+            fleet = ServingFleet(params, cfg, replicas=2, tp=4,
+                                 prefill_replicas=1, spc=c)
+            # warm the jit buckets, then wipe the warmup's request
+            # state so the measured phases start from a clean ledger
+            fleet.run(poisson_stream(4, 1000.0, cfg.vocab, seed=3,
+                                     prompt_len=PROMPT, max_new=(2, 3)))
+            requests.reset()
+            serving.reset()
+            policy.reset()
+            trace.clear()
+
+            # -- clean phase: no SLO targets (judge disarmed), the
+            # stage histograms bank the attribution baseline ----------
+            fleet.run(poisson_stream(N_REQ, QPS, cfg.vocab, seed=SEED,
+                                     prompt_len=PROMPT,
+                                     max_new=MAX_NEW))
+            clean = requests.report()
+            clean_p99 = float(clean["e2e"]["p99_ms"])
+            if clean["slo_breaches"]:
+                raise SystemExit(
+                    f"slo probe [{arm}]: {clean['slo_breaches']} "
+                    "breach(es) with the judge disarmed")
+
+            # -- chaos phase: arm the e2e SLO at 2x the clean p99 and
+            # inject one degradation sized off the clean baseline so
+            # every request breaches.  Chaos rids offset so the merged
+            # trace keeps one span tree per request across phases;
+            # arrivals spread wide enough that the serialized lane
+            # never backs the queue up — the probe attributes the
+            # injected lane delay, not downstream queueing ------------
+            var.registry.set_cli("serve_req_slo_e2e_ms",
+                                 f"{2.0 * clean_p99:.6f}")
+            if arm == "migrate":
+                extra_ms = 4.0 * clean_p99
+                chaos_val = f"{extra_ms:.6f}"
+            else:
+                pre_p99 = float(
+                    clean["stages"]["prefill"]["p99_ms"])
+                scale = max(50.0,
+                            4.0 * clean_p99 / max(pre_p99, 1e-6))
+                extra_ms = scale * pre_p99
+                chaos_val = f"{scale:.3f}"
+            var.registry.set_cli(chaos_var, chaos_val)
+            var.registry.reset_cache()
+            stream = poisson_stream(N_REQ, QPS, cfg.vocab,
+                                    seed=SEED + 1, prompt_len=PROMPT,
+                                    max_new=MAX_NEW)
+            spacing = 5.0 * (clean_p99 + extra_ms) / 1e3
+            for i, r in enumerate(stream):
+                r.rid = 1000 + r.rid
+                r.arrival = (i + 1) * spacing
+            fleet.run(stream)
+            rep = requests.report()
+            prep = policy.report()
+            var.registry.clear_cli("serve_req_slo_e2e_ms")
+            var.registry.clear_cli(chaos_var)
+            var.registry.reset_cache()
+            chaos_p99 = float(rep["e2e"]["p99_ms"])
+
+            # (a) the judge fired and the excursion was ONE episode
+            # with exactly one slo_breach verdict on the bus
+            breaches = int(rep["slo_breaches"])
+            if not breaches:
+                raise SystemExit(
+                    f"slo probe [{arm}]: chaos phase produced no SLO "
+                    f"breach (clean p99 {clean_p99:.2f} ms, chaos p99 "
+                    f"{chaos_p99:.2f} ms)")
+            slo_verdicts = [v for v in prep["verdicts"]
+                            if v.get("kind") == "slo_breach"]
+            if len(slo_verdicts) != int(rep["episodes"]) \
+                    or len(slo_verdicts) != 1:
+                raise SystemExit(
+                    f"slo probe [{arm}]: {len(slo_verdicts)} "
+                    f"slo_breach verdict(s) for {rep['episodes']} "
+                    "episode(s) — want exactly one per episode")
+            # (b) the pre-verified route_weight action answered it:
+            # one applied ledger row, one audited decide:fleet_route
+            applied = [r for r in prep["ledger"]
+                       if r.get("rule") == "req_slo_breach"
+                       and r.get("outcome") == "applied"]
+            route_evs = [e for e in trace.events()
+                         if e.get("name") == "decide:fleet_route"
+                         and e.get("args", {}).get("reason")
+                         == "slo_breach"]
+            if len(applied) != 1 or len(route_evs) != 1:
+                raise SystemExit(
+                    f"slo probe [{arm}]: {len(applied)} applied "
+                    f"req_slo_breach action(s), {len(route_evs)} "
+                    "audited decide:fleet_route — want exactly one "
+                    "of each")
+            if route_evs[0]["args"].get("stage") != stage:
+                raise SystemExit(
+                    f"slo probe [{arm}]: the fleet_route decision "
+                    f"carries stage "
+                    f"{route_evs[0]['args'].get('stage')!r}, want "
+                    f"{stage!r}")
+
+            # (c) ledger-side attribution: every breach exemplar must
+            # blame the injected stage
+            brollup = rep["breach_attribution"]
+            wrong = {k: v for k, v in brollup.items() if k != stage}
+            if not brollup or wrong:
+                raise SystemExit(
+                    f"slo probe [{arm}]: breach attribution {brollup} "
+                    f"— want every breach on {stage!r}")
+
+            # (d) trace-side: round-trip the per-rank rings through
+            # the Chrome format, merge on aligned clocks, and re-derive
+            # attribution + conservation from the span trees alone
+            with tempfile.TemporaryDirectory() as td:
+                paths = []
+                for r in sorted({e["rank"] for e in trace.events()}):
+                    paths.append(trace.save_chrome(
+                        os.path.join(td, f"rank{r}.json"), rank=r))
+                per_rank = tmerge.load_chrome(paths)
+                ranks = sorted(per_rank)
+                tl = tmerge.merge(
+                    per_rank,
+                    offsets={r: 0.0 for r in ranks},
+                    best_rtt={r: 2e-5 for r in ranks})
+            cons = critical.conservation(tl)
+            if not cons["checked"] or not cons["all_ok"]:
+                bad = [r for r in cons["requests"] if not r["ok"]]
+                raise SystemExit(
+                    f"slo probe [{arm}]: stage-sum conservation failed "
+                    f"for {len(bad)}/{cons['checked']} request(s): "
+                    + "; ".join(
+                        f"rid {r['rid']} resid {r['resid_s']:.2e}s > "
+                        f"tol {r['tol_s']:.2e}s" for r in bad[:4]))
+            tail = critical.tail_attribution(tl, q=0.99)
+            misattr = [t for t in tail["tail"] if t["stage"] != stage]
+            if not tail["tail"] or misattr:
+                raise SystemExit(
+                    f"slo probe [{arm}]: p99 tail attribution "
+                    f"{tail['rollup']} — want every tail request on "
+                    f"{stage!r}")
+
+            arms_rows.append({
+                "arm": arm,
+                "chaos_var": chaos_var,
+                "chaos_value": chaos_val,
+                "clean_e2e_p99_ms": round(clean_p99, 3),
+                "chaos_e2e_p99_ms": round(chaos_p99, 3),
+                "breaches": breaches,
+                "episodes": int(rep["episodes"]),
+                "attributed_stage": stage,
+                "tail_rollup": tail["rollup"],
+                "conservation_checked": cons["checked"],
+                "route_decisions": len(route_evs),
+                "pvars": {k: c.get(k) for k in requests.PVARS},
+            })
+            last_report = rep
+
+        doc = {
+            "metric": "request_slo_attribution",
+            "value": float(len(arms_rows)),
+            "unit": "chaos arms whose p99 tail attributed to the "
+                    "injected stage (of 2)",
+            "platform": platform, "ndev": ndev,
+            "n_requests": N_REQ, "qps": QPS,
+            "prompt_len": list(PROMPT), "max_new": list(MAX_NEW),
+            "d_model": cfg.d_model, "vocab": cfg.vocab,
+            "arms": arms_rows,
+            "report": last_report,
+        }
+        with open(os.path.join(here, f"REQUESTS_{platform}.json"),
+                  "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k != "report"}), flush=True)
+        _bank_requests_baseline(doc)
+    finally:
+        for name in ("topo_sim_dcn_axes", "topo_sim_dcn_us_per_mib",
+                     "policy_enabled", "serve_req_slo_e2e_ms",
+                     "serve_req_chaos_migrate_ms",
+                     "serve_req_chaos_prefill_scale"):
+            var.registry.clear_cli(name)
+        var.registry.reset_cache()
+        requests.reset()
+        requests.disable()
+        serving.reset()
+        serving.disable()
+        policy.disable()
+        policy.reset()
+        trace.disable()
+
+
 def _bank_policy_rule_row(doc) -> None:
     """Maintain the machine-authored rule block in DEVICE_RULES.txt
     between POLICY markers (replace-or-append).  The row is scoped
@@ -4278,6 +4572,9 @@ def main() -> None:
             return
         if "--selfdrive" in sys.argv[1:]:
             run_selfdrive_probe(platform)
+            return
+        if "--slo" in sys.argv[1:]:
+            run_slo_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

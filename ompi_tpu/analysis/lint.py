@@ -44,6 +44,12 @@ Rule catalog (docs/static-analysis.md has the long rationale):
   spelling), and every sentry verdict dict must carry ``plane`` and
   ``severity`` keys — an unattributed decision or an envelope-less
   verdict is invisible to ``comm_doctor --policy``.
+* **CL008** the request-plane stitching contract: every span recorded
+  inside the serving request path (``ompi_tpu/serving/``) must carry a
+  ``rid=`` tag in its args — an untagged span is invisible to the
+  per-request span-tree stitching and the critical-path analyzer.
+  Batch-scoped spans (one decode step covers every live request) waive
+  with the why.
 """
 
 from __future__ import annotations
@@ -63,6 +69,7 @@ RULES: Dict[str, str] = {
     "CL006": "one-sided window op reachable outside an RMA epoch",
     "CL007": "decision without a verdict= cause / verdict without "
              "plane+severity",
+    "CL008": "serving request-path span without a rid= tag",
 }
 
 _HINTS: Dict[str, str] = {
@@ -90,6 +97,10 @@ _HINTS: Dict[str, str] = {
              "operator-forced decision), and give every sentry verdict "
              "dict the bus envelope keys 'plane' and 'severity' — "
              "comm_doctor --policy renders only attributed decisions",
+    "CL008": "tag the span's args with the owning request (rid=...) so "
+             "the request plane's span-tree stitching can group it; a "
+             "genuinely batch-scoped span (one decode step serves every "
+             "live request) waives with the why",
 }
 
 # -- CL001 vocabulary --------------------------------------------------------
@@ -147,6 +158,12 @@ _REASON_PREFIXES = ("force:", "blanket:", "rule:", "floor:", "off:",
 _CL007_ENGINE_SUFFIXES = ("ompi_tpu/trace/__init__.py",)
 # names whose dict construction is held to the bus-envelope contract
 _CL007_VERDICT_NAMES = re.compile(r"(^|_)verdicts?$")
+
+# -- CL008 vocabulary --------------------------------------------------------
+
+# the serving request path: every span these modules record narrates a
+# request's lifecycle, so the request plane's stitching needs the rid tag
+_CL008_PATH_FRAGMENT = "ompi_tpu/serving/"
 
 # -- CL006 vocabulary --------------------------------------------------------
 
@@ -503,6 +520,52 @@ def _cl007(tree: ast.AST, path: str) -> List[Finding]:
     return out
 
 
+def _cl008(tree: ast.AST, path: str) -> List[Finding]:
+    if _CL008_PATH_FRAGMENT not in _norm(path):
+        return []
+    out = []
+
+    def _dict_keys(node) -> Optional[Set[str]]:
+        """Constant keys of a dict literal or dict(...) call, else None."""
+        if isinstance(node, ast.Dict):
+            return {k.value for k in node.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+        if isinstance(node, ast.Call) and _call_name(node) == "dict":
+            return {kw.arg for kw in node.keywords if kw.arg}
+        return None
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _call_name(node) == "record_span"):
+            continue
+        chain = _attr_chain(node.func)
+        # only the trace recorder's spellings (trace.record_span /
+        # _trace.record_span); a different receiver is not the event
+        if chain.split(".")[0] not in ("trace", "_trace") \
+                and chain != "record_span":
+            continue
+        args_kw = next((kw.value for kw in node.keywords
+                        if kw.arg == "args"), None)
+        if args_kw is None and len(node.args) >= 6:
+            args_kw = node.args[5]
+        if args_kw is None:
+            out.append(_finding(
+                "CL008", path, node,
+                "request-path span recorded with no args= at all — "
+                "it cannot carry the rid= tag the request plane "
+                "stitches span trees on"))
+            continue
+        keys = _dict_keys(args_kw)
+        if keys is not None and "rid" not in keys:
+            out.append(_finding(
+                "CL008", path, node,
+                "request-path span args without a rid= tag — the "
+                "per-request span tree and critical-path analyzer "
+                "cannot attribute it"))
+    return out
+
+
 def _cl006(tree: ast.AST, path: str) -> List[Finding]:
     npath = _norm(path)
     if any(s in npath for s in _CL006_EXEMPT_SUFFIXES):
@@ -606,6 +669,7 @@ def lint_sources(src_by_path: Dict[str, str]) -> List[Finding]:
         findings += _cl005(tree, path)
         findings += _cl006(tree, path)
         findings += _cl007(tree, path)
+        findings += _cl008(tree, path)
     findings += _cl003(trees)
     findings = _apply_waivers(findings, src_by_path)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
@@ -633,7 +697,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(
-        description="repo-invariant comm-lint (rules CL001-CL007; "
+        description="repo-invariant comm-lint (rules CL001-CL008; "
                     "waive per line with '# comm-lint: disable=CLnnn "
                     "<why>')")
     ap.add_argument("paths", nargs="*", default=["ompi_tpu"])

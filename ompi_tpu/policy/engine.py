@@ -357,9 +357,16 @@ def _route_weight_apply(verdict: Verdict,
     bias = serving.apply_route_weight(int(rep), scale)
     if bias is None:
         return None                     # replica unknown to the fleet
-    return {"arm": f"bias={bias:g}", "reason": "hot_replica",
-            "replica": int(rep), "scale": scale, "bias": bias,
-            "step": step}
+    effect = {"arm": f"bias={bias:g}", "reason": str(verdict.kind),
+              "replica": int(rep), "scale": scale, "bias": bias,
+              "step": step}
+    stage = verdict.evidence.get("stage")
+    if stage is not None:
+        # the request plane's slo_breach carries its critical-path
+        # attribution — the audited decision names the hot STAGE, not
+        # just the hot replica
+        effect["stage"] = str(stage)
+    return effect
 
 
 def builtin_rules() -> List[Rule]:
@@ -413,6 +420,11 @@ def builtin_rules() -> List[Rule]:
                  cooldown=demote_cd)),
         Rule(name="fleet_hot_replica", plane="serve",
              kind="hot_replica", min_severity="warn", enabled=_pol,
+             action=Action(
+                 name="route_weight", apply=_route_weight_apply,
+                 audit_op="fleet_route", cooldown=demote_cd)),
+        Rule(name="req_slo_breach", plane="serve",
+             kind="slo_breach", min_severity="warn", enabled=_pol,
              action=Action(
                  name="route_weight", apply=_route_weight_apply,
                  audit_op="fleet_route", cooldown=demote_cd)),

@@ -590,12 +590,14 @@ class ServingEngine:
 
     # -- serving entry points ----------------------------------------------
 
-    def prefill(self, slot: int, prompt: np.ndarray):
+    def prefill(self, slot: int, prompt: np.ndarray,
+                rid: Any = None):
         """Run one request's prompt through the decode-layout model:
         writes its KV pages, returns (first greedy token, last-position
         logits (tp, 1, V)).  Prompts pad to a small power-of-2 bucket
         so compilations stay bounded; padded positions write to the
-        scratch page and never enter the causal window."""
+        scratch page and never enter the causal window.  ``rid`` tags
+        the emitted span with the owning request (CL008)."""
         from .. import trace
         prompt = np.asarray(prompt, np.int32)
         s = int(prompt.shape[0])
@@ -620,7 +622,8 @@ class ServingEngine:
             if trace.enabled:
                 trace.record_span("serve:prefill", "serve", t0,
                                   time.perf_counter(),
-                                  args={"slot": slot, "prompt_len": s})
+                                  args={"slot": slot, "prompt_len": s,
+                                        "rid": rid})
         self.cache.seq_lens[slot] = s
         return int(np.asarray(jax.device_get(nxt))[0, 0]), logits
 
@@ -661,6 +664,7 @@ class ServingEngine:
                 jax.block_until_ready(nxt)
         finally:
             if trace.enabled:
+                # comm-lint: disable=CL008 batch-scoped decode span covers every live rid at once
                 trace.record_span(
                     "serve:decode_step", "serve", t0,
                     time.perf_counter(),
@@ -724,6 +728,7 @@ class ServingEngine:
                 jax.block_until_ready(nxt)
         finally:
             if trace.enabled:
+                # comm-lint: disable=CL008 batch-scoped verify window covers every live rid at once
                 trace.record_span(
                     "serve:decode_window", "serve", t0,
                     time.perf_counter(),

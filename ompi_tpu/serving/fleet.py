@@ -74,6 +74,7 @@ _reshard = importlib.import_module("ompi_tpu.parallel.reshard")
 from ..parallel.collectives import DeviceComm
 from ..parallel.hierarchy import classify_axes
 from ..parallel.mesh import make_mesh
+from . import requests as _requests
 from .engine import ServingEngine
 from .scheduler import (ContinuousBatchingScheduler, FleetRouter,
                         Request, _Active)
@@ -122,6 +123,7 @@ class _ReplicaScheduler(ContinuousBatchingScheduler):
                  **kw: Any) -> None:
         super().__init__(replica.engine, requests, **kw)
         self.replica = replica
+        self.rank = replica.idx            # request-plane lane
         self.itl: List[float] = []
         self._last_t: Dict[Any, float] = {}
 
@@ -171,16 +173,33 @@ class _DisaggScheduler(_ReplicaScheduler):
             if serving.enabled:
                 serving.note_admit(req.rid, len(req.prompt),
                                    req.max_new, req.arrival, pre.clock)
+            if _requests.enabled:
+                _requests.note_admit(req.rid, req.arrival, pre.clock,
+                                     len(req.prompt), req.max_new,
+                                     replica=dec.idx, rank=pre.idx)
             pslot = pcache.admit(len(req.prompt), req.max_new)
             t0 = time.perf_counter()
-            first, _ = pre.engine.prefill(pslot, req.prompt)
+            first, _ = pre.engine.prefill(pslot, req.prompt,
+                                          rid=req.rid)
             pdur = time.perf_counter() - t0
+            # bench --slo fault injection: a slowed prefill replica is
+            # a multiplier on the VIRTUAL prefill duration, so the lane
+            # clock, the goodput split and the request plane's prefill
+            # stage all degrade consistently
+            scale = float(_var.get("serve_req_chaos_prefill_scale", 1.0))
+            if scale != 1.0:
+                pdur *= max(scale, 0.0)
             pre.clock += pdur
             pre.prefills += 1
             pre.prefill_s += pdur
             if serving.enabled:
                 serving.note_prefill(pdur, len(req.prompt))
                 serving.note_token(req.rid, pre.clock)
+            if _requests.enabled:
+                _requests.note_stage(req.rid, "prefill",
+                                     pre.clock - pdur, pre.clock,
+                                     rank=pre.idx)
+                _requests.note_token(req.rid, pre.clock, rank=pre.idx)
             self._last_t[req.rid] = pre.clock
             eos = (req.eos_id if req.eos_id is not None else self.eos_id)
             if (eos is not None and first == eos) or req.max_new <= 1:
@@ -193,13 +212,27 @@ class _DisaggScheduler(_ReplicaScheduler):
                     "finished_at": pre.clock}
                 if serving.enabled:
                     serving.note_evict(req.rid, reason, pre.clock)
+                if _requests.enabled:
+                    _requests.note_finish(req.rid, pre.clock, reason)
                 continue
             t0 = time.perf_counter()
             dslot = self.fleet.migrate(pre, dec, pslot,
                                        len(req.prompt), req.max_new,
                                        rid=req.rid)
-            pre.clock += time.perf_counter() - t0
+            mdur = time.perf_counter() - t0
+            # bench --slo fault injection: a degraded migration lane is
+            # extra virtual delay on every KV hand-off hop
+            mdur += 1e-3 * float(_var.get("serve_req_chaos_migrate_ms",
+                                          0.0))
+            pre.clock += mdur
             pcache.release(pslot)
+            if _requests.enabled:
+                last = _reshard.report()["last"] or {}
+                _requests.note_stage(
+                    req.rid, "migrate", pre.clock - mdur, pre.clock,
+                    rank=pre.idx, src=pre.idx, dst=dec.idx,
+                    wire_bytes=int(last.get("wire_bytes", 0)),
+                    link="decide:reshard")
             self.ready.append((pre.clock, req, dslot, first))
 
     def _join_ready(self) -> None:
@@ -208,6 +241,9 @@ class _DisaggScheduler(_ReplicaScheduler):
             if t <= self.clock:
                 self.active[dslot] = _Active(req=req, slot=dslot,
                                              tokens=[first], last=first)
+                if _requests.enabled:
+                    _requests.note_stage(req.rid, "join", t, self.clock,
+                                         rank=self.replica.idx)
             else:
                 rest.append((t, req, dslot, first))
         self.ready = rest

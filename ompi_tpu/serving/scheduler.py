@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import serving
+from . import requests as _requests
 
 
 @dataclass
@@ -95,6 +96,7 @@ class ContinuousBatchingScheduler:
         self.pending: List[Request] = sorted(requests,
                                              key=lambda r: r.arrival)
         self.active: Dict[int, _Active] = {}       # slot -> state
+        self.rank = 0                  # request-plane lane (replica id)
         self.clock = 0.0
         self.decode_steps = 0
         self.decode_s = 0.0
@@ -114,8 +116,12 @@ class ContinuousBatchingScheduler:
             serving.note_admit(req.rid, len(req.prompt), req.max_new,
                                req.arrival, self.clock)
             serving.set_pages_used(cache.pages_used)
+        if _requests.enabled:
+            _requests.note_admit(req.rid, req.arrival, self.clock,
+                                 len(req.prompt), req.max_new,
+                                 replica=self.rank)
         t0 = time.perf_counter()
-        first, _ = self.engine.prefill(slot, req.prompt)
+        first, _ = self.engine.prefill(slot, req.prompt, rid=req.rid)
         dur = time.perf_counter() - t0
         self.clock += dur
         st = _Active(req=req, slot=slot, tokens=[first], last=first)
@@ -123,6 +129,10 @@ class ContinuousBatchingScheduler:
         if serving.enabled:
             serving.note_prefill(dur, len(req.prompt))
             serving.note_token(req.rid, self.clock)
+        if _requests.enabled:
+            _requests.note_stage(req.rid, "prefill", self.clock - dur,
+                                 self.clock, rank=self.rank)
+            _requests.note_token(req.rid, self.clock, rank=self.rank)
         self._on_token(st)
         self._maybe_finish(st, first)
 
@@ -135,6 +145,8 @@ class ContinuousBatchingScheduler:
         if serving.enabled:
             serving.note_evict(st.req.rid, reason, self.clock)
             serving.set_pages_used(self.engine.cache.pages_used)
+        if _requests.enabled:
+            _requests.note_finish(st.req.rid, self.clock, reason)
 
     def _maybe_finish(self, st: _Active, tok: int) -> bool:
         eos = (st.req.eos_id if st.req.eos_id is not None
@@ -213,6 +225,9 @@ class ContinuousBatchingScheduler:
             st.last = tok
             if serving.enabled:
                 serving.note_token(st.req.rid, self.clock)
+            if _requests.enabled:
+                _requests.note_token(st.req.rid, self.clock,
+                                     rank=self.rank)
             self._on_token(st)
             self._maybe_finish(st, tok)
         host = time.perf_counter() - th0
@@ -295,6 +310,9 @@ class ContinuousBatchingScheduler:
                 emitted += 1
                 if serving.enabled:
                     serving.note_token(st.req.rid, self.clock)
+                if _requests.enabled:
+                    _requests.note_token(st.req.rid, self.clock,
+                                         rank=self.rank)
                 self._on_token(st)
                 if self._maybe_finish(st, tok):
                     finished = True
@@ -380,4 +398,8 @@ class FleetRouter:
         self._credits[pick] -= 1.0
         if serving.enabled:
             serving.note_route(rid, pick, eff)
+        if _requests.enabled:
+            # the weight snapshot rides the route DECISION event too, so
+            # "why this replica" is answerable from the trace alone
+            _requests.note_route(rid, pick, eff)
         return pick

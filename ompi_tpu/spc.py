@@ -135,6 +135,15 @@ COUNTERS = [
      "wire bytes moved by KV-page migrations"),
     ("fleet_rebalances",
      "route_weight adaptations applied to the fleet router"),
+    # request plane (fed by ompi_tpu/serving/requests; process-wide)
+    ("req_active",
+     "requests currently in flight through the request plane"),
+    ("req_completed",
+     "requests finished end-to-end (stage tree folded or kept)"),
+    ("req_slo_breaches",
+     "finished requests that breached a TTFT/ITL/e2e SLO target"),
+    ("req_exemplars_kept",
+     "full span trees held in the slowest-k + breach reservoir"),
 ]
 
 
@@ -208,6 +217,10 @@ class Counters:
             from . import serving
             if name in serving.FLEET_PVARS:
                 return serving.fleet_pvar_value(name)
+        if name.startswith("req_"):
+            from .serving import requests
+            if name in requests.PVARS:
+                return requests.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -242,6 +255,9 @@ class Counters:
             out[name] = serving.pvar_value(name)
         for name in serving.FLEET_PVARS:
             out[name] = serving.fleet_pvar_value(name)
+        from .serving import requests
+        for name in requests.PVARS:
+            out[name] = requests.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:
@@ -319,4 +335,8 @@ def export_prometheus(ctx, comm=None, prefix: str = "ompi_tpu") -> str:
     trows = traffic.prometheus_rows(rank, comm=label, prefix=prefix)
     if trows:
         text += "\n".join(trows) + "\n"
+    from .serving import requests
+    rrows = requests.prometheus_rows(rank, comm=label, prefix=prefix)
+    if rrows:
+        text += "\n".join(rrows) + "\n"
     return text

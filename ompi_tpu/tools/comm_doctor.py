@@ -43,8 +43,10 @@ from ..trace import merge as _merge
 # 11 = the policy-plane section: verdict->vote->action->effect
 #      ledger with attribution, ISSUE 17;
 # 12 = the serving-fleet section: per-replica rows, migration
-#      ledger, router decision table, ISSUE 18)
-SCHEMA_VERSION = 12
+#      ledger, router decision table, ISSUE 18;
+# 13 = the request-plane section: per-request stage waterfall,
+#      tail-attribution rollup, SLO judge counters, ISSUE 19)
+SCHEMA_VERSION = 13
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -800,6 +802,84 @@ def build_fleet_report(
     return "\n".join(lines), rep
 
 
+def build_requests_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the request plane: headline
+    counters, the SLO judge targets, per-stage latency quantiles, the
+    tail-attribution rollup and an ASCII waterfall of the slowest kept
+    exemplar.  ``path`` loads a banked REQUESTS json (bench.py --slo);
+    default reads the live in-process request ledger."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from ..serving import requests as _requests
+        rep = _requests.report()
+    lines: List[str] = []
+    w = lines.append
+    src = f" (from {path})" if path else ""
+    w(f"requests: {int(rep.get('completed', 0))} completed, "
+      f"{int(rep.get('active', 0))} active, "
+      f"{int(rep.get('slo_breaches', 0))} SLO breach(es) in "
+      f"{int(rep.get('episodes', 0))} episode(s), "
+      f"{int(rep.get('exemplars_kept', 0))} exemplar(s) kept{src}")
+    slo = rep.get("slo") or {}
+    targets = [f"{k}<={float(v):g}ms" for k, v in sorted(slo.items())
+               if float(v or 0.0) > 0.0]
+    w("  SLO: " + (" ".join(targets) if targets
+                   else "no targets set (judge disarmed)"))
+    e2e = rep.get("e2e") or {}
+    if e2e.get("count"):
+        w(f"  e2e: p50 {float(e2e.get('p50_ms', 0.0)):.2f} ms  "
+          f"p99 {float(e2e.get('p99_ms', 0.0)):.2f} ms  "
+          f"over {int(e2e['count'])} request(s)")
+    stages = rep.get("stages") or {}
+    if stages:
+        w("  stage           count    p50 ms    p99 ms")
+        for name, row in stages.items():
+            w(f"    {name:<12} {int(row.get('count', 0)):>6}  "
+              f"{float(row.get('p50_ms', 0.0)):>8.2f}  "
+              f"{float(row.get('p99_ms', 0.0)):>8.2f}")
+    rollup = rep.get("tail_attribution") or {}
+    if rollup:
+        total = sum(rollup.values()) or 1
+        parts = [f"{k}={v} ({100.0 * v / total:.0f}%)" for k, v in
+                 sorted(rollup.items(), key=lambda kv: -kv[1])]
+        w("  tail attribution (kept exemplars): " + "  ".join(parts))
+    brollup = rep.get("breach_attribution") or {}
+    if brollup:
+        parts = [f"{k}={v}" for k, v in
+                 sorted(brollup.items(), key=lambda kv: -kv[1])]
+        w("  breach attribution: " + "  ".join(parts))
+    exemplars = rep.get("exemplars") or []
+    if exemplars:
+        worst = max(exemplars,
+                    key=lambda e: float(e.get("e2e_ms", 0.0)))
+        span = max(float(worst.get("e2e_ms", 0.0)), 1e-9)
+        arrival = float(worst.get("arrival", 0.0))
+        w(f"  slowest exemplar rid {worst.get('rid')!s} "
+          f"(replica {int(worst.get('replica', 0))}, "
+          f"{float(worst.get('e2e_ms', 0.0)):.2f} ms e2e, "
+          f"attributed {worst.get('attributed_stage')}"
+          + (", BREACH" if worst.get("breach") else "") + "):")
+        width = 40
+        for s in worst.get("spans") or []:
+            off = 1e3 * (float(s.get("t0", arrival)) - arrival)
+            dur = 1e3 * (float(s.get("t1", 0.0)) - float(s.get("t0", 0.0)))
+            lo = int(round(width * max(off, 0.0) / span))
+            n = max(1, int(round(width * max(dur, 0.0) / span)))
+            bar = " " * min(lo, width - 1) + "#" * min(n, width - lo)
+            w(f"    {str(s.get('stage', '?')):<8} r{int(s.get('rank', 0))} "
+              f"|{bar:<{width}}| {dur:8.2f} ms")
+        cons = worst.get("conservation") or {}
+        if cons:
+            w(f"    stage sum {float(cons.get('stage_sum_ms', 0.0)):.2f} ms"
+              f" vs e2e {float(cons.get('e2e_ms', 0.0)):.2f} ms"
+              f" (resid {float(cons.get('resid_ms', 0.0)):.4f} ms)")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -910,6 +990,13 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "table. With a path, loads a banked FLEET "
                          "json (bench.py --fleet); bare flag reads "
                          "the live in-process fleet ledger")
+    ap.add_argument("--requests", nargs="?", const="", default=None,
+                    metavar="REQUESTS.json",
+                    help="render the request-plane section: per-request "
+                         "stage waterfall, tail-attribution rollup and "
+                         "the SLO judge counters. With a path, loads a "
+                         "banked REQUESTS json (bench.py --slo); bare "
+                         "flag reads the live in-process request ledger")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -949,7 +1036,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 or ns.reshard is not None or ns.analyze is not None
                 or ns.ft is not None or ns.moe is not None
                 or ns.serve is not None or ns.policy is not None
-                or ns.fleet is not None):
+                or ns.fleet is not None or ns.requests is not None):
             # plane sections render standalone (no merged timeline)
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
@@ -1011,6 +1098,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         fltext, fldata = build_fleet_report(ns.fleet or None)
         text = (text + "\n" + fltext) if text else fltext
         data["fleet"] = fldata
+    if getattr(ns, "requests", None) is not None:
+        rqtext, rqdata = build_requests_report(ns.requests or None)
+        text = (text + "\n" + rqtext) if text else rqtext
+        data["requests"] = rqdata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

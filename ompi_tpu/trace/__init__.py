@@ -168,6 +168,23 @@ def instant(name: str, cat: str = "event", rank: int = 0,
            "rank": int(rank), "args": args or {}})
 
 
+_FLOW_PHASES = ("s", "t", "f")
+
+
+def flow(name: str, cat: str, fid: int, ph: str, rank: int = 0,
+         t: Optional[float] = None, args: Optional[dict] = None) -> None:
+    """Record one Chrome-trace flow event — the arrow primitive that links
+    work across (pid, tid) lanes.  ``ph`` is "s" (start), "t" (step) or
+    "f" (finish); events sharing (cat, fid) render as one arrow chain in
+    Perfetto.  Flow events are zero-duration, so the per-lane span
+    non-overlap invariant is untouched."""
+    if ph not in _FLOW_PHASES:
+        raise ValueError(f"flow phase must be one of {_FLOW_PHASES}: {ph!r}")
+    _emit({"name": name, "cat": cat, "ph": ph, "id": int(fid),
+           "t": time.perf_counter() if t is None else t,
+           "rank": int(rank), "args": args or {}})
+
+
 # One downstream consumer may register for span completions (the perf
 # cost model ingests grad_sync bucket spans this way).  A sink failure
 # must never take down the traced operation itself.
@@ -332,6 +349,12 @@ def chrome_doc(evs: List[dict], t0: float) -> dict:
             row["dur"] = max(0, int((e["t"] + e["dur"] - t0) * 1e6) - ts)
         elif e["ph"] == "i":
             row["s"] = "t"
+        elif e["ph"] in _FLOW_PHASES:
+            # flow arrows bind by (cat, id); "bp":"e" attaches the
+            # finish end to the enclosing slice rather than the lane
+            row["id"] = int(e.get("id", 0))
+            if e["ph"] == "f":
+                row["bp"] = "e"
         rows.append(row)
     meta: List[dict] = []
     for pid in sorted(pids):

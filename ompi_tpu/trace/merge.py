@@ -219,7 +219,7 @@ def load_chrome(paths: Sequence[str],
         pids = {r["pid"] for r in rows if r.get("ph") != "M"}
         remap = (ranks is not None and len(pids) == 1)
         for r in rows:
-            if r.get("ph") not in ("X", "i"):
+            if r.get("ph") not in ("X", "i", "s", "t", "f"):
                 continue
             rank = int(ranks[i]) if remap else int(r["pid"])
             ev = {"name": r["name"], "cat": r.get("cat", "event"),
@@ -227,6 +227,9 @@ def load_chrome(paths: Sequence[str],
                   "args": r.get("args", {})}
             if r["ph"] == "X":
                 ev["dur"] = r.get("dur", 0) / 1e6
+            elif r["ph"] in ("s", "t", "f"):
+                # flow arrows (request hand-offs) bind by id — keep it
+                ev["id"] = int(r.get("id", 0))
             out.setdefault(rank, []).append(ev)
     return out
 
