@@ -9,7 +9,7 @@ SHELL := /bin/bash
 	health-tests perf-tests traffic-tests hier-tests numerics-tests \
 	reshard-tests analysis-tests ft-elastic-tests moe-tests \
 	serve-tests decode-tests policy-tests fleet-tests request-tests \
-	comm-lint bench-compare
+	history-tests comm-lint bench-compare
 
 # the health-plane gate runs FIRST: its suite is seconds-cheap and its
 # end-to-end probe (an 8-rank fleet with an injected one-rank stall the
@@ -34,7 +34,7 @@ SHELL := /bin/bash
 # measured second
 tier1: analysis-tests health-tests perf-tests traffic-tests hier-tests \
 	numerics-tests reshard-tests ft-elastic-tests moe-tests serve-tests \
-	decode-tests policy-tests fleet-tests request-tests
+	decode-tests policy-tests fleet-tests request-tests history-tests
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors \
@@ -219,6 +219,18 @@ request-tests:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_requests.py -q \
 	  -p no:cacheprovider -p no:randomly
 	env JAX_PLATFORMS=cpu python bench.py --slo
+
+# the history tier: the fleet-lifetime run ledger + deterministic
+# changepoint kernel suite, then the end-to-end probe (a 12-run
+# synthetic trajectory with an injected -20% step and -2%/run drift
+# the detector must attribute to exactly those two (metric, run_id)
+# changepoints with zero false positives, the history_regression
+# verdict answered by one audited decide:policy, and the episode
+# re-armed after a recovered run; banks HISTORY_<platform>.json)
+history-tests:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_history.py -q \
+	  -p no:cacheprovider -p no:randomly
+	env JAX_PLATFORMS=cpu python bench.py --history
 
 # the static-analysis tier: jaxpr collective extraction + SPMD checks
 # + comm-lint + DEVICE_RULES validator suite, then the end-to-end probe

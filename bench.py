@@ -1555,6 +1555,117 @@ def _bank_r06(here: str, sweep: dict) -> None:
                    int(sweep.get("ndev", 0) or 0), phases)
 
 
+def _bank_history(platform: str, probe: str, doc: dict) -> None:
+    """Append this probe's headline gauges as one history-plane run to
+    BENCH_HISTORY.jsonl (next to the banked artifact).  run_id is the
+    next index per (platform, probe) derived from ledger content — no
+    wall clock anywhere.  Best-effort: a broken ledger must never fail
+    a probe that already banked its artifact."""
+    from ompi_tpu import history
+    from ompi_tpu.core import var
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "BENCH_HISTORY.jsonl")
+    var.registry.set_cli("history_path", path)
+    var.registry.reset_cache()
+    try:
+        history.reset()
+        history.enable()                 # rehydrates from the jsonl
+        rid = history.next_run_id(platform, probe)
+        for metric, value, unit in history.headline_rows(probe, doc):
+            history.record_run(rid, platform, probe, metric, value,
+                               unit=unit)
+        print(json.dumps({"history_banked": {
+            "probe": probe, "run_id": rid,
+            "rows": len(history.headline_rows(probe, doc)),
+            "ledger": os.path.basename(path)}}), flush=True)
+    except Exception as exc:             # noqa: BLE001
+        print(f"bench: history append skipped ({exc})", flush=True)
+    finally:
+        var.registry.clear_cli("history_path")
+        var.registry.reset_cache()
+        history.disable()
+        history.reset()
+
+
+def run_compare_against_history(new_path: str,
+                                hist_path: Optional[str] = None,
+                                window: int = 5) -> None:
+    """--compare NEW.json --against-history [HISTORY.jsonl]: gate a
+    fresh artifact against the trajectory median of the last K banked
+    runs instead of one hand-picked OLD artifact.  Exits non-zero
+    naming the regressed metric AND the first regressed run_id (the
+    changepoint onset when the detector attributes one, else the
+    incoming run).  Pure file arithmetic — no jax init."""
+    from ompi_tpu import history
+    from ompi_tpu.history import HistoryStore, bad_direction, detect
+
+    new = _load_json(new_path)
+    if new is None:
+        raise SystemExit(f"bench compare: unreadable artifact "
+                         f"({new_path})")
+    here = os.path.dirname(os.path.abspath(__file__))
+    hist_path = hist_path or os.path.join(here, "BENCH_HISTORY.jsonl")
+    store = HistoryStore()
+    if not store.load_jsonl(hist_path):
+        raise SystemExit(f"bench compare: no history rows in "
+                         f"{hist_path} (run probes or "
+                         f"tools/history_backfill.py first)")
+    platform = str(new.get("platform", "")) or None
+    # the probe owning this artifact = the one whose banked trajectory
+    # carries the doc's own headline metric
+    probe = next((p for p, m in store.metrics()
+                  if m == str(new.get("metric", ""))), None)
+    if probe is None:
+        raise SystemExit(
+            f"bench compare: metric {new.get('metric')!r} has no "
+            f"banked trajectory in {hist_path}")
+    window = max(int(window), 1)
+    regressions, checked = [], 0
+    for metric, value, _unit in history.headline_rows(probe, new):
+        traj = store.trajectory(probe, metric, platform)
+        if not traj:
+            continue
+        tail = [v for _, v in traj[-window:]]
+        med = float(np.median(tail))
+        if med == 0.0:
+            continue
+        checked += 1
+        bad = bad_direction(metric)
+        worse = (value < 0.9 * med if bad == "down"
+                 else value > 1.1 * med)
+        if not worse:
+            continue
+        # first regressed run_id: the changepoint onset over the
+        # trajectory extended by the incoming value; when the detector
+        # stays quiet the incoming run itself is the onset
+        run_ids = [rid for rid, _ in traj]
+        next_rid = store.next_run_id(
+            platform or str(new.get("platform", "")), probe)
+        cps = [c for c in detect([v for _, v in traj] + [value])
+               if c["direction"] == bad]
+        first_rid = (run_ids + [next_rid])[cps[-1]["index"]] \
+            if cps else next_rid
+        regressions.append(
+            f"{probe}/{metric}: {value:g} vs median({len(tail)} "
+            f"run(s)) {med:g} ({(value / med - 1) * 100:+.1f}%), "
+            f"first regressed run_id {first_rid}")
+    print(json.dumps({
+        "metric": "bench_compare_history",
+        "value": float(len(regressions)),
+        "unit": f"metrics regressed vs trajectory median "
+                f"(last {window} run(s))",
+        "new": new_path, "history": hist_path, "probe": probe,
+        "columns_checked": checked,
+        "regressions": regressions,
+    }))
+    if regressions:
+        raise SystemExit("bench compare: regression vs history in "
+                         + "; ".join(regressions))
+    if not checked:
+        raise SystemExit(f"bench compare: no comparable metrics "
+                         f"between {new_path} and {hist_path}")
+
+
 def run_compare(old_path: str, new_path: str) -> None:
     """--compare OLD.json NEW.json: diff two bench-trajectory artifacts
     (BENCH_r06.json schema) on the higher-is-better columns and exit
@@ -1871,6 +1982,7 @@ def run_goodput_probe(platform: str) -> None:
                   "w") as f:
             json.dump(doc, f, indent=1)
         print(json.dumps(doc), flush=True)
+        _bank_history(platform, "goodput", doc)
 
         gp = cols["goodput"]
         bad = [k for k, v in gp.items()
@@ -1975,6 +2087,7 @@ def run_traffic_probe(platform: str) -> None:
             json.dump(doc, f, indent=1)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "traffic"}), flush=True)
+        _bank_history(platform, "traffic", doc)
 
         if res["traffic_hotlink_trips"] != 1 or len(verdicts) != 1:
             raise SystemExit(
@@ -2102,6 +2215,7 @@ def run_pod_probe(platform: str) -> None:
             json.dump(doc, f, indent=1)
         print(json.dumps({k: v for k, v in doc.items() if k != "arms"}),
               flush=True)
+        _bank_history(platform, "pod", doc)
 
         # 1. the audit names each executed arm
         for arm in ("native", "hier", "hier+quant"):
@@ -2265,6 +2379,7 @@ def run_numerics_probe(platform: str) -> None:
             json.dump(doc, f, indent=1)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "report"}), flush=True)
+        _bank_history(platform, "numerics", doc)
 
         if len(nf_verdicts) != 1:
             raise SystemExit(
@@ -2607,6 +2722,7 @@ def run_reshard_probe(platform: str) -> None:
             json.dump(doc, f, indent=1)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "report"}), flush=True)
+        _bank_history(platform, "reshard", doc)
 
         if res["device_s"] >= res["host_s"]:
             raise SystemExit(
@@ -2861,6 +2977,7 @@ def run_elastic_probe(platform: str) -> None:
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "report"}), flush=True)
         _bank_elastic_baseline(doc)
+        _bank_history(platform, "elastic", doc)
     finally:
         var.registry.clear_cli("traffic_enabled")
         var.registry.clear_cli("coll_xla_mode")
@@ -3183,6 +3300,7 @@ def run_moe_probe(platform: str) -> None:
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "report"}), flush=True)
         _bank_moe_baseline(doc)
+        _bank_history(platform, "moe", doc)
     finally:
         for name in ("topo_sim_dcn_axes", "coll_xla_moe_dispatch_mode",
                      "coll_xla_moe_combine_mode"):
@@ -3684,6 +3802,7 @@ def run_serve_probe(platform: str) -> None:
                           if k not in ("report", "decisions")}),
               flush=True)
         _bank_serve_baseline(doc)
+        _bank_history(platform, "serve", doc)
     finally:
         serving.reset()
         serving.disable()
@@ -3937,6 +4056,7 @@ def run_fleet_probe(platform: str) -> None:
                           if k not in ("report", "migration",
                                        "arms")}),
               flush=True)
+        _bank_history(platform, "fleet", doc)
         _bank_fleet_baseline(doc)
     finally:
         var.registry.clear_cli("topo_sim_dcn_axes")
@@ -4225,6 +4345,7 @@ def run_slo_probe(platform: str) -> None:
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "report"}), flush=True)
         _bank_requests_baseline(doc)
+        _bank_history(platform, "slo", doc)
     finally:
         for name in ("topo_sim_dcn_axes", "topo_sim_dcn_us_per_mib",
                      "policy_enabled", "serve_req_slo_e2e_ms",
@@ -4464,6 +4585,7 @@ def run_selfdrive_probe(platform: str) -> None:
             json.dump(doc, f, indent=1)
         print(json.dumps({k: v for k, v in doc.items()
                           if k != "report"}), flush=True)
+        _bank_history(platform, "selfdrive", doc)
 
         if res["retune_step"] is None or res["last"].get("arm") \
                 != "quant":
@@ -4506,10 +4628,230 @@ def run_selfdrive_probe(platform: str) -> None:
         trace.disable()
 
 
+def _hist_lcg(seed: int):
+    """Deterministic noise source for the history probe's synthetic
+    trajectories (no numpy RNG, no wall clock): yields in [-1, 1)."""
+    s = (int(seed) * 2654435761) & 0x7FFFFFFF
+    while True:
+        s = (1103515245 * s + 12345) & 0x7FFFFFFF
+        yield (s / 0x7FFFFFFF) * 2.0 - 1.0
+
+
+def run_history_probe(platform: str) -> None:
+    """--history: end-to-end acceptance for the history plane — the
+    fleet-lifetime trajectory judged by the deterministic changepoint
+    kernel.  Synthesizes a 12-run ledger with a known step regression
+    (decode tokens/s -20% from run 8), a known slow drift (busbw
+    -2%/run) and clean control metrics, then requires: exactly those
+    two (metric, run_id) changepoints and ZERO false positives; the
+    history_regression verdict on the policy bus driving one audited
+    decide:policy adaptation; the episode re-armed after a recovered
+    run (a later regression is a NEW episode); and comm_doctor
+    --history rendering the same trajectory from the banked
+    HISTORY_<platform>.json.  Banks HISTORY_<platform>.json."""
+    import tempfile
+
+    import jax
+
+    from ompi_tpu import history, policy, trace
+    from ompi_tpu.core import var
+    from ompi_tpu.tools.comm_doctor import (SCHEMA_VERSION,
+                                            build_history_report)
+
+    ndev = len(jax.devices())
+    here = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="ompi_tpu_history_probe_")
+    ledger_path = os.path.join(tmp, "BENCH_HISTORY.jsonl")
+
+    RUNS = 12
+    STEP_AT = 8                   # decode tokens/s -20% from run 8
+    DRIFT_PCT = 0.02              # busbw -2% per run
+    # pinned kernel attribution for the drift ramp: the half-max onset
+    # rule lands mid-ramp, deterministically (see tests/test_history)
+    DRIFT_ONSET = 7
+
+    var.registry.set_cli("history_enabled", "true")
+    var.registry.set_cli("history_path", ledger_path)
+    var.registry.set_cli("policy_enabled", "true")
+    var.registry.reset_cache()
+    history.reset()
+    policy.reset()
+    trace.enable()
+    trace.clear()
+    try:
+        history.enable()
+        policy.enable()
+
+        noise = _hist_lcg(20)
+        for i in range(RUNS):
+            rid = i + 1
+            tok = 220.0 * (0.8 if rid >= STEP_AT else 1.0) \
+                * (1.0 + 0.005 * next(noise))
+            history.record_run(rid, platform, "serve",
+                               "decode_tokens_per_s", tok,
+                               unit="tokens/s")
+            bw = 1.8 * (1.0 - DRIFT_PCT * i)
+            history.record_run(rid, platform, "reshard", "busbw_GBps",
+                               bw, unit="GB/s")
+            # clean controls: same noise floor, no injected shift
+            history.record_run(rid, platform, "goodput", "goodput_pct",
+                               81.0 * (1.0 + 0.005 * next(noise)),
+                               unit="%")
+            history.record_run(rid, platform, "goodput", "mfu_pct",
+                               38.0 * (1.0 + 0.005 * next(noise)),
+                               unit="%")
+
+        fresh = history.scan(platform)
+        flagged = {(v["metric"], v["run_id"]) for v in fresh
+                   if v["scope"] == "runs"}
+        want = {("decode_tokens_per_s", STEP_AT),
+                ("busbw_GBps", DRIFT_ONSET)}
+
+        # determinism: the identical ledger rehydrated into a fresh
+        # store must attribute the identical changepoint set
+        replay = history.HistoryStore()
+        replay.load_jsonl(ledger_path)
+        replay_keys = set()
+        for probe, metric in replay.metrics():
+            traj = replay.trajectory(probe, metric, platform)
+            for cp in history.detect([v for _, v in traj]):
+                replay_keys.add((metric, traj[cp["index"]][0]))
+
+        # the verdict landed on the policy bus and the builtin
+        # history_demote_quant rule answered with ONE audited decision
+        rep = policy.report()
+        bus_hist = [v for v in rep["verdicts"]
+                    if v["plane"] == "history"
+                    and v["kind"] == "history_regression"]
+        decide_events = [e for e in trace.events()
+                         if e.get("name") == "decide:policy"
+                         and (e.get("args", {}).get("verdict") or
+                              {}).get("plane") == "history"]
+
+        # episode re-arm: a recovered run 13 ends the episode; a fresh
+        # regression at 14-15 must be attributed as a NEW episode
+        noise2 = _hist_lcg(21)
+        history.record_run(13, platform, "serve",
+                           "decode_tokens_per_s",
+                           220.0 * (1.0 + 0.005 * next(noise2)),
+                           unit="tokens/s")
+        for rid in (14, 15):
+            history.record_run(rid, platform, "serve",
+                               "decode_tokens_per_s",
+                               176.0 * (1.0 + 0.005 * next(noise2)),
+                               unit="tokens/s")
+        again = history.scan(platform)
+        second = [v for v in again if v["metric"] ==
+                  "decode_tokens_per_s" and v["scope"] == "runs"]
+
+        doc = {
+            "metric": "history_changepoints",
+            "value": float(len(flagged)),
+            "unit": "run-over-run changepoints attributed "
+                    "(want exactly 2)",
+            "platform": platform, "ndev": ndev,
+            "runs": RUNS,
+            "injected": {
+                "step": {"metric": "decode_tokens_per_s",
+                         "run_id": STEP_AT, "drop_pct": 20.0},
+                "drift": {"metric": "busbw_GBps",
+                          "pct_per_run": 100.0 * DRIFT_PCT,
+                          "expected_onset_run_id": DRIFT_ONSET},
+            },
+            "flagged": sorted(flagged),
+            "replay_flagged": sorted(replay_keys),
+            "bus_verdicts": bus_hist,
+            "decide_events": len(decide_events),
+            "second_episode": second,
+            "schema_version_doctor": SCHEMA_VERSION,
+            "pvars": {name: history.pvar_value(name)
+                      for name in history.PVARS},
+            "report": history.report(),
+        }
+        banked_path = os.path.join(here, f"HISTORY_{platform}.json")
+        with open(banked_path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(json.dumps({k: v for k, v in doc.items()
+                          if k != "report"}), flush=True)
+
+        if flagged != want:
+            raise SystemExit(
+                f"history probe: changepoints {sorted(flagged)} != "
+                f"injected {sorted(want)} (false positive or missed "
+                "attribution)")
+        if replay_keys != want:
+            raise SystemExit(
+                "history probe: rehydrated ledger attributed "
+                f"{sorted(replay_keys)} != {sorted(want)} — the "
+                "kernel must be deterministic over the banked rows")
+        if not bus_hist:
+            raise SystemExit(
+                "history probe: no history_regression verdict reached "
+                "the policy bus")
+        if not decide_events:
+            raise SystemExit(
+                "history probe: the history_demote_quant rule never "
+                "applied — no decide:policy event names a history "
+                "verdict")
+        if len(decide_events) != 1:
+            raise SystemExit(
+                f"history probe: {len(decide_events)} audited "
+                "decisions for one trend — want exactly one per "
+                "adaptation")
+        if [v["run_id"] for v in second] != [14]:
+            raise SystemExit(
+                "history probe: after a recovered run 13 the fresh "
+                "regression at 14 must open a NEW episode (got "
+                f"{[v['run_id'] for v in second]})")
+
+        # doctor round-trip: the banked artifact renders the same
+        # trajectory (the report dict rides under doc["report"])
+        text, data = build_history_report(banked_path)
+        if "decode_tokens_per_s" not in text \
+                or "busbw_GBps" not in text:
+            raise SystemExit(
+                "history probe: comm_doctor --history lost the "
+                "trajectory when rendering the banked artifact")
+        if int(data.get("changepoints", 0)) < 2:
+            raise SystemExit(
+                "history probe: banked report carries "
+                f"{data.get('changepoints')} changepoint(s), want the "
+                "attributed 2+")
+    finally:
+        var.registry.clear_cli("history_enabled")
+        var.registry.clear_cli("history_path")
+        var.registry.clear_cli("policy_enabled")
+        var.registry.set_override("coll_xla_allreduce_mode", "")
+        var.registry.reset_cache()
+        history.disable()
+        history.reset()
+        policy.disable()
+        policy.reset()
+        trace.disable()
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if "--compare" in argv:
         i = argv.index("--compare")
+        if "--against-history" in argv:
+            j = argv.index("--against-history")
+            if len(argv) < i + 2 or argv[i + 1].startswith("--"):
+                raise SystemExit(
+                    "usage: bench.py --compare NEW.json "
+                    "--against-history [HISTORY.jsonl] "
+                    "[--history-window K]")
+            hist = (argv[j + 1] if len(argv) > j + 1
+                    and not argv[j + 1].startswith("--") else None)
+            window = 5
+            if "--history-window" in argv:
+                k = argv.index("--history-window")
+                if len(argv) < k + 2:
+                    raise SystemExit("bench compare: --history-window "
+                                     "needs a run count")
+                window = int(argv[k + 1])
+            run_compare_against_history(argv[i + 1], hist, window)
+            return
         if len(argv) < i + 3:
             raise SystemExit("usage: bench.py --compare OLD.json "
                              "NEW.json")
@@ -4575,6 +4917,9 @@ def main() -> None:
             return
         if "--slo" in sys.argv[1:]:
             run_slo_probe(platform)
+            return
+        if "--history" in sys.argv[1:]:
+            run_history_probe(platform)
             return
 
         # Phase control + incremental banking: the tunneled chip wedges

@@ -143,7 +143,8 @@ _CL002_ENGINE_SUFFIXES = ("ompi_tpu/trace/__init__.py",)
 
 # -- CL004 vocabulary --------------------------------------------------------
 
-_PLANES = ("trace", "traffic", "perf", "numerics", "health", "policy")
+_PLANES = ("trace", "traffic", "perf", "numerics", "health", "policy",
+           "history")
 _PLANE_ENABLED_VARS = frozenset(f"{p}_enabled" for p in _PLANES)
 
 # -- CL005 vocabulary --------------------------------------------------------

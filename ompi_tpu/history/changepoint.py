@@ -1,0 +1,142 @@
+"""Deterministic changepoint kernel — Page-Hinkley / CUSUM over
+MAD-normalized residuals.
+
+One kernel judges both time axes: the run-over-run trajectory of a
+banked gauge and the downsampled within-run step series.  The design
+constraints come straight from the sentry grammar the other planes
+already speak:
+
+* **deterministic** — no wall clock, no randomness; an identical value
+  sequence always yields an identical changepoint list.
+* **min-run-count gate** — the first ``history_cp_min_runs`` points
+  form the baseline (median + MAD); shorter inputs never judge, the
+  same bar as ``perf_sentry_min_samples``.
+* **sustain gate** — a trip needs ``history_cp_sustain`` consecutive
+  out-of-band points; single outliers are noise.
+* **episode semantics** — one trip per degradation episode; a
+  recovered point (residual back inside the delta dead-band) re-arms
+  the side, so a second regression later is a second episode.
+
+The statistic is the classic one-sided CUSUM pair with drift term
+``delta`` (in MAD-normalized units): for the "down" side
+
+    g_t = -r_t - delta        r_t = (x_t - median) / (1.4826 * MAD)
+    S_t = max(S_{t-1} + g_t, 0)
+
+tripping when ``S_t > lambda`` with the sustain gate satisfied.  Onset
+attribution is the standard CUSUM estimate: the first index after the
+statistic last left zero — for a step injected at run k with a shift
+large against the noise floor, that is exactly k.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..core import var as _var
+
+_var.register("history", "cp", "min_runs", 5, type=int, level=3,
+              help="Baseline length for the changepoint kernel; "
+                   "trajectories shorter than this never judge (a "
+                   "two-run ledger cannot define a regression).")
+_var.register("history", "cp", "lambda", 8.0, type=float, level=3,
+              help="CUSUM trip threshold in MAD-normalized units "
+                   "(Page-Hinkley lambda).")
+_var.register("history", "cp", "delta", 0.5, type=float, level=3,
+              help="CUSUM drift dead-band in MAD-normalized units; "
+                   "residuals inside +/-delta count as recovered and "
+                   "re-arm the episode.")
+_var.register("history", "cp", "sustain", 2, type=int, level=3,
+              help="Consecutive out-of-band points required to trip "
+                   "(single outliers are noise).")
+_var.register("history", "cp", "rel_floor", 0.005, type=float, level=4,
+              help="Noise-scale floor as a fraction of |baseline "
+                   "median| — the minimum detectable effect size. A "
+                   "near-constant baseline has a near-zero MAD, which "
+                   "would otherwise inflate sub-noise wiggles into "
+                   "changepoints (and a truly constant one would "
+                   "divide by zero).")
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def _mad(xs: List[float], med: float) -> float:
+    return _median([abs(x - med) for x in xs])
+
+
+def detect(values: List[float],
+           min_runs: Optional[int] = None,
+           lam: Optional[float] = None,
+           delta: Optional[float] = None,
+           sustain: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Scan one value sequence; return changepoints in onset order.
+
+    Each changepoint: ``{"index", "confirm_index", "direction"
+    ("down"/"up"), "magnitude" (relative shift vs baseline median),
+    "stat"}``.  Indices are positions in ``values`` — the caller maps
+    them back to run_ids (trajectory) or step offsets (series).
+    """
+    xs = [float(v) for v in values]
+    n = len(xs)
+    min_runs = max(int(_var.get("history_cp_min_runs", 5)
+                       if min_runs is None else min_runs), 2)
+    lam = float(_var.get("history_cp_lambda", 8.0)
+                if lam is None else lam)
+    delta = float(_var.get("history_cp_delta", 0.5)
+                  if delta is None else delta)
+    sustain = max(int(_var.get("history_cp_sustain", 2)
+                      if sustain is None else sustain), 1)
+    if n < min_runs + sustain:
+        return []
+    base = xs[:min_runs]
+    med = _median(base)
+    mad = _mad(base, med)
+    rel = float(_var.get("history_cp_rel_floor", 0.005))
+    scale = max(1.4826 * mad, abs(med) * rel)
+    if scale <= 0.0:
+        scale = 1.0                      # all-zero baseline
+    out: List[Dict[str, Any]] = []
+    # one-sided CUSUM per direction; each side carries its own episode
+    # state so an up-shift never masks a later down-shift.  A single
+    # in-band point fully re-arms the side (S, streak, trip) — the
+    # same "good sample ends the episode" grammar as perf's sentry.
+    sides = {"down": {"S": 0.0, "gs": [], "tripped": False},
+             "up": {"S": 0.0, "gs": [], "tripped": False}}
+    for t in range(min_runs, n):
+        r = (xs[t] - med) / scale
+        for direction, st in sides.items():
+            g = (-r - delta) if direction == "down" else (r - delta)
+            if g <= 0.0:
+                st["S"] = 0.0
+                st["gs"] = []
+                st["tripped"] = False    # recovered point: re-arm
+                continue
+            st["S"] += g
+            st["gs"].append(g)
+            if (not st["tripped"] and st["S"] > lam
+                    and len(st["gs"]) >= sustain):
+                st["tripped"] = True
+                # onset attribution: within the bad streak, the first
+                # point whose increment reaches half the streak max —
+                # for a step shift large against the noise floor that
+                # is exactly the injection point even when a mildly
+                # low pre-step point opened the streak early
+                gmax = max(st["gs"])
+                lead = next(i for i, gv in enumerate(st["gs"])
+                            if gv >= 0.5 * gmax)
+                onset = t - (len(st["gs"]) - 1) + lead
+                seg = xs[onset:t + 1]
+                mag = ((_median(seg) - med) / abs(med)
+                       if med else _median(seg) - med)
+                out.append({"index": onset, "confirm_index": t,
+                            "direction": direction,
+                            "magnitude": round(mag, 6),
+                            "stat": round(st["S"], 3)})
+    return sorted(out, key=lambda c: (c["index"], c["direction"]))

@@ -428,4 +428,17 @@ def builtin_rules() -> List[Rule]:
              action=Action(
                  name="route_weight", apply=_route_weight_apply,
                  audit_op="fleet_route", cooldown=demote_cd)),
+        # the history plane's trend verdicts reuse the SAME verified
+        # demotion surface as perf's spike rule — a sustained
+        # run-over-run busbw/tokens regression answers like a live one
+        Rule(name="history_demote_quant", plane="history",
+             kind="history_regression", min_severity="warn",
+             enabled=_pol,
+             action=Action(
+                 name="demote_arm_quant",
+                 apply=_set_arm(("allreduce", "grad_sync",
+                                 "reduce_scatter", "allgather"), "quant"),
+                 colls=("allreduce", "grad_sync", "reduce_scatter",
+                        "allgather"),
+                 arm="quant", cooldown=demote_cd)),
     ]

@@ -144,6 +144,13 @@ COUNTERS = [
      "finished requests that breached a TTFT/ITL/e2e SLO target"),
     ("req_exemplars_kept",
      "full span trees held in the slowest-k + breach reservoir"),
+    # history plane (fed by ompi_tpu/history's run ledger)
+    ("history_runs",
+     "distinct (platform, probe, run_id) runs banked in the ledger"),
+    ("history_samples",
+     "history rows appended (monotonic; dedup never decrements)"),
+    ("history_changepoints",
+     "changepoints the trajectory sentry attributed (both directions)"),
 ]
 
 
@@ -221,6 +228,10 @@ class Counters:
             from .serving import requests
             if name in requests.PVARS:
                 return requests.pvar_value(name)
+        if name.startswith("history_"):
+            from . import history
+            if name in history.PVARS:
+                return history.pvar_value(name)
         return self._v.get(name, 0)
 
     def snapshot(self) -> Dict[str, float]:
@@ -258,6 +269,9 @@ class Counters:
         from .serving import requests
         for name in requests.PVARS:
             out[name] = requests.pvar_value(name)
+        from . import history
+        for name in history.PVARS:
+            out[name] = history.pvar_value(name)
         return out
 
     def matrix(self) -> Dict[str, Dict[int, Tuple[int, int]]]:
@@ -339,4 +353,8 @@ def export_prometheus(ctx, comm=None, prefix: str = "ompi_tpu") -> str:
     rrows = requests.prometheus_rows(rank, comm=label, prefix=prefix)
     if rrows:
         text += "\n".join(rrows) + "\n"
+    from . import history
+    hrows = history.prometheus_rows(rank, comm=label, prefix=prefix)
+    if hrows:
+        text += "\n".join(hrows) + "\n"
     return text

@@ -45,8 +45,10 @@ from ..trace import merge as _merge
 # 12 = the serving-fleet section: per-replica rows, migration
 #      ledger, router decision table, ISSUE 18;
 # 13 = the request-plane section: per-request stage waterfall,
-#      tail-attribution rollup, SLO judge counters, ISSUE 19)
-SCHEMA_VERSION = 13
+#      tail-attribution rollup, SLO judge counters, ISSUE 19;
+# 14 = the history-plane section: run-trajectory sparklines +
+#      changepoint verdicts, ISSUE 20)
+SCHEMA_VERSION = 14
 
 
 def build_report(tl: "_merge.FleetTimeline", rules: Optional[str] = None,
@@ -880,6 +882,78 @@ def build_requests_report(
     return "\n".join(lines), rep
 
 
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: List[float], width: int = 24) -> str:
+    """Deterministic unicode sparkline of a trajectory (downsampled to
+    ``width`` by the history store's bucket-mean rule)."""
+    from ..history import downsample
+    vals = downsample([float(v) for v in values], width)
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0.0:
+        return _SPARK[3] * len(vals)
+    idx = [int((v - lo) / span * (len(_SPARK) - 1)) for v in vals]
+    return "".join(_SPARK[i] for i in idx)
+
+
+def build_history_report(
+        path: Optional[str] = None) -> Tuple[str, Dict[str, Any]]:
+    """(human text, structured dict) for the history plane: one
+    sparkline + trend row per banked (probe, metric) trajectory and
+    the changepoint verdicts the sentry attributed.  ``path`` loads a
+    banked HISTORY json (bench.py --history); default reads the live
+    in-process run ledger."""
+    if path:
+        with open(path) as fh:
+            rep = json.load(fh)
+        rep = rep.get("report", rep)
+    else:
+        from .. import history as _history
+        rep = _history.report()
+    lines: List[str] = []
+    w = lines.append
+    src = f" (from {path})" if path else ""
+    w(f"history: {int(rep.get('runs', 0))} run(s), "
+      f"{int(rep.get('samples', 0))} sample(s), "
+      f"{int(rep.get('changepoints', 0))} changepoint(s){src}")
+    gauges = rep.get("gauges") or []
+    if gauges:
+        w("  probe      metric                        runs  "
+          "trend                     latest")
+        for g in gauges:
+            vals = [float(v) for v in g.get("values") or []]
+            if not vals:
+                continue
+            spark = _sparkline(vals)
+            first, last = vals[0], vals[-1]
+            pct = 100.0 * (last - first) / abs(first) if first else 0.0
+            w(f"    {str(g.get('probe', '?')):<9}"
+              f"{str(g.get('metric', '?')):<30}"
+              f"{int(g.get('runs', len(vals))):>4}  "
+              f"{spark:<24}  {last:>10.3f} ({pct:+.1f}%)")
+    verdicts = rep.get("verdicts") or []
+    if verdicts:
+        w("  changepoints (one verdict per episode):")
+        for v in verdicts:
+            where = (f"step {int(v['step_index'])} of run "
+                     f"{int(v.get('run_id', 0))}"
+                     if v.get("scope") == "series"
+                     and v.get("step_index") is not None
+                     else f"run {int(v.get('run_id', 0))}")
+            w(f"    [{str(v.get('severity', '?')):<5}] "
+              f"{str(v.get('probe', '?'))}/"
+              f"{str(v.get('metric', '?'))} "
+              f"{str(v.get('direction', '?'))} "
+              f"{float(v.get('magnitude_pct', 0.0)):+.1f}% at {where} "
+              f"(stat {float(v.get('stat', 0.0)):.1f})")
+    else:
+        w("  no changepoints attributed (trajectory clean or below "
+          "the min-run gate)")
+    return "\n".join(lines), rep
+
+
 def _default_ledger() -> Optional[str]:
     hits = sorted(glob.glob("PERF_LEDGER_*.json"))
     return hits[0] if hits else None
@@ -997,6 +1071,14 @@ def _parse_args(argv: Optional[List[str]]) -> argparse.Namespace:
                          "the SLO judge counters. With a path, loads a "
                          "banked REQUESTS json (bench.py --slo); bare "
                          "flag reads the live in-process request ledger")
+    ap.add_argument("--history", nargs="?", const="", default=None,
+                    metavar="HISTORY.json",
+                    help="render the history-plane section: one "
+                         "sparkline/trend row per banked run "
+                         "trajectory plus the changepoint verdicts. "
+                         "With a path, loads a banked HISTORY json "
+                         "(bench.py --history); bare flag reads the "
+                         "live in-process run ledger")
     ap.add_argument("--live", action="store_true",
                     help="gather over comm_world instead of reading "
                          "dumps (run under tpurun)")
@@ -1036,7 +1118,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 or ns.reshard is not None or ns.analyze is not None
                 or ns.ft is not None or ns.moe is not None
                 or ns.serve is not None or ns.policy is not None
-                or ns.fleet is not None or ns.requests is not None):
+                or ns.fleet is not None or ns.requests is not None
+                or ns.history is not None):
             # plane sections render standalone (no merged timeline)
             return _report(None, ns)
         print("comm_doctor: no trace dumps given (and not --live); "
@@ -1102,6 +1185,10 @@ def _report(tl: Optional["_merge.FleetTimeline"], ns: argparse.Namespace,
         rqtext, rqdata = build_requests_report(ns.requests or None)
         text = (text + "\n" + rqtext) if text else rqtext
         data["requests"] = rqdata
+    if getattr(ns, "history", None) is not None:
+        hitext, hidata = build_history_report(ns.history or None)
+        text = (text + "\n" + hitext) if text else hitext
+        data["history"] = hidata
     data["schema_version"] = SCHEMA_VERSION
     if ns.as_json:
         if ns.merged_out:

@@ -392,7 +392,7 @@ class TestCommLint:
 class TestDoctorDecode:
     def test_serve_report_renders_spec_and_dispatches(self):
         from ompi_tpu.tools import comm_doctor
-        assert comm_doctor.SCHEMA_VERSION == 13
+        assert comm_doctor.SCHEMA_VERSION == 14
         serving.reset()
         serving.enable()
         serving.note_admit("r9", 4, 8, 0.0, 0.0)

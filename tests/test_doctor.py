@@ -580,7 +580,7 @@ def test_comm_doctor_policy_banked_json_golden(tmp_path, capsys):
     rc = comm_doctor.main(["--policy", str(banked), "--json"])
     assert rc == 0
     data = json.loads(capsys.readouterr().out)
-    assert data["schema_version"] == 13       # the v12 -> v13 pin
+    assert data["schema_version"] == 14       # the v13 -> v14 pin
     assert data["policy"] == report           # banked report, verbatim
 
     rc = comm_doctor.main(["--policy", str(banked)])
@@ -607,7 +607,7 @@ def test_comm_doctor_policy_live_section(capsys):
         rc = comm_doctor.main(["--policy", "--json"])
         assert rc == 0
         data = json.loads(capsys.readouterr().out)
-        assert data["schema_version"] == 13
+        assert data["schema_version"] == 14
         pol = data["policy"]
         assert pol["verdicts_published"] == 1
         assert pol["decisions_applied"] == 1

@@ -499,7 +499,7 @@ def test_doctor_ft_report_renders_timeline(tmp_path):
 
     from ompi_tpu.tools import comm_doctor
 
-    assert comm_doctor.SCHEMA_VERSION == 13
+    assert comm_doctor.SCHEMA_VERSION == 14
     doc = {"report": {
         "counters": {"ft_recoveries": 1, "ft_steps_lost": 2,
                      "ft_shadow_refreshes": 9},

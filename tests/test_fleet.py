@@ -248,7 +248,7 @@ def test_comm_doctor_fleet_live_section(capsys):
     rc = comm_doctor.main(["--fleet", "--json"])
     assert rc == 0
     data = json.loads(capsys.readouterr().out)
-    assert data["schema_version"] == 13
+    assert data["schema_version"] == 14
     fl = data["fleet"]
     assert fl["replicas"] == 2
     assert fl["migrations"] == 1 and fl["migrated_bytes"] == 2048
@@ -299,7 +299,7 @@ def test_comm_doctor_fleet_banked_json_golden(tmp_path, capsys):
     rc = comm_doctor.main(["--fleet", str(banked), "--json"])
     assert rc == 0
     data = json.loads(capsys.readouterr().out)
-    assert data["schema_version"] == 13       # the v12 -> v13 pin
+    assert data["schema_version"] == 14       # the v13 -> v14 pin
     assert data["fleet"] == report            # banked report, verbatim
 
     rc = comm_doctor.main(["--fleet", str(banked)])

@@ -405,7 +405,7 @@ class TestDoctorMoe:
 
         from ompi_tpu.tools import comm_doctor
 
-        assert comm_doctor.SCHEMA_VERSION == 13
+        assert comm_doctor.SCHEMA_VERSION == 14
         moe_plane.enable()
         moe_plane.reset()
         var.registry.set_cli("moe_adapt_cooldown", "1")

@@ -164,7 +164,7 @@ class TestRegistrationVeto:
 
     def test_builtin_vocabulary_verifies_clean(self):
         eng = PolicyEngine(builtin_rules())
-        assert len(eng.rules) == 7         # + req_slo_breach (PR 19)
+        assert len(eng.rules) == 8         # + history_demote_quant (PR 20)
         quant_reports = eng.verified["demote_arm_quant"]
         assert len(quant_reports) == 4     # one per coll in the surface
         assert all(r["predicted_wire_bytes"] < r["native_wire_bytes"]

@@ -379,7 +379,7 @@ def test_comm_doctor_requests_banked_golden(tmp_path, capsys):
     rc = comm_doctor.main(["--requests", str(banked), "--json"])
     assert rc == 0
     data = json.loads(capsys.readouterr().out)
-    assert data["schema_version"] == 13      # the v12 -> v13 pin
+    assert data["schema_version"] == 14      # the v13 -> v14 pin
     assert data["requests"] == report        # banked report, verbatim
     rc = comm_doctor.main(["--requests", str(banked)])
     assert rc == 0
@@ -402,7 +402,7 @@ def test_comm_doctor_requests_live_section(capsys):
     rc = comm_doctor.main(["--requests", "--json"])
     assert rc == 0
     data = json.loads(capsys.readouterr().out)
-    assert data["schema_version"] == 13
+    assert data["schema_version"] == 14
     req = data["requests"]
     assert req["completed"] == 1
     assert req["slo_breaches"] == 0

@@ -408,7 +408,7 @@ class TestServePvars:
 class TestDoctorServe:
     def test_schema_and_live_section(self, shared):
         from ompi_tpu.tools import comm_doctor
-        assert comm_doctor.SCHEMA_VERSION == 13
+        assert comm_doctor.SCHEMA_VERSION == 14
         serving.reset()
         serving.enable()
         serving.note_admit("r2", 4, 8, 0.0, 0.0)
